@@ -1,0 +1,23 @@
+"""GridLLM-TPU: a TPU-native distributed LLM inference framework.
+
+A ground-up rebuild of the GridLLM orchestrator (reference: GridLLM/GridLLM,
+a TypeScript server/worker system proxying to Ollama — see SURVEY.md) with the
+inference engine implemented natively in JAX/XLA/Pallas for TPU:
+
+- ``gateway``   — Ollama- and OpenAI-compatible HTTP API server
+                  (reference: server/src/routes/*)
+- ``scheduler`` — job queue, worker registry, failure machinery
+                  (reference: server/src/services/JobScheduler.ts, WorkerRegistry.ts)
+- ``bus``       — pub/sub + KV message bus (in-memory and RESP/Redis wire)
+                  (reference: server/src/services/RedisService.ts)
+- ``worker``    — TPU worker host: registration, heartbeat, job execution
+                  (reference: client/src/services/WorkerClientService.ts)
+- ``engine``    — JAX inference engine: continuous batching, streaming decode
+                  (replaces the reference's external Ollama dependency,
+                  client/src/services/OllamaService.ts)
+- ``models``    — Llama / Mixtral / embedding model definitions (pure JAX)
+- ``ops``       — attention, KV-cache, sampling, norms; Pallas TPU kernels
+- ``parallel``  — device mesh, sharding plans (TP/EP/DP/SP), collectives
+"""
+
+__version__ = "0.1.0"

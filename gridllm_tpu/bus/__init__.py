@@ -1,0 +1,30 @@
+from gridllm_tpu.bus.base import MessageBus, Subscription
+from gridllm_tpu.bus.memory import InMemoryBus
+
+
+def create_bus(url: str = "", key_prefix: str = "GridLLM:",
+               password: str | None = None, db: int = 0) -> MessageBus:
+    """Bus factory. "" → process-local in-memory bus; "resp://host:port" or a
+    standard "redis://[:pass@]host:port[/db]" URL → RESP wire protocol (real
+    Redis or the bundled gridbus broker). Explicit password/db args are
+    fallbacks for URL forms that omit them."""
+    if not url or url == "memory://":
+        return InMemoryBus(key_prefix=key_prefix)
+    if url.startswith(("resp://", "redis://", "rediss://")):
+        from urllib.parse import urlparse
+
+        from gridllm_tpu.bus.resp import RespBus
+
+        parsed = urlparse(url)
+        url_db = parsed.path.lstrip("/")
+        return RespBus(
+            host=parsed.hostname or "localhost",
+            port=parsed.port or 6379,
+            key_prefix=key_prefix,
+            password=parsed.password or password,
+            db=int(url_db) if url_db.isdigit() else db,
+        )
+    raise ValueError(f"Unknown bus url: {url!r}")
+
+
+__all__ = ["MessageBus", "Subscription", "InMemoryBus", "create_bus"]

@@ -1,0 +1,140 @@
+"""Message-bus interface: the DCN-plane control/data bus contract.
+
+Reference analogue: server/src/services/RedisService.ts:110-247 and
+client/src/services/RedisConnectionManager.ts:257-358 — Redis KV + hash +
+pub/sub with a `GridLLM:` key prefix. Design fixes baked in (SURVEY.md §2.8):
+
+- ``subscribe`` returns a ``Subscription`` handle whose ``unsubscribe()``
+  removes exactly that handler — the reference leaked one `message` listener
+  per subscribe call (RedisService.ts:207-227).
+- Channel names are NOT key-prefixed (matches reference behavior: ioredis
+  keyPrefix does not apply to pub/sub), keys ARE.
+
+The protocol carried over this interface (channels `worker:*`, `job:*`,
+keys `workers`, `heartbeat:{id}`, `active_jobs`, `job_queue`) is inventoried
+in SURVEY.md §2.6 and implemented by scheduler/ and worker/.
+"""
+
+from __future__ import annotations
+
+import abc
+import asyncio
+from typing import Awaitable, Callable
+
+# handler(channel, message) — message is the raw string payload
+Handler = Callable[[str, str], Awaitable[None]]
+
+
+class HandlerPump:
+    """Per-handler FIFO delivery: a queue plus one pump task, so a handler
+    always finishes message N before seeing N+1 (token-stream frames on
+    `job:stream:{id}` rely on in-order delivery), while publishers never
+    block. Handler exceptions are logged and do not kill the pump."""
+
+    def __init__(self, handler: Handler):
+        self.handler = handler
+        self.queue: asyncio.Queue[tuple[str, str]] = asyncio.Queue()
+        self.task = asyncio.ensure_future(self._run())
+
+    async def _run(self) -> None:
+        while True:
+            channel, message = await self.queue.get()
+            try:
+                await self.handler(channel, message)
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                import traceback
+
+                traceback.print_exc()
+            finally:
+                self.queue.task_done()
+
+    def push(self, channel: str, message: str) -> None:
+        self.queue.put_nowait((channel, message))
+
+    async def drain(self) -> None:
+        await self.queue.join()
+
+    def stop(self) -> None:
+        self.task.cancel()
+
+
+class Subscription:
+    """Handle for one (pattern|channel, handler) registration."""
+
+    def __init__(self, unsubscribe: Callable[[], Awaitable[None]], target: str):
+        self._unsubscribe = unsubscribe
+        self.target = target
+        self.active = True
+
+    async def unsubscribe(self) -> None:
+        if self.active:
+            self.active = False
+            await self._unsubscribe()
+
+
+class MessageBus(abc.ABC):
+    """KV + hash + pub/sub bus. All ``key`` args get the configured prefix."""
+
+    def __init__(self, key_prefix: str = "GridLLM:"):
+        self.key_prefix = key_prefix
+
+    def _k(self, key: str) -> str:
+        return f"{self.key_prefix}{key}"
+
+    # -- lifecycle ----------------------------------------------------------
+    @abc.abstractmethod
+    async def connect(self) -> None: ...
+
+    @abc.abstractmethod
+    async def disconnect(self) -> None: ...
+
+    @abc.abstractmethod
+    async def is_healthy(self) -> bool:
+        """reference: RedisService.isHealthy (ping), RedisService.ts:270-277."""
+
+    # -- KV -----------------------------------------------------------------
+    @abc.abstractmethod
+    async def get(self, key: str) -> str | None: ...
+
+    @abc.abstractmethod
+    async def set(self, key: str, value: str) -> None: ...
+
+    @abc.abstractmethod
+    async def set_with_expiry(self, key: str, value: str, ttl_s: float) -> None:
+        """reference: setWithExpiry — heartbeat TTL keys
+        (RedisConnectionManager.ts:299-309)."""
+
+    @abc.abstractmethod
+    async def delete(self, key: str) -> None: ...
+
+    @abc.abstractmethod
+    async def ttl(self, key: str) -> int:
+        """Seconds to live; -1 no expiry; -2 missing (Redis TTL semantics —
+        the liveness probe reads this, WorkerRegistry.ts:161-180)."""
+
+    # -- hash ---------------------------------------------------------------
+    @abc.abstractmethod
+    async def hget(self, key: str, field: str) -> str | None: ...
+
+    @abc.abstractmethod
+    async def hset(self, key: str, field: str, value: str) -> None: ...
+
+    @abc.abstractmethod
+    async def hgetall(self, key: str) -> dict[str, str]: ...
+
+    @abc.abstractmethod
+    async def hdel(self, key: str, field: str) -> None: ...
+
+    # -- pub/sub ------------------------------------------------------------
+    @abc.abstractmethod
+    async def publish(self, channel: str, message: str) -> int:
+        """Returns receiver count when known (0 otherwise)."""
+
+    @abc.abstractmethod
+    async def subscribe(self, channel: str, handler: Handler) -> Subscription: ...
+
+    @abc.abstractmethod
+    async def psubscribe(self, pattern: str, handler: Handler) -> Subscription:
+        """Glob-style pattern subscribe (reference: RedisService.ts:230-247)."""

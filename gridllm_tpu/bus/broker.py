@@ -1,0 +1,308 @@
+"""gridbus: a minimal RESP2 broker (pure asyncio).
+
+Drop-in replacement for the reference's Redis dependency
+(docker-compose.yml service `redis`) covering exactly the command subset the
+GridLLM protocol uses (SURVEY.md §2.6): PING, GET/SET(+PX/EX)/DEL/TTL,
+HGET/HSET/HGETALL/HDEL, PUBLISH/SUBSCRIBE/UNSUBSCRIBE/PSUBSCRIBE/
+PUNSUBSCRIBE, AUTH/SELECT (accepted, no-op). Real Redis remains fully
+compatible (RespBus speaks standard RESP2); this broker exists so a
+multi-process cluster can run with zero external dependencies.
+
+Run: ``python -m gridllm_tpu.bus.broker --port 6379``
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import fnmatch
+import time
+
+from gridllm_tpu.utils.logging import get_logger
+
+log = get_logger("bus.broker")
+
+
+def _bulk(s: str | None) -> bytes:
+    if s is None:
+        return b"$-1\r\n"
+    b = s.encode()
+    return b"$%d\r\n%s\r\n" % (len(b), b)
+
+
+def _arr(items: list[bytes]) -> bytes:
+    return b"*%d\r\n%s" % (len(items), b"".join(items))
+
+
+def _int(n: int) -> bytes:
+    return b":%d\r\n" % n
+
+
+OK = b"+OK\r\n"
+PONG = b"+PONG\r\n"
+
+
+class GridBusBroker:
+    def __init__(self) -> None:
+        self._kv: dict[str, str] = {}
+        self._expiry: dict[str, float] = {}
+        self._hashes: dict[str, dict[str, str]] = {}
+        # channel/pattern → set of client writers
+        self._subs: dict[str, set[asyncio.StreamWriter]] = {}
+        self._psubs: dict[str, set[asyncio.StreamWriter]] = {}
+        self._clients: set[asyncio.StreamWriter] = {*()}
+        self._server: asyncio.AbstractServer | None = None
+
+    # -- kv helpers ---------------------------------------------------------
+    def _expired(self, key: str) -> bool:
+        dl = self._expiry.get(key)
+        if dl is not None and time.monotonic() >= dl:
+            self._kv.pop(key, None)
+            self._expiry.pop(key, None)
+            return True
+        return False
+
+    # -- server -------------------------------------------------------------
+    async def start(self, host: str = "127.0.0.1", port: int = 6379) -> None:
+        self._server = await asyncio.start_server(self._client, host, port)
+        log.info("gridbus broker listening", host=host, port=port)
+
+    @property
+    def port(self) -> int:
+        assert self._server is not None
+        return self._server.sockets[0].getsockname()[1]
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            # Close live client connections too: since Python 3.12.1
+            # Server.wait_closed() blocks until all handlers finish.
+            for w in list(self._clients):
+                try:
+                    w.close()
+                except Exception:
+                    pass
+            await self._server.wait_closed()
+            self._server = None
+
+    async def serve_forever(self) -> None:
+        assert self._server is not None
+        async with self._server:
+            await self._server.serve_forever()
+
+    MAX_BULK = 64 * 1024 * 1024  # guard against absurd $<len> headers
+
+    async def _read_command(self, reader: asyncio.StreamReader) -> list[str] | None:
+        """Returns None to close the connection (EOF or malformed frame)."""
+        try:
+            line = await reader.readuntil(b"\r\n")
+            if not line.startswith(b"*"):
+                # inline command (telnet-style)
+                parts = line.strip().split()
+                return [p.decode("utf-8", errors="replace") for p in parts] if parts else []
+            n = int(line[1:-2])
+            if n < 0 or n > 1024:
+                return None
+            args: list[str] = []
+            for _ in range(n):
+                hdr = await reader.readuntil(b"\r\n")
+                if not hdr.startswith(b"$"):
+                    return None
+                ln = int(hdr[1:-2])
+                if ln < 0 or ln > self.MAX_BULK:
+                    return None
+                data = await reader.readexactly(ln + 2)
+                args.append(data[:-2].decode("utf-8", errors="replace"))
+            return args
+        except (asyncio.IncompleteReadError, ConnectionResetError, ValueError,
+                asyncio.LimitOverrunError):
+            return None
+
+    async def _client(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter) -> None:
+        self._clients.add(writer)
+        try:
+            while True:
+                args = await self._read_command(reader)
+                if args is None:
+                    break
+                if not args:
+                    continue
+                reply = self._execute(args, writer)
+                if reply is not None:
+                    writer.write(reply)
+                    await writer.drain()
+        except (ConnectionResetError, asyncio.IncompleteReadError, BrokenPipeError):
+            pass
+        finally:
+            self._clients.discard(writer)
+            self._drop_client(writer)
+            writer.close()
+
+    def _drop_client(self, writer: asyncio.StreamWriter) -> None:
+        for registry in (self._subs, self._psubs):
+            empty = []
+            for target, clients in registry.items():
+                clients.discard(writer)
+                if not clients:
+                    empty.append(target)
+            for t in empty:
+                registry.pop(t, None)
+
+    # -- command dispatch ---------------------------------------------------
+    def _execute(self, args: list[str], writer: asyncio.StreamWriter) -> bytes | None:
+        cmd = args[0].upper()
+        a = args[1:]
+        if cmd == "PING":
+            return PONG
+        if cmd in ("AUTH", "SELECT"):
+            return OK
+        if cmd == "GET":
+            key = a[0]
+            if self._expired(key):
+                return _bulk(None)
+            return _bulk(self._kv.get(key))
+        if cmd == "SET":
+            key, val = a[0], a[1]
+            self._kv[key] = val
+            self._expiry.pop(key, None)
+            i = 2
+            while i < len(a):
+                opt = a[i].upper()
+                if opt == "PX":
+                    self._expiry[key] = time.monotonic() + int(a[i + 1]) / 1000
+                    i += 2
+                elif opt == "EX":
+                    self._expiry[key] = time.monotonic() + int(a[i + 1])
+                    i += 2
+                else:
+                    i += 1
+            return OK
+        if cmd == "SETEX":
+            self._kv[a[0]] = a[2]
+            self._expiry[a[0]] = time.monotonic() + int(a[1])
+            return OK
+        if cmd == "DEL":
+            n = 0
+            for key in a:
+                if key in self._kv or key in self._hashes:
+                    n += 1
+                self._kv.pop(key, None)
+                self._expiry.pop(key, None)
+                self._hashes.pop(key, None)
+            return _int(n)
+        if cmd == "TTL":
+            key = a[0]
+            if self._expired(key) or (key not in self._kv and key not in self._hashes):
+                return _int(-2)
+            dl = self._expiry.get(key)
+            return _int(-1 if dl is None else max(0, int(dl - time.monotonic())))
+        if cmd == "EXISTS":
+            return _int(sum(1 for k in a if not self._expired(k) and (k in self._kv or k in self._hashes)))
+        if cmd == "HGET":
+            return _bulk(self._hashes.get(a[0], {}).get(a[1]))
+        if cmd == "HSET":
+            h = self._hashes.setdefault(a[0], {})
+            added = 0
+            for i in range(1, len(a) - 1, 2):
+                if a[i] not in h:
+                    added += 1
+                h[a[i]] = a[i + 1]
+            return _int(added)
+        if cmd == "HGETALL":
+            h = self._hashes.get(a[0], {})
+            flat: list[bytes] = []
+            for k, v in h.items():
+                flat.append(_bulk(k))
+                flat.append(_bulk(v))
+            return _arr(flat)
+        if cmd == "HDEL":
+            h = self._hashes.get(a[0], {})
+            n = 0
+            for f in a[1:]:
+                if f in h:
+                    h.pop(f)
+                    n += 1
+            return _int(n)
+        if cmd == "PUBLISH":
+            return _int(self._publish(a[0], a[1]))
+        if cmd == "SUBSCRIBE":
+            for ch in a:
+                self._subs.setdefault(ch, set()).add(writer)
+                writer.write(_arr([_bulk("subscribe"), _bulk(ch), _int(1)]))
+            return None
+        if cmd == "UNSUBSCRIBE":
+            for ch in a:
+                clients = self._subs.get(ch)
+                if clients:
+                    clients.discard(writer)
+                    if not clients:
+                        self._subs.pop(ch, None)
+                writer.write(_arr([_bulk("unsubscribe"), _bulk(ch), _int(0)]))
+            return None
+        if cmd == "PSUBSCRIBE":
+            for p in a:
+                self._psubs.setdefault(p, set()).add(writer)
+                writer.write(_arr([_bulk("psubscribe"), _bulk(p), _int(1)]))
+            return None
+        if cmd == "PUNSUBSCRIBE":
+            for p in a:
+                clients = self._psubs.get(p)
+                if clients:
+                    clients.discard(writer)
+                    if not clients:
+                        self._psubs.pop(p, None)
+                writer.write(_arr([_bulk("punsubscribe"), _bulk(p), _int(0)]))
+            return None
+        return b"-ERR unknown command '%s'\r\n" % cmd.encode()
+
+    def _publish(self, channel: str, message: str) -> int:
+        n = 0
+        frame = _arr([_bulk("message"), _bulk(channel), _bulk(message)])
+        for w in list(self._subs.get(channel, ())):
+            if self._try_write(w, frame):
+                n += 1
+        for pattern, clients in list(self._psubs.items()):
+            if fnmatch.fnmatchcase(channel, pattern):
+                pframe = _arr([_bulk("pmessage"), _bulk(pattern), _bulk(channel), _bulk(message)])
+                for w in list(clients):
+                    if self._try_write(w, pframe):
+                        n += 1
+        return n
+
+    # Redis's client-output-buffer-limit for pubsub clients defaults to
+    # 32mb hard; same idea — a subscriber that stops reading gets kicked
+    # instead of growing the broker's memory unboundedly.
+    MAX_SUB_BUFFER = 32 * 1024 * 1024
+
+    def _try_write(self, writer: asyncio.StreamWriter, frame: bytes) -> bool:
+        try:
+            if writer.is_closing():
+                return False
+            transport = writer.transport
+            if transport.get_write_buffer_size() > self.MAX_SUB_BUFFER:
+                log.warning("kicking slow pub/sub subscriber (output buffer full)")
+                self._drop_client(writer)
+                writer.close()
+                return False
+            writer.write(frame)
+            return True
+        except Exception:
+            return False
+
+
+def main() -> None:  # pragma: no cover
+    ap = argparse.ArgumentParser(description="gridbus RESP broker")
+    ap.add_argument("--host", default="0.0.0.0")
+    ap.add_argument("--port", type=int, default=6379)
+    ns = ap.parse_args()
+
+    async def run() -> None:
+        broker = GridBusBroker()
+        await broker.start(ns.host, ns.port)
+        await broker.serve_forever()
+
+    asyncio.run(run())
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

@@ -1,0 +1,317 @@
+"""RESP wire-protocol bus client (asyncio, no third-party deps).
+
+Speaks RESP2 to any compatible broker: a real Redis 7 (the reference's bus,
+docker-compose.yml service `redis`) or the bundled `gridbusd` broker
+(gridllm_tpu/bus/broker.py). Mirrors the reference's 3-connection pattern —
+main KV / subscriber / publisher — because a RESP connection in subscribe
+mode cannot issue normal commands (server/src/services/RedisService.ts:19-53,
+client/src/services/RedisConnectionManager.ts:36-92).
+
+Failure handling:
+- main/publisher connections reconnect lazily inside ``command`` (one retry
+  per call) — a broker restart does not permanently poison KV/publish.
+- the subscriber connection reconnects with exponential backoff in its push
+  pump and re-issues all subscriptions; on loss it fires ``on_disconnect`` so
+  the worker can publish `worker:disconnected` best-effort, mirroring
+  RedisConnectionManager.ts:158-179.
+- deliveries are strictly ordered per handler (HandlerPump).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Awaitable, Callable
+
+from gridllm_tpu.bus.base import Handler, HandlerPump, MessageBus, Subscription
+from gridllm_tpu.utils.logging import get_logger
+
+log = get_logger("bus.resp")
+
+
+def encode_command(*args: str | bytes | int | float) -> bytes:
+    """RESP array-of-bulk-strings command encoding."""
+    out = [f"*{len(args)}\r\n".encode()]
+    for a in args:
+        b = a if isinstance(a, bytes) else str(a).encode()
+        out.append(f"${len(b)}\r\n".encode())
+        out.append(b)
+        out.append(b"\r\n")
+    return b"".join(out)
+
+
+class RespProtocolError(Exception):
+    pass
+
+
+async def read_reply(reader: asyncio.StreamReader):
+    """Parse one RESP2 reply (simple/error/int/bulk/array, recursively)."""
+    line = await reader.readuntil(b"\r\n")
+    kind, rest = line[:1], line[1:-2]
+    if kind == b"+":
+        return rest.decode()
+    if kind == b"-":
+        raise RespProtocolError(rest.decode())
+    if kind == b":":
+        return int(rest)
+    if kind == b"$":
+        n = int(rest)
+        if n == -1:
+            return None
+        data = await reader.readexactly(n + 2)
+        return data[:-2].decode("utf-8", errors="replace")
+    if kind == b"*":
+        n = int(rest)
+        if n == -1:
+            return None
+        return [await read_reply(reader) for _ in range(n)]
+    raise RespProtocolError(f"bad RESP type byte: {line!r}")
+
+
+_CONN_ERRORS = (ConnectionError, asyncio.IncompleteReadError, OSError, EOFError)
+
+
+class _Conn:
+    """One RESP connection with serialized request/reply and lazy reconnect."""
+
+    def __init__(self, host: str, port: int, name: str,
+                 password: str | None = None, db: int = 0):
+        self.host, self.port, self.name = host, port, name
+        self.password, self.db = password, db
+        self.reader: asyncio.StreamReader | None = None
+        self.writer: asyncio.StreamWriter | None = None
+        self._lock = asyncio.Lock()
+
+    async def connect(self) -> None:
+        async with self._lock:
+            await self._connect_locked()
+
+    async def _connect_locked(self) -> None:
+        await self._close_locked()
+        self.reader, self.writer = await asyncio.open_connection(self.host, self.port)
+        # AUTH/SELECT inline (can't recurse into command(); lock already held)
+        for cmd in ([("AUTH", self.password)] if self.password else []) + \
+                   ([("SELECT", self.db)] if self.db else []):
+            self.writer.write(encode_command(*cmd))
+            await self.writer.drain()
+            await read_reply(self.reader)
+
+    async def close(self) -> None:
+        async with self._lock:
+            await self._close_locked()
+
+    async def _close_locked(self) -> None:
+        if self.writer is not None:
+            try:
+                self.writer.close()
+                await self.writer.wait_closed()
+            except Exception:
+                pass
+        self.reader = self.writer = None
+
+    async def command(self, *args: str | bytes | int | float):
+        async with self._lock:
+            for attempt in range(2):
+                try:
+                    if self.writer is None:
+                        await self._connect_locked()
+                    assert self.reader is not None and self.writer is not None
+                    self.writer.write(encode_command(*args))
+                    await self.writer.drain()
+                    return await read_reply(self.reader)
+                except _CONN_ERRORS:
+                    await self._close_locked()
+                    if attempt == 1:
+                        raise
+                    log.warning("connection lost, retrying once",
+                                conn=self.name, command=str(args[0]))
+
+    async def send_only(self, *args: str | bytes | int | float) -> None:
+        """Write a command without reading its reply. Used on the subscriber
+        connection while the push-message pump owns the read side (the pump
+        consumes and ignores subscribe/unsubscribe acks)."""
+        async with self._lock:
+            if self.writer is None:
+                raise ConnectionError(f"{self.name}: not connected")
+            self.writer.write(encode_command(*args))
+            await self.writer.drain()
+
+
+class RespBus(MessageBus):
+    def __init__(self, host: str = "localhost", port: int = 6379,
+                 key_prefix: str = "GridLLM:", password: str | None = None,
+                 db: int = 0, reconnect_max_attempts: int = 10):
+        super().__init__(key_prefix)
+        self.host, self.port = host, port
+        self.password, self.db = password, db
+        self.reconnect_max_attempts = reconnect_max_attempts
+        self._main = _Conn(host, port, "main", password, db)
+        self._pub = _Conn(host, port, "publisher", password, db)
+        self._sub = _Conn(host, port, "subscriber", password, db)
+        self._subs: dict[str, list[HandlerPump]] = {}
+        self._psubs: dict[str, list[HandlerPump]] = {}
+        self._reader_task: asyncio.Task | None = None
+        self._closed = False
+        # Set by the worker runtime to publish `worker:disconnected` fast-path
+        self.on_disconnect: Callable[[], Awaitable[None]] | None = None
+
+    # -- lifecycle ----------------------------------------------------------
+    async def connect(self) -> None:
+        self._closed = False
+        for conn in (self._main, self._pub, self._sub):
+            await conn.connect()
+        self._reader_task = asyncio.create_task(self._sub_reader_loop())
+        # Re-establish any subscriptions that predate a reconnect
+        # (pump owns the read side now → write-only)
+        for channel in self._subs:
+            await self._sub.send_only("SUBSCRIBE", channel)
+        for pattern in self._psubs:
+            await self._sub.send_only("PSUBSCRIBE", pattern)
+
+    async def disconnect(self) -> None:
+        self._closed = True
+        if self._reader_task is not None:
+            self._reader_task.cancel()
+            self._reader_task = None
+        for registry in (self._subs, self._psubs):
+            for pumps in registry.values():
+                for p in pumps:
+                    p.stop()
+            registry.clear()
+        for conn in (self._main, self._pub, self._sub):
+            await conn.close()
+
+    async def is_healthy(self) -> bool:
+        try:
+            return (await self._main.command("PING")) == "PONG"
+        except Exception:
+            return False
+
+    async def _sub_reader_loop(self) -> None:
+        """Push-message pump for the subscriber connection."""
+        backoff = 0.5
+        while not self._closed:
+            try:
+                assert self._sub.reader is not None
+                msg = await read_reply(self._sub.reader)
+                backoff = 0.5
+            except asyncio.CancelledError:
+                return
+            except Exception as e:
+                if self._closed:
+                    return
+                log.warning("subscriber connection lost, reconnecting", error=str(e))
+                if self.on_disconnect is not None:
+                    try:
+                        await self.on_disconnect()
+                    except Exception:
+                        pass
+                ok = await self._reconnect_sub(backoff)
+                backoff = min(backoff * 2, 30.0)
+                if not ok:
+                    return
+                continue
+            if not isinstance(msg, list) or not msg:
+                continue
+            kind = msg[0]
+            if kind == "message" and len(msg) == 3:
+                _, channel, payload = msg
+                for pump in list(self._subs.get(channel, [])):
+                    pump.push(channel, payload)
+            elif kind == "pmessage" and len(msg) == 4:
+                _, pattern, channel, payload = msg
+                for pump in list(self._psubs.get(pattern, [])):
+                    pump.push(channel, payload)
+            # subscribe/unsubscribe acks: ignore
+
+    async def _reconnect_sub(self, delay: float) -> bool:
+        for attempt in range(self.reconnect_max_attempts):
+            await asyncio.sleep(delay)
+            try:
+                await self._sub.connect()  # closes the stale transport first
+                for channel in self._subs:
+                    await self._sub.send_only("SUBSCRIBE", channel)
+                for pattern in self._psubs:
+                    await self._sub.send_only("PSUBSCRIBE", pattern)
+                log.info("subscriber reconnected", attempt=attempt + 1)
+                return True
+            except Exception:
+                delay = min(delay * 2, 30.0)
+        log.error("subscriber reconnect gave up", attempts=self.reconnect_max_attempts)
+        return False
+
+    # -- KV -----------------------------------------------------------------
+    async def get(self, key: str) -> str | None:
+        return await self._main.command("GET", self._k(key))
+
+    async def set(self, key: str, value: str) -> None:
+        await self._main.command("SET", self._k(key), value)
+
+    async def set_with_expiry(self, key: str, value: str, ttl_s: float) -> None:
+        # PX for sub-second TTLs (heartbeat TTL = 2× interval)
+        await self._main.command("SET", self._k(key), value, "PX", int(ttl_s * 1000))
+
+    async def delete(self, key: str) -> None:
+        await self._main.command("DEL", self._k(key))
+
+    async def ttl(self, key: str) -> int:
+        return int(await self._main.command("TTL", self._k(key)))
+
+    # -- hash ---------------------------------------------------------------
+    async def hget(self, key: str, field: str) -> str | None:
+        return await self._main.command("HGET", self._k(key), field)
+
+    async def hset(self, key: str, field: str, value: str) -> None:
+        await self._main.command("HSET", self._k(key), field, value)
+
+    async def hgetall(self, key: str) -> dict[str, str]:
+        flat = await self._main.command("HGETALL", self._k(key)) or []
+        return {flat[i]: flat[i + 1] for i in range(0, len(flat), 2)}
+
+    async def hdel(self, key: str, field: str) -> None:
+        await self._main.command("HDEL", self._k(key), field)
+
+    # -- pub/sub ------------------------------------------------------------
+    async def publish(self, channel: str, message: str) -> int:
+        return int(await self._pub.command("PUBLISH", channel, message))
+
+    async def subscribe(self, channel: str, handler: Handler) -> Subscription:
+        pump = HandlerPump(handler)
+        first = channel not in self._subs
+        self._subs.setdefault(channel, []).append(pump)
+        if first:
+            await self._sub.send_only("SUBSCRIBE", channel)
+
+        async def _unsub() -> None:
+            lst = self._subs.get(channel, [])
+            if pump in lst:
+                lst.remove(pump)
+            pump.stop()
+            if not lst:
+                self._subs.pop(channel, None)
+                try:
+                    await self._sub.send_only("UNSUBSCRIBE", channel)
+                except Exception:
+                    pass
+
+        return Subscription(_unsub, channel)
+
+    async def psubscribe(self, pattern: str, handler: Handler) -> Subscription:
+        pump = HandlerPump(handler)
+        first = pattern not in self._psubs
+        self._psubs.setdefault(pattern, []).append(pump)
+        if first:
+            await self._sub.send_only("PSUBSCRIBE", pattern)
+
+        async def _unsub() -> None:
+            lst = self._psubs.get(pattern, [])
+            if pump in lst:
+                lst.remove(pump)
+            pump.stop()
+            if not lst:
+                self._psubs.pop(pattern, None)
+                try:
+                    await self._sub.send_only("PUNSUBSCRIBE", pattern)
+                except Exception:
+                    pass
+
+        return Subscription(_unsub, pattern)

@@ -1,0 +1,169 @@
+"""Env-driven configuration, validated fail-fast at load.
+
+Reference analogue: dotenv + Joi schemas (server/src/config/index.ts:6-92,
+client/src/config/index.ts:6-148). Same shape and defaults; pydantic replaces
+Joi. TPU-specific knobs (mesh, dtype, KV cache) join the worker schema per
+SURVEY.md §5.6.
+
+Defaults preserved from the reference:
+- server port 4000 (server/src/config/index.ts:10)
+- workerHeartbeatTimeout 15000 ms (:24), workerCleanupInterval 5000 ms (:25)
+- jobTimeout 600000 ms (:28), retryAttempts 3 / retryDelay 5000 ms (:29-30)
+- maxConcurrentJobsPerWorker 1 (:31) — the TPU engine supersedes this with
+  continuous batching, so the default here is per-engine slot count
+- bus key prefix "GridLLM:" (:17)
+- worker heartbeatInterval 5000 ms (client/src/config/index.ts:94)
+"""
+
+from __future__ import annotations
+
+import os
+import uuid
+from typing import Any
+
+from pydantic import BaseModel, Field, ValidationError
+
+
+def _env(name: str, default: Any) -> Any:
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    if isinstance(default, bool):
+        return raw.lower() in ("1", "true", "yes", "on")
+    if isinstance(default, int):
+        return int(raw)
+    if isinstance(default, float):
+        return float(raw)
+    return raw
+
+
+class BusConfig(BaseModel):
+    """reference: redis block of server/src/config/index.ts:12-18."""
+
+    url: str = ""                      # "" → in-memory bus; "resp://host:port" → wire
+    host: str = "localhost"
+    port: int = 6379
+    password: str | None = None
+    db: int = 0
+    key_prefix: str = "GridLLM:"
+
+
+class SchedulerConfig(BaseModel):
+    """reference: performance/scheduling block, server/src/config/index.ts:22-33."""
+
+    worker_heartbeat_timeout_ms: int = Field(15_000, gt=0)
+    worker_cleanup_interval_ms: int = Field(5_000, gt=0)
+    connection_monitor_interval_ms: int = Field(5_000, gt=0)
+    quick_disconnect_window_ms: int = Field(15_000, gt=0)
+    orphan_assign_threshold_ms: int = Field(10_000, gt=0)
+    job_timeout_ms: int = Field(600_000, gt=0)
+    retry_attempts: int = Field(3, ge=0)
+    retry_delay_ms: int = Field(5_000, ge=0)
+    max_concurrent_jobs_per_worker: int = Field(1, ge=1)
+    # TPU change: the reference polled a 1 s tick (JobScheduler.ts:128-135);
+    # we dispatch event-driven, with this tick only as a fallback sweep.
+    sweep_interval_ms: int = Field(1_000, gt=0)
+
+
+class GatewayConfig(BaseModel):
+    """reference: server block, server/src/config/index.ts:8-11, 38-43."""
+
+    host: str = "0.0.0.0"
+    port: int = 4000
+    max_body_bytes: int = 10 * 1024 * 1024  # express json limit 10mb (index.ts:47)
+    rate_limit_window_ms: int = 900_000
+    rate_limit_max_requests: int = 100
+    rate_limit_enabled: bool = True
+    default_request_timeout_ms: int = 300_000
+
+
+class EngineConfig(BaseModel):
+    """TPU engine knobs — NEW (replaces the reference's ollama block,
+    client/src/config/index.ts:82-89)."""
+
+    models: str = ""                   # comma-separated model specs to serve
+    checkpoint_dir: str = ""
+    dtype: str = "bfloat16"
+    max_seq_len: int = 8192
+    max_batch_slots: int = 8           # continuous-batching slot count
+    prefill_buckets: str = "512,1024,2048,4096,8192"
+    kv_page_size: int = 128
+    stream_flush_ms: int = 20          # token-frame batching window
+    mesh_shape: str = ""               # e.g. "data:1,model:8"; "" → single device
+    decode_steps_per_host_sync: int = 8
+
+
+class WorkerConfig(BaseModel):
+    """reference: client/src/config/index.ts:6-148."""
+
+    worker_id: str = Field(default_factory=lambda: f"worker-{uuid.uuid4().hex[:12]}")
+    host: str = "0.0.0.0"
+    port: int = 3000
+    heartbeat_interval_ms: int = Field(5_000, gt=0)
+    resource_monitor_interval_ms: int = Field(10_000, gt=0)
+    max_reconnect_attempts: int = 10
+    max_concurrent_tasks: int = 1      # superseded by engine.max_batch_slots when engine present
+    performance_tier: str = "medium"
+
+
+class Config(BaseModel):
+    env: str = "development"
+    bus: BusConfig = Field(default_factory=BusConfig)
+    scheduler: SchedulerConfig = Field(default_factory=SchedulerConfig)
+    gateway: GatewayConfig = Field(default_factory=GatewayConfig)
+    worker: WorkerConfig = Field(default_factory=WorkerConfig)
+    engine: EngineConfig = Field(default_factory=EngineConfig)
+
+
+def load_config() -> Config:
+    """Build Config from the environment; raise on invalid values (the
+    reference fails fast at import on Joi errors, server/src/config/index.ts:45-49)."""
+    try:
+        return Config(
+            env=_env("NODE_ENV", _env("GRIDLLM_ENV", "development")),
+            bus=BusConfig(
+                url=_env("GRIDLLM_BUS_URL", ""),
+                host=_env("REDIS_HOST", "localhost"),
+                port=_env("REDIS_PORT", 6379),
+                password=os.environ.get("REDIS_PASSWORD") or None,
+                db=_env("REDIS_DB", 0),
+                key_prefix=_env("REDIS_KEY_PREFIX", "GridLLM:"),
+            ),
+            scheduler=SchedulerConfig(
+                worker_heartbeat_timeout_ms=_env("WORKER_HEARTBEAT_TIMEOUT", 15_000),
+                worker_cleanup_interval_ms=_env("WORKER_CLEANUP_INTERVAL", 5_000),
+                job_timeout_ms=_env("JOB_TIMEOUT", 600_000),
+                retry_attempts=_env("JOB_RETRY_ATTEMPTS", 3),
+                retry_delay_ms=_env("JOB_RETRY_DELAY", 5_000),
+                max_concurrent_jobs_per_worker=_env("MAX_CONCURRENT_JOBS_PER_WORKER", 1),
+                sweep_interval_ms=_env("SCHEDULER_SWEEP_INTERVAL", 1_000),
+            ),
+            gateway=GatewayConfig(
+                host=_env("HOST", "0.0.0.0"),
+                port=_env("PORT", 4000),
+                rate_limit_window_ms=_env("RATE_LIMIT_WINDOW_MS", 900_000),
+                rate_limit_max_requests=_env("RATE_LIMIT_MAX_REQUESTS", 100),
+                rate_limit_enabled=_env("RATE_LIMIT_ENABLED", True),
+            ),
+            worker=WorkerConfig(
+                worker_id=_env("WORKER_ID", f"worker-{uuid.uuid4().hex[:12]}"),
+                host=_env("WORKER_HOST", "0.0.0.0"),
+                port=_env("WORKER_PORT", 3000),
+                heartbeat_interval_ms=_env("HEARTBEAT_INTERVAL", 5_000),
+                max_reconnect_attempts=_env("MAX_RECONNECT_ATTEMPTS", 10),
+                max_concurrent_tasks=_env("MAX_CONCURRENT_TASKS", 1),
+                performance_tier=_env("PERFORMANCE_TIER", "medium"),
+            ),
+            engine=EngineConfig(
+                models=_env("GRIDLLM_MODELS", ""),
+                checkpoint_dir=_env("GRIDLLM_CHECKPOINT_DIR", ""),
+                dtype=_env("GRIDLLM_DTYPE", "bfloat16"),
+                max_seq_len=_env("GRIDLLM_MAX_SEQ_LEN", 8192),
+                max_batch_slots=_env("GRIDLLM_MAX_BATCH_SLOTS", 8),
+                kv_page_size=_env("GRIDLLM_KV_PAGE_SIZE", 128),
+                stream_flush_ms=_env("GRIDLLM_STREAM_FLUSH_MS", 20),
+                mesh_shape=_env("GRIDLLM_MESH_SHAPE", ""),
+            ),
+        )
+    except (ValidationError, ValueError) as e:  # pragma: no cover - fail fast
+        raise SystemExit(f"Invalid configuration: {e}") from e

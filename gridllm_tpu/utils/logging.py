@@ -1,0 +1,93 @@
+"""Structured logging.
+
+Reference analogue: winston logger with domain helpers ``logger.worker`` /
+``logger.job`` / ``logger.performance`` (server/src/utils/logger.ts:104-126).
+Here: stdlib logging with a structured ``extra``-style kwargs API and the same
+domain tags, JSON-ish single-line output, circular-safe serialization
+(reference: server/src/utils/logger.ts:12-36).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import sys
+import time
+from typing import Any
+
+_LEVEL = os.environ.get("GRIDLLM_LOG_LEVEL", "info").upper()
+_CONFIGURED = False
+
+
+def _safe(obj: Any, _depth: int = 0) -> Any:
+    """Best-effort JSON-serializable projection (circular/huge-safe)."""
+    if _depth > 4:
+        return "<depth>"
+    if obj is None or isinstance(obj, (bool, int, float, str)):
+        return obj
+    if isinstance(obj, dict):
+        return {str(k): _safe(v, _depth + 1) for k, v in list(obj.items())[:50]}
+    if isinstance(obj, (list, tuple)):
+        return [_safe(v, _depth + 1) for v in list(obj)[:50]]
+    if isinstance(obj, BaseException):
+        return f"{type(obj).__name__}: {obj}"
+    return repr(obj)[:200]
+
+
+class StructuredLogger:
+    """Thin wrapper: ``log.info("msg", job_id=..., worker_id=...)``."""
+
+    def __init__(self, name: str):
+        self._log = logging.getLogger(name)
+
+    def _emit(self, level: int, msg: str, kw: dict[str, Any]) -> None:
+        if kw:
+            try:
+                msg = f"{msg} {json.dumps(_safe(kw), default=str)}"
+            except Exception:
+                msg = f"{msg} <unserializable>"
+        self._log.log(level, msg)
+
+    def debug(self, msg: str, **kw: Any) -> None:
+        self._emit(logging.DEBUG, msg, kw)
+
+    def info(self, msg: str, **kw: Any) -> None:
+        self._emit(logging.INFO, msg, kw)
+
+    def warning(self, msg: str, **kw: Any) -> None:
+        self._emit(logging.WARNING, msg, kw)
+
+    def error(self, msg: str, **kw: Any) -> None:
+        self._emit(logging.ERROR, msg, kw)
+
+    # Domain helpers (reference: server/src/utils/logger.ts:114-126)
+    def worker(self, msg: str, worker_id: str, **kw: Any) -> None:
+        self._emit(logging.INFO, msg, {"type": "worker", "worker_id": worker_id, **kw})
+
+    def job(self, msg: str, job_id: str, **kw: Any) -> None:
+        self._emit(logging.INFO, msg, {"type": "job", "job_id": job_id, **kw})
+
+    def performance(self, msg: str, **kw: Any) -> None:
+        self._emit(logging.INFO, msg, {"type": "performance", **kw})
+
+
+def get_logger(name: str) -> StructuredLogger:
+    global _CONFIGURED
+    if not _CONFIGURED:
+        handler = logging.StreamHandler(sys.stderr)
+        handler.setFormatter(
+            logging.Formatter(
+                "%(asctime)s.%(msecs)03dZ %(levelname)s [%(name)s] %(message)s",
+                datefmt="%Y-%m-%dT%H:%M:%S",
+            )
+        )
+        handler.formatter.converter = time.gmtime  # type: ignore[union-attr]
+        root = logging.getLogger("gridllm_tpu")
+        root.addHandler(handler)
+        root.setLevel(getattr(logging, _LEVEL, logging.INFO))
+        root.propagate = False
+        _CONFIGURED = True
+    if not name.startswith("gridllm_tpu"):
+        name = f"gridllm_tpu.{name}"
+    return StructuredLogger(name)

@@ -1,0 +1,235 @@
+"""Shared wire types for the scheduler/bus/worker protocol.
+
+Reference analogue: server/src/types/index.ts:1-471 and
+client/src/types/index.ts:1-145. Field names here ARE the wire contract
+(JSON over the bus, and the HTTP API response surface), so they keep the
+reference's camelCase on the bus protocol and Ollama's snake_case on the
+HTTP surface. TPU additions (not in the reference, which treats workers as
+opaque Ollama hosts): per-worker accelerator topology + model shard layout,
+used for topology-aware scheduling (SURVEY.md §2.6, §7).
+"""
+
+from __future__ import annotations
+
+import time
+from enum import Enum
+from typing import Any, Literal
+
+from pydantic import BaseModel, ConfigDict, Field
+
+
+def now_ms() -> int:
+    return int(time.time() * 1000)
+
+
+def iso_now() -> str:
+    t = time.time()
+    return time.strftime("%Y-%m-%dT%H:%M:%S", time.gmtime(t)) + f".{int(t*1000)%1000:03d}Z"
+
+
+class Priority(str, Enum):
+    high = "high"
+    medium = "medium"
+    low = "low"
+
+    @property
+    def rank(self) -> int:
+        return {"high": 0, "medium": 1, "low": 2}[self.value]
+
+
+class _Model(BaseModel):
+    model_config = ConfigDict(extra="allow", populate_by_name=True)
+
+
+# ---------------------------------------------------------------------------
+# Worker capability / status records (bus hash `workers`)
+# ---------------------------------------------------------------------------
+
+class SystemResources(_Model):
+    """reference: server/src/types/index.ts:12-23 (SystemResources)."""
+
+    cpuCores: int = 0
+    totalMemoryMB: float = 0
+    availableMemoryMB: float = 0
+    cpuUsagePercent: float = 0
+    memoryUsagePercent: float = 0
+    diskSpaceGB: float = 0
+    platform: str = ""
+    architecture: str = ""
+    # TPU additions (replace the reference's gpuMemoryMB/gpuUsagePercent)
+    tpuChips: int = 0
+    hbmTotalMB: float = 0
+    hbmFreeMB: float = 0
+
+
+class TpuTopology(_Model):
+    """NEW (no reference analogue): accelerator topology of a worker group.
+
+    A multi-host TPU slice registers as ONE logical worker; the scheduler
+    routes by shard layout + topology (SURVEY.md §2.6 'TPU-native equivalent').
+    """
+
+    platform: str = "cpu"            # "tpu" | "cpu" | "gpu"
+    numDevices: int = 1              # devices visible to this logical worker
+    numHosts: int = 1
+    meshShape: dict[str, int] = Field(default_factory=dict)  # e.g. {"data":1,"model":8}
+    deviceKind: str = ""             # e.g. "TPU v5e"
+    iciBandwidthGBps: float = 0.0
+
+
+class ModelShardLayout(_Model):
+    """NEW: how a served model is laid out on the worker's mesh."""
+
+    name: str
+    strategy: str = "replicated"     # replicated | tensor | expert | pipeline | hybrid
+    meshAxes: dict[str, int] = Field(default_factory=dict)
+    dtype: str = "bfloat16"
+    maxSeqLen: int = 8192
+    maxBatchSlots: int = 8
+
+
+class ModelInfo(_Model):
+    """Ollama-style model record (reference: OllamaModel, types/index.ts:25-38)."""
+
+    name: str
+    model: str | None = None
+    size: int = 0
+    digest: str = ""
+    modified_at: str = ""
+    details: dict[str, Any] | None = None
+
+
+class NodeCapabilities(_Model):
+    """reference: server/src/types/index.ts:2-10 (NodeCapabilities)."""
+
+    workerId: str
+    availableModels: list[ModelInfo] = Field(default_factory=list)
+    systemResources: SystemResources | None = None
+    performanceTier: Literal["high", "medium", "low"] = "medium"
+    maxConcurrentTasks: int = 1
+    supportedFormats: list[str] = Field(default_factory=lambda: ["json"])
+    lastUpdated: str = Field(default_factory=iso_now)
+    # TPU additions
+    topology: TpuTopology | None = None
+    shardLayouts: list[ModelShardLayout] = Field(default_factory=list)
+
+
+class WorkerInfo(_Model):
+    """reference: server/src/types/index.ts:41-50 (WorkerInfo)."""
+
+    workerId: str
+    capabilities: NodeCapabilities
+    status: Literal["online", "offline", "busy", "error"] = "online"
+    currentJobs: int = 0
+    lastHeartbeat: float = Field(default_factory=time.time)
+    registeredAt: float = Field(default_factory=time.time)
+    totalJobsProcessed: int = 0
+    connectionHealth: Literal["healthy", "degraded", "unhealthy"] = "healthy"
+
+    def model_names(self) -> list[str]:
+        return [m.name for m in self.capabilities.availableModels]
+
+
+# ---------------------------------------------------------------------------
+# Jobs
+# ---------------------------------------------------------------------------
+
+class InferenceRequest(_Model):
+    """reference: server/src/types/index.ts:64-93 (InferenceRequest).
+
+    One job as it travels gateway → scheduler → bus → worker. `metadata`
+    carries the orphan/retry audit trail exactly as the reference does
+    (retryCount / orphaned / originalWorkerId / orphanedAt / requeueCount /
+    requestType), because the failure machinery keys off it.
+    """
+
+    id: str
+    model: str
+    prompt: str | None = None
+    stream: bool | None = None
+    # chat path: structured messages survive end-to-end (fixes reference
+    # defect SURVEY.md §2.8: /ollama/api/chat flattened messages to a prompt)
+    messages: list[dict[str, Any]] | None = None
+    tools: list[dict[str, Any]] | None = None
+    format: str | dict[str, Any] | None = None
+    # embedding path
+    input: str | list[str] | None = None
+    truncate: bool | None = None
+    # common
+    options: dict[str, Any] = Field(default_factory=dict)
+    priority: Priority = Priority.medium
+    timeout: int = 300_000  # ms
+    metadata: dict[str, Any] = Field(default_factory=dict)
+
+    @property
+    def request_type(self) -> str:
+        return self.metadata.get("requestType", "inference")
+
+
+class JobAssignment(_Model):
+    """reference: server/src/types/index.ts:149-155 (JobAssignment)."""
+
+    jobId: str
+    workerId: str
+    request: InferenceRequest
+    assignedAt: float = Field(default_factory=time.time)
+    timeout: int = 300_000  # ms
+
+
+class InferenceResponse(_Model):
+    """reference: server/src/types/index.ts:117-138 (InferenceResponse).
+
+    Ollama-native response shape. Unlike the reference — which zeroes timing
+    fields on its OpenAI-facade path (SURVEY.md §2.8) — the TPU engine
+    measures real durations (nanoseconds, Ollama convention).
+    """
+
+    id: str
+    model: str | None = None
+    created_at: str | None = None
+    response: str | None = None
+    thinking: str | None = None
+    message: dict[str, Any] | None = None  # chat responses
+    done: bool = True
+    done_reason: str | None = None
+    context: list[int] | None = None
+    embeddings: list[list[float]] | None = None
+    embedding: list[float] | None = None
+    total_duration: int | None = None
+    load_duration: int | None = None
+    prompt_eval_count: int | None = None
+    prompt_eval_duration: int | None = None
+    eval_count: int | None = None
+    eval_duration: int | None = None
+    system_fingerprint: str | None = None
+
+
+class StreamChunk(_Model):
+    """One streamed token frame on `job:stream:{id}`.
+
+    reference: client/src/types/index.ts:70-74 (StreamResponse). TPU change:
+    a frame may carry MULTIPLE tokens (`response` is the concatenated text)
+    — the reference crossed Redis once per token (SURVEY.md §6), we batch.
+    """
+
+    id: str
+    model: str | None = None
+    created_at: str | None = None
+    response: str = ""
+    thinking: str | None = None
+    message: dict[str, Any] | None = None
+    done: bool = False
+    done_reason: str | None = None
+    eval_count: int | None = None
+
+
+class JobResult(_Model):
+    """Payload on `job:result:{id}` / `job:completed` / `job:failed`."""
+
+    jobId: str
+    workerId: str
+    success: bool
+    response: InferenceResponse | None = None
+    error: str | None = None
+    completedAt: float = Field(default_factory=time.time)
+    processingTimeMs: float = 0

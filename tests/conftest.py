@@ -1,0 +1,43 @@
+"""Test harness config.
+
+Per SURVEY.md §4: scheduler/gateway tests run against the in-memory fake bus
+and fake workers (no TPU, no model); parallelism tests run on a virtual
+8-device CPU mesh. The env vars below MUST be set before jax is imported
+anywhere in the test process.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+# Keep test compiles fast & deterministic
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import asyncio  # noqa: E402
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def event_loop_policy():
+    return asyncio.DefaultEventLoopPolicy()
+
+
+def pytest_collection_modifyitems(config, items):
+    # Auto-mark async tests to run under asyncio via our simple runner.
+    pass
+
+
+# Minimal asyncio test support without pytest-asyncio: run `async def` tests.
+def pytest_pyfunc_call(pyfuncitem):
+    import inspect
+
+    fn = pyfuncitem.obj
+    if inspect.iscoroutinefunction(fn):
+        sig = inspect.signature(fn)
+        kwargs = {k: v for k, v in pyfuncitem.funcargs.items() if k in sig.parameters}
+        asyncio.run(fn(**kwargs))
+        return True
+    return None

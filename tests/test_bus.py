@@ -1,0 +1,135 @@
+"""Bus contract tests (in-memory implementation).
+
+Covers the §2.6 protocol primitives the scheduler/worker rely on: KV with
+TTL (heartbeat keys), hashes (`workers`, `active_jobs`), pub/sub channels,
+pattern subscribe, and the subscribe-handle unsubscribe semantics that fix
+the reference's listener leak (SURVEY.md §2.8).
+"""
+
+import asyncio
+
+import pytest
+
+from gridllm_tpu.bus import InMemoryBus
+
+
+@pytest.fixture
+def bus():
+    b = InMemoryBus(key_prefix="T:")
+    asyncio.run(b.connect())
+    return b
+
+
+async def test_kv_roundtrip(bus):
+    await bus.set("k", "v")
+    assert await bus.get("k") == "v"
+    # prefix applied internally
+    assert bus._kv.get("T:k") == "v"
+    await bus.delete("k")
+    assert await bus.get("k") is None
+
+
+async def test_ttl_semantics(bus):
+    assert await bus.ttl("missing") == -2
+    await bus.set("plain", "x")
+    assert await bus.ttl("plain") == -1
+    await bus.set_with_expiry("hb", "alive", ttl_s=5)
+    assert 0 <= await bus.ttl("hb") <= 5
+    await bus.set_with_expiry("gone", "x", ttl_s=0.01)
+    await asyncio.sleep(0.02)
+    assert await bus.get("gone") is None
+    assert await bus.ttl("gone") == -2
+
+
+async def test_hash_ops(bus):
+    await bus.hset("workers", "w1", "{}")
+    await bus.hset("workers", "w2", "{...}")
+    assert await bus.hget("workers", "w1") == "{}"
+    assert set((await bus.hgetall("workers")).keys()) == {"w1", "w2"}
+    await bus.hdel("workers", "w1")
+    assert await bus.hget("workers", "w1") is None
+
+
+async def test_pubsub_and_unsubscribe(bus):
+    got: list[tuple[str, str]] = []
+
+    async def handler(ch, msg):
+        got.append((ch, msg))
+
+    sub = await bus.subscribe("job:completed", handler)
+    n = await bus.publish("job:completed", "a")
+    await bus.flush()
+    assert n == 1 and got == [("job:completed", "a")]
+
+    # unsubscribe removes exactly this handler (no listener leak)
+    await sub.unsubscribe()
+    await bus.publish("job:completed", "b")
+    await bus.flush()
+    assert got == [("job:completed", "a")]
+
+
+async def test_two_handlers_same_channel(bus):
+    got1, got2 = [], []
+
+    async def h1(ch, m):
+        got1.append(m)
+
+    async def h2(ch, m):
+        got2.append(m)
+
+    s1 = await bus.subscribe("c", h1)
+    await bus.subscribe("c", h2)
+    await bus.publish("c", "x")
+    await bus.flush()
+    assert got1 == ["x"] and got2 == ["x"]
+    await s1.unsubscribe()
+    await bus.publish("c", "y")
+    await bus.flush()
+    assert got1 == ["x"] and got2 == ["x", "y"]
+
+
+async def test_psubscribe(bus):
+    got = []
+
+    async def handler(ch, m):
+        got.append((ch, m))
+
+    sub = await bus.psubscribe("worker:*:job", handler)
+    await bus.publish("worker:w1:job", "assign")
+    await bus.publish("other:w1:job", "no")
+    await bus.flush()
+    assert got == [("worker:w1:job", "assign")]
+    await sub.unsubscribe()
+
+
+async def test_handler_error_does_not_break_bus(bus):
+    ok = []
+
+    async def bad(ch, m):
+        raise RuntimeError("boom")
+
+    async def good(ch, m):
+        ok.append(m)
+
+    await bus.subscribe("c", bad)
+    await bus.subscribe("c", good)
+    await bus.publish("c", "m")
+    await bus.flush()
+    assert ok == ["m"]
+
+
+async def test_per_subscriber_ordering(bus):
+    """A slow handler must still see frames in publish order (token streams)."""
+    import random
+
+    got = []
+
+    async def slow(ch, m):
+        await asyncio.sleep(random.uniform(0, 0.003))
+        got.append(m)
+
+    await bus.subscribe("job:stream:x", slow)
+    for i in range(20):
+        await bus.publish("job:stream:x", str(i))
+    await bus.flush()
+    assert got == [str(i) for i in range(20)]

@@ -1,0 +1,129 @@
+"""RespBus ↔ gridbus broker wire tests: the same contract as test_bus.py,
+exercised over a real TCP socket speaking RESP2."""
+
+import asyncio
+
+from gridllm_tpu.bus.broker import GridBusBroker
+from gridllm_tpu.bus.resp import RespBus
+
+
+async def _make():
+    broker = GridBusBroker()
+    await broker.start("127.0.0.1", 0)
+    bus = RespBus(host="127.0.0.1", port=broker.port, key_prefix="T:")
+    await bus.connect()
+    return broker, bus
+
+
+async def _teardown(broker, *buses):
+    for b in buses:
+        await b.disconnect()
+    await broker.stop()
+
+
+async def test_wire_kv_hash_ttl():
+    broker, bus = await _make()
+    try:
+        assert await bus.is_healthy()
+        await bus.set("k", "v")
+        assert await bus.get("k") == "v"
+        assert await bus.ttl("k") == -1
+        await bus.set_with_expiry("hb", "1", ttl_s=10)
+        assert 0 <= await bus.ttl("hb") <= 10
+        assert await bus.ttl("nope") == -2
+        await bus.hset("workers", "w1", '{"a":1}')
+        assert await bus.hget("workers", "w1") == '{"a":1}'
+        assert await bus.hgetall("workers") == {"w1": '{"a":1}'}
+        await bus.hdel("workers", "w1")
+        assert await bus.hgetall("workers") == {}
+        await bus.delete("k")
+        assert await bus.get("k") is None
+    finally:
+        await _teardown(broker, bus)
+
+
+async def test_wire_pubsub_between_two_clients():
+    broker, server_bus = await _make()
+    worker_bus = RespBus(host="127.0.0.1", port=broker.port, key_prefix="T:")
+    await worker_bus.connect()
+    try:
+        got = []
+        done = asyncio.Event()
+
+        async def on_msg(ch, m):
+            got.append((ch, m))
+            done.set()
+
+        sub = await server_bus.subscribe("worker:registered", on_msg)
+        await asyncio.sleep(0.05)  # let SUBSCRIBE reach the broker
+        n = await worker_bus.publish("worker:registered", '{"workerId":"w1"}')
+        await asyncio.wait_for(done.wait(), 2)
+        assert n == 1
+        assert got == [("worker:registered", '{"workerId":"w1"}')]
+
+        await sub.unsubscribe()
+        await asyncio.sleep(0.05)
+        assert await worker_bus.publish("worker:registered", "x") == 0
+    finally:
+        await _teardown(broker, server_bus, worker_bus)
+
+
+async def test_wire_psubscribe():
+    broker, bus = await _make()
+    pub = RespBus(host="127.0.0.1", port=broker.port, key_prefix="T:")
+    await pub.connect()
+    try:
+        got = []
+        done = asyncio.Event()
+
+        async def on_msg(ch, m):
+            got.append((ch, m))
+            done.set()
+
+        await bus.psubscribe("job:stream:*", on_msg)
+        await asyncio.sleep(0.05)
+        await pub.publish("job:stream:abc", "tok")
+        await asyncio.wait_for(done.wait(), 2)
+        assert got == [("job:stream:abc", "tok")]
+    finally:
+        await _teardown(broker, bus, pub)
+
+
+async def test_wire_main_conn_survives_broker_restart():
+    """KV/publish must recover after the broker restarts (lazy reconnect)."""
+    broker, bus = await _make()
+    port = broker.port
+    await bus.set("k", "v1")
+    await broker.stop()
+    broker2 = GridBusBroker()
+    await broker2.start("127.0.0.1", port)
+    try:
+        await bus.set("k2", "v2")  # lazy reconnect inside command()
+        assert await bus.get("k2") == "v2"
+        assert await bus.is_healthy()
+    finally:
+        await _teardown(broker2, bus)
+
+
+async def test_wire_ordering():
+    broker, bus = await _make()
+    pub = RespBus(host="127.0.0.1", port=broker.port, key_prefix="T:")
+    await pub.connect()
+    try:
+        got = []
+        done = asyncio.Event()
+
+        async def h(ch, m):
+            await asyncio.sleep(0.001)
+            got.append(m)
+            if len(got) == 10:
+                done.set()
+
+        await bus.subscribe("s", h)
+        await asyncio.sleep(0.05)
+        for i in range(10):
+            await pub.publish("s", str(i))
+        await asyncio.wait_for(done.wait(), 3)
+        assert got == [str(i) for i in range(10)]
+    finally:
+        await _teardown(broker, bus, pub)
