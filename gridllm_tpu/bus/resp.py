@@ -156,9 +156,20 @@ class RespBus(MessageBus):
 
     # -- lifecycle ----------------------------------------------------------
     async def connect(self) -> None:
+        """Connect all three links; brief retry so a worker starting alongside
+        the broker (compose-style bring-up) doesn't die on the race."""
         self._closed = False
         for conn in (self._main, self._pub, self._sub):
-            await conn.connect()
+            delay = 0.3
+            for attempt in range(5):
+                try:
+                    await conn.connect()
+                    break
+                except OSError:
+                    if attempt == 4:
+                        raise
+                    await asyncio.sleep(delay)
+                    delay = min(delay * 2, 3.0)
         self._reader_task = asyncio.create_task(self._sub_reader_loop())
         # Re-establish any subscriptions that predate a reconnect
         # (pump owns the read side now → write-only)

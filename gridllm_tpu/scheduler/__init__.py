@@ -1,0 +1,4 @@
+from gridllm_tpu.scheduler.registry import WorkerRegistry
+from gridllm_tpu.scheduler.scheduler import JobScheduler
+
+__all__ = ["WorkerRegistry", "JobScheduler"]

@@ -1,0 +1,307 @@
+"""Worker registry: the server-side worker table + liveness machinery.
+
+Reference analogue: server/src/services/WorkerRegistry.ts (516 LoC). Same
+behavioral surface:
+
+- in-memory table mirrored to the bus hash ``workers`` (crash-reload on boot,
+  WorkerRegistry.ts:76-110)
+- subscribes ``worker:registered/unregistered/heartbeat/status_update/
+  disconnected`` (WorkerRegistry.ts:17-55)
+- three liveness mechanisms (SURVEY.md §3.5): cleanup sweep on heartbeat
+  staleness (:182-219), connection monitor with a quick-disconnect window
+  probing the worker's ``heartbeat:{id}`` TTL key (:125-180), and the
+  fast-path ``worker:disconnected`` publish from the worker's own socket-close
+  handler (:352-369)
+- unknown-heartbeat healing: reload from bus or request re-registration via
+  ``worker:reregister:{id}`` (:261-323, :496-515)
+- model→worker queries and job-count/status accounting (:383-494)
+
+Events emitted: ``worker_registered``, ``worker_removed``, ``worker_heartbeat``,
+``worker_status_changed`` (WorkerRegistry.ts:244,378,275,342).
+
+TPU extension: capability records may carry ``topology`` and ``shardLayouts``
+(utils/types.py) — a multi-host TPU slice registers as ONE logical worker.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+
+from gridllm_tpu.bus.base import MessageBus, Subscription
+from gridllm_tpu.utils.config import SchedulerConfig
+from gridllm_tpu.utils.events import EventEmitter
+from gridllm_tpu.utils.logging import get_logger
+from gridllm_tpu.utils.types import WorkerInfo
+
+log = get_logger("scheduler.registry")
+
+WORKERS_KEY = "workers"
+
+
+class WorkerRegistry(EventEmitter):
+    def __init__(self, bus: MessageBus, config: SchedulerConfig | None = None):
+        super().__init__()
+        self.bus = bus
+        self.config = config or SchedulerConfig()
+        self.workers: dict[str, WorkerInfo] = {}
+        self._subs: list[Subscription] = []
+        self._tasks: list[asyncio.Task] = []
+        self._running = False
+
+    # -- lifecycle ----------------------------------------------------------
+    async def initialize(self) -> None:
+        self._running = True
+        for channel, handler in [
+            ("worker:registered", self._on_registered),
+            ("worker:unregistered", self._on_unregistered),
+            ("worker:heartbeat", self._on_heartbeat),
+            ("worker:status_update", self._on_status_update),
+            ("worker:disconnected", self._on_disconnected),
+        ]:
+            self._subs.append(await self.bus.subscribe(channel, handler))
+        await self._load_existing_workers()
+        self._tasks.append(asyncio.create_task(self._cleanup_loop()))
+        self._tasks.append(asyncio.create_task(self._connection_monitor_loop()))
+        log.info("worker registry initialized", workers=len(self.workers))
+
+    async def shutdown(self) -> None:
+        self._running = False
+        for t in self._tasks:
+            t.cancel()
+        self._tasks.clear()
+        for s in self._subs:
+            await s.unsubscribe()
+        self._subs.clear()
+
+    async def _load_existing_workers(self) -> None:
+        """Crash recovery: reload the `workers` hash, dropping stale entries
+        (reference: WorkerRegistry.ts:76-110)."""
+        stored = await self.bus.hgetall(WORKERS_KEY)
+        timeout_s = self.config.worker_heartbeat_timeout_ms / 1000
+        for worker_id, raw in stored.items():
+            try:
+                info = WorkerInfo.model_validate_json(raw)
+            except Exception:
+                await self.bus.hdel(WORKERS_KEY, worker_id)
+                continue
+            if time.time() - info.lastHeartbeat > timeout_s:
+                log.worker("dropping stale worker on reload", worker_id)
+                await self.bus.hdel(WORKERS_KEY, worker_id)
+                continue
+            self.workers[worker_id] = info
+
+    # -- bus handlers -------------------------------------------------------
+    async def _on_registered(self, _ch: str, raw: str) -> None:
+        try:
+            info = WorkerInfo.model_validate_json(raw)
+        except Exception as e:
+            log.error("bad registration payload", error=str(e))
+            return
+        is_new = info.workerId not in self.workers
+        info.lastHeartbeat = time.time()
+        self.workers[info.workerId] = info
+        await self.bus.hset(WORKERS_KEY, info.workerId, info.model_dump_json())
+        log.worker("worker registered", info.workerId,
+                   models=info.model_names(), new=is_new)
+        self.emit("worker_registered", info)
+
+    async def _on_unregistered(self, _ch: str, raw: str) -> None:
+        try:
+            worker_id = json.loads(raw).get("workerId", raw)
+        except Exception:
+            worker_id = raw
+        await self.remove_worker(worker_id, reason="unregistered")
+
+    async def _on_heartbeat(self, _ch: str, raw: str) -> None:
+        """reference: WorkerRegistry.ts:261-323 — includes the unknown-worker
+        healing path (reload from bus, else request re-registration)."""
+        try:
+            data = json.loads(raw)
+            worker_id = data["workerId"]
+        except Exception:
+            return
+        info = self.workers.get(worker_id)
+        if info is None:
+            stored = await self.bus.hget(WORKERS_KEY, worker_id)
+            if stored:
+                try:
+                    info = WorkerInfo.model_validate_json(stored)
+                    self.workers[worker_id] = info
+                    log.worker("worker reloaded from bus on heartbeat", worker_id)
+                except Exception:
+                    info = None
+            if info is None:
+                await self.request_worker_reregistration(worker_id)
+                return
+        info.lastHeartbeat = time.time()
+        # Divergence from reference (which copied status/currentJobs from the
+        # heartbeat): job accounting is registry-authoritative, driven by
+        # mark_worker_busy/available on the job lifecycle. A heartbeat emitted
+        # just before an assignment landed would otherwise erase the busy
+        # mark and allow over-assignment past maxConcurrentTasks. Heartbeats
+        # only refresh liveness and surface error states.
+        if data.get("status") == "error":
+            info.status = "error"
+        # Persist so a restarted server doesn't see a stale lastHeartbeat and
+        # evict live workers (reference hsets every beat too).
+        await self.bus.hset(WORKERS_KEY, worker_id, info.model_dump_json())
+        self.emit("worker_heartbeat", worker_id, data)
+
+    async def _on_status_update(self, _ch: str, raw: str) -> None:
+        try:
+            data = json.loads(raw)
+            worker_id = data["workerId"]
+        except Exception:
+            return
+        info = self.workers.get(worker_id)
+        if info is None:
+            return
+        old = info.status
+        info.status = data.get("status", info.status)
+        info.currentJobs = int(data.get("currentJobs", info.currentJobs))
+        if "capabilities" in data:
+            try:
+                info.capabilities = info.capabilities.model_validate(data["capabilities"])
+            except Exception:
+                pass
+        info.lastHeartbeat = time.time()
+        await self.bus.hset(WORKERS_KEY, worker_id, info.model_dump_json())
+        if old != info.status:
+            self.emit("worker_status_changed", worker_id, old, info.status)
+
+    async def _on_disconnected(self, _ch: str, raw: str) -> None:
+        """Fast eviction path: the worker's own socket-close handler publishes
+        this best-effort (reference: RedisConnectionManager.ts:158-179)."""
+        try:
+            worker_id = json.loads(raw).get("workerId", raw)
+        except Exception:
+            worker_id = raw
+        await self.remove_worker(worker_id, reason="disconnected")
+
+    # -- liveness loops -----------------------------------------------------
+    async def _cleanup_loop(self) -> None:
+        """Sweep workers whose lastHeartbeat exceeds the timeout
+        (reference: WorkerRegistry.ts:112-123, 182-219)."""
+        interval = self.config.worker_cleanup_interval_ms / 1000
+        timeout_s = self.config.worker_heartbeat_timeout_ms / 1000
+        while self._running:
+            await asyncio.sleep(interval)
+            now = time.time()
+            for worker_id, info in list(self.workers.items()):
+                if now - info.lastHeartbeat > timeout_s:
+                    log.worker("worker heartbeat timed out", worker_id,
+                               silent_s=round(now - info.lastHeartbeat, 1))
+                    await self.remove_worker(worker_id, reason="heartbeat_timeout")
+
+    async def _connection_monitor_loop(self) -> None:
+        """Quick-disconnect detection: any worker silent beyond the
+        quick-disconnect window gets its `heartbeat:{id}` TTL key probed; a
+        missing key means abrupt death (reference: WorkerRegistry.ts:125-180)."""
+        interval = self.config.connection_monitor_interval_ms / 1000
+        window_s = self.config.quick_disconnect_window_ms / 1000
+        while self._running:
+            await asyncio.sleep(interval)
+            now = time.time()
+            for worker_id, info in list(self.workers.items()):
+                if now - info.lastHeartbeat <= window_s:
+                    continue
+                ttl = await self.bus.ttl(f"heartbeat:{worker_id}")
+                if ttl == -2:  # key expired/missing → worker died abruptly
+                    log.worker("worker aliveness probe failed", worker_id)
+                    await self.remove_worker(worker_id, reason="aliveness_probe")
+
+    # -- mutation -----------------------------------------------------------
+    async def remove_worker(self, worker_id: str, reason: str = "") -> None:
+        info = self.workers.pop(worker_id, None)
+        await self.bus.hdel(WORKERS_KEY, worker_id)
+        if info is not None:
+            log.worker("worker removed", worker_id, reason=reason)
+            self.emit("worker_removed", worker_id, info, reason)
+
+    async def request_worker_reregistration(self, worker_id: str) -> None:
+        """reference: WorkerRegistry.ts:496-515."""
+        log.worker("requesting re-registration", worker_id)
+        await self.bus.publish(
+            f"worker:reregister:{worker_id}",
+            json.dumps({"type": "reregistration_request", "timestamp": time.time()}),
+        )
+
+    async def update_worker_job_count(self, worker_id: str, delta: int) -> None:
+        """Busy/online transitions against maxConcurrentTasks
+        (reference: WorkerRegistry.ts:421-454)."""
+        info = self.workers.get(worker_id)
+        if info is None:
+            return
+        info.currentJobs = max(0, info.currentJobs + delta)
+        if delta < 0:  # job finished (reference: WorkerRegistry.ts:441-443)
+            info.totalJobsProcessed += 1
+        old = info.status
+        # Divergence from reference (which used the server-wide
+        # maxConcurrentJobsPerWorker config): the worker's own advertised
+        # capacity governs, so TPU workers with continuous batching can take
+        # maxBatchSlots concurrent jobs.
+        cap = max(info.capabilities.maxConcurrentTasks, 1)
+        if info.currentJobs >= cap:
+            info.status = "busy"
+        elif info.currentJobs < cap and info.status == "busy":
+            info.status = "online"
+        await self.bus.hset(WORKERS_KEY, worker_id, info.model_dump_json())
+        if old != info.status:
+            self.emit("worker_status_changed", worker_id, old, info.status)
+
+    async def mark_worker_busy(self, worker_id: str) -> None:
+        await self.update_worker_job_count(worker_id, +1)
+
+    async def mark_worker_available(self, worker_id: str) -> None:
+        await self.update_worker_job_count(worker_id, -1)
+
+    # -- queries ------------------------------------------------------------
+    def get_worker(self, worker_id: str) -> WorkerInfo | None:
+        return self.workers.get(worker_id)
+
+    def get_all_workers(self) -> list[WorkerInfo]:
+        return list(self.workers.values())
+
+    def get_online_workers(self) -> list[WorkerInfo]:
+        return [w for w in self.workers.values() if w.status in ("online", "busy")]
+
+    def get_available_workers(self) -> list[WorkerInfo]:
+        return [
+            w for w in self.workers.values()
+            if w.status == "online"
+            and w.currentJobs < max(w.capabilities.maxConcurrentTasks, 1)
+        ]
+
+    def get_available_workers_by_model(self, model: str) -> list[WorkerInfo]:
+        """reference: WorkerRegistry.ts:413."""
+        return [w for w in self.get_available_workers() if model in w.model_names()]
+
+    def get_workers_with_model(self, model: str) -> list[WorkerInfo]:
+        return [w for w in self.get_online_workers() if model in w.model_names()]
+
+    def get_all_available_models(self) -> list[dict]:
+        """Aggregate model records across workers, annotated with
+        num_workers_with_model (reference: WorkerRegistry.ts:484-494 +
+        ollama.ts:507-571 gridllm_metadata)."""
+        by_name: dict[str, dict] = {}
+        for w in self.get_online_workers():
+            for m in w.capabilities.availableModels:
+                entry = by_name.setdefault(m.name, {**m.model_dump(exclude_none=True), "_workers": 0})
+                entry["_workers"] += 1
+        out = []
+        for entry in by_name.values():
+            n = entry.pop("_workers")
+            entry["gridllm_metadata"] = {"num_workers_with_model": n}
+            out.append(entry)
+        return out
+
+    def get_worker_count(self) -> dict[str, int]:
+        all_w = list(self.workers.values())
+        return {
+            "total": len(all_w),
+            "online": sum(1 for w in all_w if w.status == "online"),
+            "busy": sum(1 for w in all_w if w.status == "busy"),
+            "offline": sum(1 for w in all_w if w.status == "offline"),
+        }

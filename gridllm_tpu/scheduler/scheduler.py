@@ -1,0 +1,553 @@
+"""Job scheduler: priority queue, worker selection, failure machinery.
+
+Reference analogue: server/src/services/JobScheduler.ts (909 LoC). Behavioral
+surface preserved:
+
+- priority queue (high > medium > low, FIFO within a class,
+  JobScheduler.ts:144-151) mirrored to the bus for crash recovery
+- least-loaded worker selection with performance-tier tiebreak (:317-360)
+- assignment via ``worker:{id}:job`` publish with a staleness re-check
+  (:362-432); per-job timeout; cancellation via the same channel (:530-536)
+- orphan machinery: assignments older than the threshold whose worker is
+  gone/silent are promoted to high priority and requeued at the FRONT with
+  audit metadata (orphaned/originalWorkerId/orphanedAt/requeueCount,
+  :219-315); worker disconnection requeues all its active jobs (:553-630)
+- failed jobs retried ≤ retry_attempts with retry_delay (:463-514)
+- ``submit_and_wait`` / ``submit_streaming_job`` / ``cancel_job`` (:666-856)
+
+Deliberate divergences (fix-by-design, SURVEY.md §2.8 + BASELINE.md):
+- event-driven dispatch instead of the 1 s polling tick — a queued job is
+  dispatched the moment it's added or a worker frees up; the sweep loop
+  remains only as the orphan/retry safety net
+- per-job timeout timers are cancelled on completion (the reference leaked
+  a live setTimeout per job)
+- the queue persists as a bus hash entry per job (jobId → record with a
+  sequence number), not one O(queue²) JSON blob
+- on worker failure with retries remaining, the waiter on ``job:result:{id}``
+  is NOT failed — the retry is transparent; only the final failure is
+  delivered (the reference rejected the waiter on first failure yet retried
+  anyway in the background)
+
+Events: job_queued/assigned/completed/failed/timeout/orphaned
+(reference wiring: server/src/index.ts:140-191).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+from typing import Any, Awaitable, Callable
+
+from gridllm_tpu.bus.base import MessageBus, Subscription
+from gridllm_tpu.scheduler.registry import WorkerRegistry
+from gridllm_tpu.utils.config import SchedulerConfig
+from gridllm_tpu.utils.events import EventEmitter
+from gridllm_tpu.utils.logging import get_logger
+from gridllm_tpu.utils.types import (
+    InferenceRequest,
+    JobAssignment,
+    JobResult,
+    Priority,
+    StreamChunk,
+    WorkerInfo,
+)
+
+log = get_logger("scheduler.jobs")
+
+ACTIVE_JOBS_KEY = "active_jobs"
+JOB_QUEUE_KEY = "job_queue"
+
+_TIER_RANK = {"high": 0, "medium": 1, "low": 2}
+
+
+class JobTimeoutError(TimeoutError):
+    pass
+
+
+class JobCancelledError(RuntimeError):
+    pass
+
+
+class _QueuedJob:
+    __slots__ = ("request", "seq", "enqueued_at")
+
+    def __init__(self, request: InferenceRequest, seq: int):
+        self.request = request
+        self.seq = seq
+        self.enqueued_at = time.time()
+
+    def sort_key(self) -> tuple[int, int]:
+        return (self.request.priority.rank, self.seq)
+
+
+class JobScheduler(EventEmitter):
+    def __init__(self, bus: MessageBus, registry: WorkerRegistry,
+                 config: SchedulerConfig | None = None):
+        super().__init__()
+        self.bus = bus
+        self.registry = registry
+        self.config = config or SchedulerConfig()
+        self.job_queue: list[_QueuedJob] = []
+        self.active_jobs: dict[str, JobAssignment] = {}
+        self._timeout_handles: dict[str, asyncio.TimerHandle] = {}
+        self._retry_handles: dict[str, asyncio.TimerHandle] = {}
+        self._seq = 0           # back-of-queue counter (grows)
+        self._front_seq = 0     # front-of-queue counter (shrinks; orphans)
+        self._subs: list[Subscription] = []
+        self._sweep_task: asyncio.Task | None = None
+        self._dispatch_scheduled = False
+        self._dispatch_lock = asyncio.Lock()
+        self._no_owner_warned: dict[str, float] = {}  # model → last warn time
+        self._cancelled: dict[str, float] = {}        # jobId → cancel time
+        self._running = False
+        self.total_completed = 0
+        self.total_failed = 0
+
+    # -- lifecycle ----------------------------------------------------------
+    async def initialize(self) -> None:
+        self._running = True
+        for channel, handler in [
+            ("job:completed", self._on_job_completed),
+            ("job:failed", self._on_job_failed),
+            ("job:timeout", self._on_job_timeout_report),
+        ]:
+            self._subs.append(await self.bus.subscribe(channel, handler))
+        await self._load_existing_jobs()
+        self._sweep_task = asyncio.create_task(self._sweep_loop())
+        # new capacity → dispatch; lost worker → requeue its jobs
+        self.registry.on("worker_registered", lambda *_: self.request_dispatch())
+        self.registry.on("worker_status_changed", lambda *_: self.request_dispatch())
+        self.registry.on("worker_removed", self._on_worker_removed)
+        log.info("job scheduler initialized",
+                 queued=len(self.job_queue), active=len(self.active_jobs))
+
+    async def shutdown(self) -> None:
+        self._running = False
+        if self._sweep_task:
+            self._sweep_task.cancel()
+            self._sweep_task = None
+        for h in (*self._timeout_handles.values(), *self._retry_handles.values()):
+            h.cancel()
+        self._timeout_handles.clear()
+        self._retry_handles.clear()
+        for s in self._subs:
+            await s.unsubscribe()
+        self._subs.clear()
+
+    async def _load_existing_jobs(self) -> None:
+        """Crash recovery from the bus (reference: JobScheduler.ts:82-126).
+        Queued jobs reload in sequence order; active jobs whose assignment
+        outlived the server restart are orphan-requeued immediately."""
+        stored_queue = await self.bus.hgetall(JOB_QUEUE_KEY)
+        entries = []
+        for job_id, raw in stored_queue.items():
+            try:
+                rec = json.loads(raw)
+                req = InferenceRequest.model_validate(rec["request"])
+                entries.append(_QueuedJob(req, int(rec.get("seq", 0))))
+            except Exception:
+                await self.bus.hdel(JOB_QUEUE_KEY, job_id)
+        entries.sort(key=_QueuedJob.sort_key)
+        self.job_queue = entries
+        if entries:
+            self._seq = max(0, max(e.seq for e in entries)) + 1
+            self._front_seq = min(0, min(e.seq for e in entries))
+
+        stored_active = await self.bus.hgetall(ACTIVE_JOBS_KEY)
+        for job_id, raw in stored_active.items():
+            try:
+                assignment = JobAssignment.model_validate_json(raw)
+            except Exception:
+                await self.bus.hdel(ACTIVE_JOBS_KEY, job_id)
+                continue
+            age_ms = (time.time() - assignment.assignedAt) * 1000
+            if age_ms > assignment.timeout:
+                await self.bus.hdel(ACTIVE_JOBS_KEY, job_id)
+                continue
+            self.active_jobs[job_id] = assignment
+            self._arm_timeout(assignment, remaining_ms=assignment.timeout - age_ms)
+
+    # -- public API ---------------------------------------------------------
+    async def add_job(self, request: InferenceRequest) -> str:
+        """Queue a job and trigger dispatch (reference: JobScheduler.ts:651-664)."""
+        qj = _QueuedJob(request, self._seq)
+        self._seq += 1
+        self.job_queue.append(qj)
+        await self._persist_queued(qj)
+        log.job("job queued", request.id, model=request.model,
+                priority=request.priority.value)
+        self.emit("job_queued", request)
+        self.request_dispatch()
+        return request.id
+
+    async def _submit_and_await(self, request: InferenceRequest,
+                                timeout_ms: int | None,
+                                extra_subs: list[tuple[str, Any]] | None = None) -> JobResult:
+        """Shared body of the synchronous submit APIs: subscribe the per-job
+        result channel (plus any extras), queue, await with timeout+cancel."""
+        timeout_ms = timeout_ms or request.timeout or self.config.job_timeout_ms
+        loop = asyncio.get_running_loop()
+        future: asyncio.Future[JobResult] = loop.create_future()
+
+        async def on_result(_ch: str, raw: str) -> None:
+            if not future.done():
+                try:
+                    future.set_result(JobResult.model_validate_json(raw))
+                except Exception as e:
+                    future.set_exception(e)
+
+        subs: list[Subscription] = []
+        for channel, handler in extra_subs or []:
+            subs.append(await self.bus.subscribe(channel, handler))
+        subs.append(await self.bus.subscribe(f"job:result:{request.id}", on_result))
+        try:
+            await self.add_job(request)
+            try:
+                return await asyncio.wait_for(future, timeout_ms / 1000)
+            except asyncio.TimeoutError:
+                await self.cancel_job(request.id, reason="timeout")
+                raise JobTimeoutError(
+                    f"Job {request.id} timed out after {timeout_ms} ms") from None
+        finally:
+            for sub in subs:
+                await sub.unsubscribe()
+
+    async def submit_and_wait(self, request: InferenceRequest,
+                              timeout_ms: int | None = None) -> JobResult:
+        """Synchronous submit: queue, await the per-job result channel
+        (reference: JobScheduler.ts:666-711)."""
+        return await self._submit_and_await(request, timeout_ms)
+
+    async def submit_streaming_job(
+        self,
+        request: InferenceRequest,
+        on_chunk: Callable[[StreamChunk], Awaitable[None]],
+        timeout_ms: int | None = None,
+    ) -> JobResult:
+        """Streaming submit: forward ``job:stream:{id}`` frames to on_chunk,
+        return the final result (reference: JobScheduler.ts:713-856)."""
+
+        async def on_stream(_ch: str, raw: str) -> None:
+            try:
+                chunk = StreamChunk.model_validate_json(raw)
+            except Exception:
+                return
+            await on_chunk(chunk)
+
+        return await self._submit_and_await(
+            request, timeout_ms,
+            extra_subs=[(f"job:stream:{request.id}", on_stream)])
+
+    async def cancel_job(self, job_id: str, reason: str = "cancelled") -> bool:
+        """Cancel a queued, retrying, or active job (reference:
+        JobScheduler.ts:874-908). The cancelled-set guards the race where a
+        dispatch pass already snapshotted the queued job."""
+        self._cancelled[job_id] = time.time()
+        retry = self._retry_handles.pop(job_id, None)
+        if retry is not None:
+            retry.cancel()
+            log.job("retrying job cancelled", job_id, reason=reason)
+            return True
+        for i, qj in enumerate(self.job_queue):
+            if qj.request.id == job_id:
+                self.job_queue.pop(i)
+                await self.bus.hdel(JOB_QUEUE_KEY, job_id)
+                log.job("queued job cancelled", job_id, reason=reason)
+                return True
+        assignment = self.active_jobs.get(job_id)
+        if assignment is not None:
+            await self.bus.publish(
+                f"worker:{assignment.workerId}:job",
+                json.dumps({"type": "job_cancellation", "jobId": job_id, "reason": reason}),
+            )
+            await self._clear_active(job_id, free_worker=True)
+            log.job("active job cancelled", job_id,
+                    worker_id=assignment.workerId, reason=reason)
+            return True
+        return False
+
+    def get_active_jobs(self) -> list[JobAssignment]:
+        return list(self.active_jobs.values())
+
+    def get_job_queue(self) -> list[InferenceRequest]:
+        return [qj.request for qj in sorted(self.job_queue, key=_QueuedJob.sort_key)]
+
+    def get_queue_position(self, job_id: str) -> int | None:
+        for pos, qj in enumerate(self.get_job_queue()):
+            if qj.id == job_id:
+                return pos
+        return None
+
+    def get_stats(self) -> dict[str, Any]:
+        return {
+            "queuedJobs": len(self.job_queue),
+            "activeJobs": len(self.active_jobs),
+            "totalJobsProcessed": self.total_completed,
+            "totalJobsFailed": self.total_failed,
+        }
+
+    # -- dispatch -----------------------------------------------------------
+    def request_dispatch(self) -> None:
+        """Debounced event-driven dispatch: coalesce triggers into one task."""
+        if self._dispatch_scheduled or not self._running:
+            return
+        self._dispatch_scheduled = True
+
+        async def run() -> None:
+            self._dispatch_scheduled = False
+            try:
+                await self._process_job_queue()
+            except Exception as e:
+                log.error("dispatch failed", error=str(e))
+
+        asyncio.ensure_future(run())
+
+    async def _process_job_queue(self) -> None:
+        """Assign every queued job that has an available worker
+        (reference: JobScheduler.ts:137-217). Serialized by a lock — dispatch
+        triggers may overlap and double-assignment must be impossible."""
+        async with self._dispatch_lock:
+            if not self.job_queue:
+                return
+            assigned_ids: set[str] = set()
+            for qj in sorted(list(self.job_queue), key=_QueuedJob.sort_key):
+                if qj.request.id in self._cancelled:
+                    assigned_ids.add(qj.request.id)  # drop from queue below
+                    await self.bus.hdel(JOB_QUEUE_KEY, qj.request.id)
+                    continue
+                worker = self._select_worker(qj.request)
+                if worker is None:
+                    owners = self.registry.get_workers_with_model(qj.request.model)
+                    if not owners:
+                        # loud no-owner log (reference: JobScheduler.ts:176-204),
+                        # rate-limited to once per model per 5 s
+                        now = time.time()
+                        if now - self._no_owner_warned.get(qj.request.model, 0) > 5:
+                            self._no_owner_warned[qj.request.model] = now
+                            log.warning("no worker serves model; job held",
+                                        job_id=qj.request.id, model=qj.request.model)
+                    continue
+                if await self._assign_job(qj, worker):
+                    assigned_ids.add(qj.request.id)
+            if assigned_ids:
+                # jobs added during assignment awaits stay for the next pass
+                self.job_queue = [qj for qj in self.job_queue
+                                  if qj.request.id not in assigned_ids]
+
+    def _select_worker(self, request: InferenceRequest) -> WorkerInfo | None:
+        """Least-loaded, then performance tier (reference:
+        JobScheduler.ts:317-360). TPU extension: prefer a worker advertising
+        a shard layout for the model (topology-aware placement)."""
+        candidates = self.registry.get_available_workers_by_model(request.model)
+        if not candidates:
+            return None
+
+        def score(w: WorkerInfo) -> tuple[int, int, int]:
+            has_layout = any(l.name == request.model for l in w.capabilities.shardLayouts)
+            return (
+                w.currentJobs,
+                0 if has_layout else 1,
+                _TIER_RANK.get(w.capabilities.performanceTier, 1),
+            )
+
+        return min(candidates, key=score)
+
+    async def _assign_job(self, qj: _QueuedJob, worker: WorkerInfo) -> bool:
+        """reference: JobScheduler.ts:362-432."""
+        # staleness re-check right before assignment (:368-386)
+        fresh = self.registry.get_worker(worker.workerId)
+        if fresh is None or fresh.status != "online":
+            return False
+        silent_s = time.time() - fresh.lastHeartbeat
+        if silent_s * 1000 > self.config.worker_heartbeat_timeout_ms:
+            return False
+
+        request = qj.request
+        timeout_ms = request.timeout or self.config.job_timeout_ms
+        assignment = JobAssignment(
+            jobId=request.id, workerId=worker.workerId,
+            request=request, timeout=timeout_ms,
+        )
+        self.active_jobs[request.id] = assignment
+        await self.bus.hset(ACTIVE_JOBS_KEY, request.id, assignment.model_dump_json())
+        await self.bus.hdel(JOB_QUEUE_KEY, request.id)
+        await self.registry.mark_worker_busy(worker.workerId)
+        await self.bus.publish(
+            f"worker:{worker.workerId}:job",
+            json.dumps({"type": "job_assignment", "job": assignment.model_dump(mode="json")}),
+        )
+        self._arm_timeout(assignment, remaining_ms=timeout_ms)
+        log.job("job assigned", request.id, worker_id=worker.workerId)
+        self.emit("job_assigned", assignment)
+        return True
+
+    def _arm_timeout(self, assignment: JobAssignment, remaining_ms: float) -> None:
+        loop = asyncio.get_running_loop()
+        job_id = assignment.jobId
+
+        def fire() -> None:
+            self._timeout_handles.pop(job_id, None)
+            asyncio.ensure_future(self._handle_job_timeout(job_id))
+
+        self._timeout_handles[job_id] = loop.call_later(remaining_ms / 1000, fire)
+
+    # -- completion/failure handlers ---------------------------------------
+    async def _on_job_completed(self, _ch: str, raw: str) -> None:
+        """reference: JobScheduler.ts:434-461."""
+        try:
+            result = JobResult.model_validate_json(raw)
+        except Exception:
+            return
+        if result.jobId not in self.active_jobs:
+            return  # stale/duplicate completion
+        await self._clear_active(result.jobId, free_worker=True)
+        self.total_completed += 1
+        log.job("job completed", result.jobId, worker_id=result.workerId,
+                ms=round(result.processingTimeMs, 1))
+        self.emit("job_completed", result)
+        self.request_dispatch()
+
+    async def _on_job_failed(self, _ch: str, raw: str) -> None:
+        """Retry with delay while attempts remain; deliver the final failure
+        to the waiter only when they run out (reference: JobScheduler.ts:463-514,
+        minus the waiter-rejects-on-first-failure defect)."""
+        try:
+            result = JobResult.model_validate_json(raw)
+        except Exception:
+            return
+        assignment = self.active_jobs.get(result.jobId)
+        if assignment is None:
+            return
+        await self._clear_active(result.jobId, free_worker=True)
+        request = assignment.request
+        retry_count = int(request.metadata.get("retryCount", 0))
+        if retry_count < self.config.retry_attempts:
+            request.metadata["retryCount"] = retry_count + 1
+            request.metadata["lastError"] = result.error
+            delay_s = self.config.retry_delay_ms / 1000
+            log.job("job failed; retry scheduled", result.jobId,
+                    attempt=retry_count + 1, delay_s=delay_s, error=result.error)
+
+            def do_retry() -> None:
+                self._retry_handles.pop(result.jobId, None)
+                if self._running:
+                    asyncio.ensure_future(self.add_job(request))
+
+            loop = asyncio.get_running_loop()
+            self._retry_handles[result.jobId] = loop.call_later(delay_s, do_retry)
+        else:
+            self.total_failed += 1
+            log.job("job failed permanently", result.jobId, error=result.error)
+            await self.bus.publish(f"job:result:{result.jobId}", result.model_dump_json())
+            self.emit("job_failed", result)
+        self.request_dispatch()
+
+    async def _on_job_timeout_report(self, _ch: str, raw: str) -> None:
+        """Worker-side timeout report on `job:timeout` (subscribed by the
+        reference at JobScheduler.ts:31-39)."""
+        try:
+            job_id = json.loads(raw).get("jobId")
+        except Exception:
+            return
+        if job_id:
+            await self._handle_job_timeout(job_id)
+
+    async def _handle_job_timeout(self, job_id: str) -> None:
+        """Server-side job timeout (reference: JobScheduler.ts:516-551)."""
+        assignment = self.active_jobs.get(job_id)
+        if assignment is None:
+            return  # already completed — benign
+        log.job("job timed out", job_id, worker_id=assignment.workerId)
+        await self.bus.publish(
+            f"worker:{assignment.workerId}:job",
+            json.dumps({"type": "job_cancellation", "jobId": job_id, "reason": "timeout"}),
+        )
+        await self._clear_active(job_id, free_worker=True)
+        self.total_failed += 1
+        result = JobResult(jobId=job_id, workerId=assignment.workerId,
+                           success=False, error="Job timed out")
+        await self.bus.publish(f"job:result:{job_id}", result.model_dump_json())
+        self.emit("job_timeout", result)
+        self.request_dispatch()
+
+    # -- orphan machinery ---------------------------------------------------
+    async def _on_worker_removed(self, worker_id: str, _info: WorkerInfo, reason: str) -> None:
+        """Requeue all active jobs of a dead worker at the front with high
+        priority (reference: JobScheduler.ts:553-630)."""
+        doomed = [a for a in self.active_jobs.values() if a.workerId == worker_id]
+        for assignment in doomed:
+            await self._orphan_job(assignment, reason=f"worker_removed:{reason}")
+        if doomed:
+            self.request_dispatch()
+
+    async def _orphan_job(self, assignment: JobAssignment, reason: str) -> None:
+        """Promote to high priority, requeue at the FRONT, record audit
+        metadata (reference: JobScheduler.ts:259-315)."""
+        job_id = assignment.jobId
+        await self._clear_active(job_id, free_worker=False)
+        request = assignment.request
+        request.priority = Priority.high
+        md = request.metadata
+        md["orphaned"] = True
+        md["originalWorkerId"] = assignment.workerId
+        md["orphanedAt"] = time.time()
+        md["requeueCount"] = int(md.get("requeueCount", 0)) + 1
+        # Front of queue: dedicated shrinking counter, so front inserts
+        # survive crash-reload (concurrent orphans end up LIFO at the front,
+        # matching the reference's unshift loop, JobScheduler.ts:585-618).
+        self._front_seq -= 1
+        qj = _QueuedJob(request, self._front_seq)
+        self.job_queue.insert(0, qj)
+        await self._persist_queued(qj)
+        log.job("job orphaned and requeued", job_id,
+                original_worker=assignment.workerId, reason=reason,
+                requeue_count=md["requeueCount"])
+        self.emit("job_orphaned", request)
+
+    async def _sweep_loop(self) -> None:
+        """Safety-net sweep (reference: the 1 s tick, JobScheduler.ts:128-135
+        — here only orphan detection + a dispatch fallback)."""
+        interval = self.config.sweep_interval_ms / 1000
+        while self._running:
+            await asyncio.sleep(interval)
+            try:
+                await self._check_for_orphaned_jobs()
+                now = time.time()
+                for job_id, at in list(self._cancelled.items()):
+                    if now - at > 60:
+                        del self._cancelled[job_id]
+                if self.job_queue:
+                    self.request_dispatch()
+            except Exception as e:
+                log.error("sweep failed", error=str(e))
+
+    async def _check_for_orphaned_jobs(self) -> None:
+        """reference: JobScheduler.ts:219-257 — assignment older than the
+        threshold AND worker gone or silent beyond the window."""
+        now = time.time()
+        threshold_s = self.config.orphan_assign_threshold_ms / 1000
+        window_s = self.config.quick_disconnect_window_ms / 1000
+        for assignment in list(self.active_jobs.values()):
+            if now - assignment.assignedAt < threshold_s:
+                continue
+            worker = self.registry.get_worker(assignment.workerId)
+            if worker is None or now - worker.lastHeartbeat > window_s:
+                await self._orphan_job(assignment, reason="orphan_sweep")
+        self.request_dispatch()
+
+    # -- internals ----------------------------------------------------------
+    async def _persist_queued(self, qj: _QueuedJob) -> None:
+        await self.bus.hset(
+            JOB_QUEUE_KEY, qj.request.id,
+            json.dumps({"seq": qj.seq, "request": qj.request.model_dump(mode="json")}),
+        )
+
+    async def _clear_active(self, job_id: str, free_worker: bool) -> None:
+        assignment = self.active_jobs.pop(job_id, None)
+        await self.bus.hdel(ACTIVE_JOBS_KEY, job_id)
+        handle = self._timeout_handles.pop(job_id, None)
+        if handle is not None:
+            handle.cancel()
+        if assignment is not None and free_worker:
+            await self.registry.mark_worker_available(assignment.workerId)

@@ -1,0 +1,169 @@
+"""Fake worker speaking the §2.6 bus protocol — scheduling/failover tests
+need no TPU and no model (SURVEY.md §4 'rebuild test plan implications')."""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+
+from gridllm_tpu.bus.base import MessageBus
+from gridllm_tpu.utils.config import SchedulerConfig
+from gridllm_tpu.utils.types import (
+    InferenceResponse,
+    JobAssignment,
+    JobResult,
+    ModelInfo,
+    NodeCapabilities,
+    StreamChunk,
+    WorkerInfo,
+    iso_now,
+)
+
+
+def fast_config() -> SchedulerConfig:
+    """Sub-second timers so failure-path tests run quickly."""
+    return SchedulerConfig(
+        worker_heartbeat_timeout_ms=600,
+        worker_cleanup_interval_ms=100,
+        connection_monitor_interval_ms=100,
+        quick_disconnect_window_ms=400,
+        orphan_assign_threshold_ms=200,
+        job_timeout_ms=5_000,
+        retry_attempts=2,
+        retry_delay_ms=50,
+        sweep_interval_ms=100,
+    )
+
+
+class FakeWorker:
+    """Registers, heartbeats, executes canned jobs over the bus protocol."""
+
+    def __init__(self, bus: MessageBus, worker_id: str, models: list[str],
+                 max_concurrent: int = 1, heartbeat_interval_s: float = 0.2,
+                 reply: str = "canned response", delay_s: float = 0.0,
+                 fail_times: int = 0, stream_tokens: list[str] | None = None):
+        self.bus = bus
+        self.worker_id = worker_id
+        self.models = models
+        self.max_concurrent = max_concurrent
+        self.heartbeat_interval_s = heartbeat_interval_s
+        self.reply = reply
+        self.delay_s = delay_s
+        self.fail_times = fail_times
+        self.stream_tokens = stream_tokens
+        self.current_jobs = 0
+        self.processed: list[str] = []
+        self.cancelled: list[str] = []
+        self._subs = []
+        self._hb_task: asyncio.Task | None = None
+        self._running = False
+
+    def _info(self) -> WorkerInfo:
+        return WorkerInfo(
+            workerId=self.worker_id,
+            capabilities=NodeCapabilities(
+                workerId=self.worker_id,
+                availableModels=[ModelInfo(name=m) for m in self.models],
+                maxConcurrentTasks=self.max_concurrent,
+            ),
+            status="online",
+            currentJobs=self.current_jobs,
+        )
+
+    async def start(self) -> None:
+        self._running = True
+        self._subs.append(await self.bus.subscribe(
+            f"worker:{self.worker_id}:job", self._on_job_message))
+        self._subs.append(await self.bus.subscribe(
+            f"worker:reregister:{self.worker_id}", self._on_reregister))
+        await self.register()
+        self._hb_task = asyncio.create_task(self._heartbeat_loop())
+
+    async def register(self) -> None:
+        info = self._info()
+        await self.bus.hset("workers", self.worker_id, info.model_dump_json())
+        await self.bus.publish("worker:registered", info.model_dump_json())
+
+    async def stop(self, announce: bool = True) -> None:
+        """Graceful stop; announce=False simulates abrupt death."""
+        self._running = False
+        if self._hb_task:
+            self._hb_task.cancel()
+            self._hb_task = None
+        for s in self._subs:
+            await s.unsubscribe()
+        self._subs.clear()
+        if announce:
+            await self.bus.publish("worker:unregistered",
+                                   json.dumps({"workerId": self.worker_id}))
+
+    async def die(self) -> None:
+        """Abrupt death: no unregister, heartbeat key left to expire."""
+        await self.stop(announce=False)
+        await self.bus.delete(f"heartbeat:{self.worker_id}")
+
+    async def _heartbeat_loop(self) -> None:
+        while self._running:
+            await self.bus.set_with_expiry(
+                f"heartbeat:{self.worker_id}", str(time.time()),
+                ttl_s=self.heartbeat_interval_s * 2)
+            await self.bus.publish("worker:heartbeat", json.dumps({
+                "workerId": self.worker_id,
+                "status": "busy" if self.current_jobs >= self.max_concurrent else "online",
+                "currentJobs": self.current_jobs,
+            }))
+            await asyncio.sleep(self.heartbeat_interval_s)
+
+    async def _on_reregister(self, _ch: str, _raw: str) -> None:
+        await self.register()
+
+    async def _on_job_message(self, _ch: str, raw: str) -> None:
+        msg = json.loads(raw)
+        if msg.get("type") == "job_cancellation":
+            self.cancelled.append(msg["jobId"])
+            return
+        if msg.get("type") != "job_assignment":
+            return
+        assignment = JobAssignment.model_validate(msg["job"])
+        asyncio.ensure_future(self._execute(assignment))
+
+    async def _execute(self, assignment: JobAssignment) -> None:
+        self.current_jobs += 1
+        start = time.time()
+        job_id = assignment.jobId
+        try:
+            if self.delay_s:
+                await asyncio.sleep(self.delay_s)
+            if job_id in self.cancelled:
+                return
+            if self.fail_times > 0:
+                self.fail_times -= 1
+                result = JobResult(jobId=job_id, workerId=self.worker_id,
+                                   success=False, error="injected failure",
+                                   processingTimeMs=(time.time() - start) * 1000)
+                await self.bus.publish("job:failed", result.model_dump_json())
+                return
+            if self.stream_tokens is not None and assignment.request.stream:
+                for i, tok in enumerate(self.stream_tokens):
+                    await self.bus.publish(f"job:stream:{job_id}", StreamChunk(
+                        id=job_id, model=assignment.request.model,
+                        created_at=iso_now(), response=tok, done=False,
+                    ).model_dump_json())
+                text = "".join(self.stream_tokens)
+            else:
+                text = self.reply
+            self.processed.append(job_id)
+            response = InferenceResponse(
+                id=job_id, model=assignment.request.model, created_at=iso_now(),
+                response=text, done=True, done_reason="stop",
+                eval_count=len(text.split()),
+                total_duration=int((time.time() - start) * 1e9),
+            )
+            result = JobResult(jobId=job_id, workerId=self.worker_id,
+                               success=True, response=response,
+                               processingTimeMs=(time.time() - start) * 1000)
+            await self.bus.publish("job:completed", result.model_dump_json())
+            await self.bus.publish(f"job:result:{job_id}", result.model_dump_json())
+        finally:
+            self.current_jobs -= 1
