@@ -1,0 +1,3 @@
+from gridllm_tpu.gateway.app import GatewayServer, create_app
+
+__all__ = ["GatewayServer", "create_app"]

@@ -1,0 +1,159 @@
+"""Gateway server assembly.
+
+Reference analogue: server/src/index.ts (GridLLMServer, 330 LoC): middleware
+stack, route mounting (/ollama, /v1, /inference, /health, root summary),
+event→log wiring (:119-212), graceful shutdown (:272-301), 60 s status log
+loop (:249-265). The reference also configured a rate limiter but never
+mounted it (SURVEY.md §2.4) — here it is actually mounted.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+
+from aiohttp import web
+
+import gridllm_tpu
+from gridllm_tpu.bus import create_bus
+from gridllm_tpu.bus.base import MessageBus
+from gridllm_tpu.gateway import (
+    health_routes,
+    inference_routes,
+    ollama_routes,
+    openai_routes,
+)
+from gridllm_tpu.gateway.errors import APP_ENV, error_middleware
+from gridllm_tpu.gateway.ratelimit import rate_limit_middleware
+from gridllm_tpu.scheduler import JobScheduler, WorkerRegistry
+from gridllm_tpu.utils.config import Config, load_config
+from gridllm_tpu.utils.logging import get_logger
+
+log = get_logger("gateway.app")
+
+
+def create_app(bus: MessageBus, registry: WorkerRegistry, scheduler: JobScheduler,
+               config: Config | None = None) -> web.Application:
+    config = config or load_config()
+    version = gridllm_tpu.__version__
+    app = web.Application(
+        middlewares=[error_middleware, rate_limit_middleware(config.gateway)],
+        client_max_size=config.gateway.max_body_bytes,
+    )
+    app[APP_ENV] = config.env
+
+    # /ollama/api/* is the canonical mount (reference mounts at /ollama);
+    # the same handlers are also mounted bare at /api/* so native Ollama
+    # SDKs pointed straight at the gateway work unchanged.
+    timeout_ms = config.gateway.default_request_timeout_ms
+    ollama = ollama_routes.build_routes(registry, scheduler, version, timeout_ms)
+    app.add_routes([web.RouteDef(r.method, f"/ollama{r.path}", r.handler, r.kwargs)
+                    for r in ollama])
+    app.add_routes(ollama)
+    app.add_routes(openai_routes.build_routes(registry, scheduler, timeout_ms))
+    app.add_routes(inference_routes.build_routes(registry, scheduler))
+    app.add_routes(health_routes.build_routes(bus, registry, scheduler, version))
+
+    async def root(request: web.Request) -> web.Response:
+        """Root summary (reference: server/src/index.ts:86-109)."""
+        stats = scheduler.get_stats()
+        return web.json_response({
+            "name": "GridLLM-TPU Server",
+            "version": version,
+            "status": "running",
+            "workers": registry.get_worker_count(),
+            "jobs": stats,
+            "endpoints": {
+                "ollama": "/ollama/api/*",
+                "openai": "/v1/*",
+                "inference": "/inference",
+                "health": "/health",
+            },
+        })
+
+    app.add_routes([web.get("/", root)])
+    return app
+
+
+class GatewayServer:
+    """Full server lifecycle: bus + registry + scheduler + HTTP."""
+
+    def __init__(self, config: Config | None = None, bus: MessageBus | None = None):
+        self.config = config or load_config()
+        self.bus = bus or create_bus(self.config.bus.url,
+                                     key_prefix=self.config.bus.key_prefix)
+        self.registry = WorkerRegistry(self.bus, self.config.scheduler)
+        self.scheduler = JobScheduler(self.bus, self.registry, self.config.scheduler)
+        self.app = create_app(self.bus, self.registry, self.scheduler, self.config)
+        self._runner: web.AppRunner | None = None
+        self._status_task: asyncio.Task | None = None
+        self._wire_events()
+
+    def _wire_events(self) -> None:
+        """Event→log wiring (reference: server/src/index.ts:119-212)."""
+        self.registry.on("worker_registered",
+                         lambda info: log.worker("registered", info.workerId,
+                                                 models=info.model_names()))
+        self.registry.on("worker_removed",
+                         lambda wid, info, reason: log.worker("removed", wid, reason=reason))
+        self.scheduler.on("job_queued", lambda r: log.job("queued", r.id, model=r.model))
+        self.scheduler.on("job_completed",
+                          lambda res: log.job("completed", res.jobId,
+                                              ms=round(res.processingTimeMs, 1)))
+        self.scheduler.on("job_failed", lambda res: log.job("failed", res.jobId,
+                                                            error=res.error))
+        self.scheduler.on("job_orphaned", lambda r: log.job("orphaned", r.id))
+
+    async def start(self, port: int | None = None) -> int:
+        await self.bus.connect()
+        await self.registry.initialize()
+        await self.scheduler.initialize()
+        self._runner = web.AppRunner(self.app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.config.gateway.host,
+                           port if port is not None else self.config.gateway.port)
+        await site.start()
+        bound = self._runner.addresses[0][1] if self._runner.addresses else 0
+        self._status_task = asyncio.create_task(self._status_loop())
+        log.info("gateway started", host=self.config.gateway.host, port=bound)
+        return bound
+
+    async def _status_loop(self) -> None:
+        """60 s performance snapshot (reference: server/src/index.ts:249-265)."""
+        while True:
+            await asyncio.sleep(60)
+            log.performance("status", workers=self.registry.get_worker_count(),
+                            jobs=self.scheduler.get_stats())
+
+    async def shutdown(self) -> None:
+        log.info("gateway shutting down")
+        if self._status_task:
+            self._status_task.cancel()
+            self._status_task = None
+        if self._runner is not None:
+            await self._runner.cleanup()
+            self._runner = None
+        await self.scheduler.shutdown()
+        await self.registry.shutdown()
+        await self.bus.disconnect()
+
+
+def main() -> None:  # pragma: no cover
+    """CLI entry: ``gridllm-server`` / ``python -m gridllm_tpu.gateway.app``."""
+    import signal
+
+    async def run() -> None:
+        server = GatewayServer()
+        await server.start()
+        stop = asyncio.Event()
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            loop.add_signal_handler(sig, stop.set)
+        await stop.wait()
+        await server.shutdown()
+
+    asyncio.run(run())
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

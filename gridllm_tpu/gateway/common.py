@@ -1,0 +1,61 @@
+"""Shared route plumbing: submit helpers and streaming error guards."""
+
+from __future__ import annotations
+
+from typing import Any, Awaitable, Callable
+
+from aiohttp import web
+
+from gridllm_tpu.gateway.errors import ApiError
+from gridllm_tpu.scheduler import JobScheduler
+from gridllm_tpu.scheduler.scheduler import JobTimeoutError
+from gridllm_tpu.utils.logging import get_logger
+from gridllm_tpu.utils.types import InferenceRequest, JobResult
+
+log = get_logger("gateway.common")
+
+
+async def submit(req: InferenceRequest, scheduler: JobScheduler,
+                 timeout_code: str = "JOB_TIMEOUT",
+                 failure_code: str = "INFERENCE_FAILED",
+                 error_cls: type[ApiError] = ApiError) -> JobResult:
+    """submit_and_wait with HTTP error translation (timeout→504, failure→500)."""
+    try:
+        result = await scheduler.submit_and_wait(req)
+    except JobTimeoutError as e:
+        raise error_cls(str(e), 504, timeout_code) from None
+    if not result.success:
+        raise error_cls(result.error or "Inference failed", 500, failure_code)
+    return result
+
+
+def response_dict(result: JobResult) -> dict[str, Any]:
+    return result.response.model_dump(exclude_none=True) if result.response else {}
+
+
+async def guarded_stream(resp: web.StreamResponse,
+                         run: Callable[[], Awaitable[None]],
+                         on_error: Callable[[str], Awaitable[None]]) -> web.StreamResponse:
+    """Run a streaming body after the response is prepared. Any failure is
+    delivered as an in-stream error frame (a second JSON response can't be
+    started once headers are out); client disconnects end the stream quietly."""
+    try:
+        await run()
+    except JobTimeoutError as e:
+        try:
+            await on_error(str(e))
+        except (ConnectionResetError, ConnectionError):
+            pass
+    except (ConnectionResetError, ConnectionError):
+        log.info("client disconnected mid-stream")
+    except Exception as e:
+        log.error("streaming handler failed", error=str(e))
+        try:
+            await on_error("Internal error during streaming")
+        except (ConnectionResetError, ConnectionError):
+            pass
+    try:
+        await resp.write_eof()
+    except (ConnectionResetError, ConnectionError):
+        pass
+    return resp

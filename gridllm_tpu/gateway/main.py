@@ -1,0 +1,6 @@
+"""Console-script entry for the gateway server (``gridllm-server``)."""
+
+from gridllm_tpu.gateway.app import main
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

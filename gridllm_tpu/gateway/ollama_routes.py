@@ -1,0 +1,319 @@
+"""Ollama-compatible API at ``/ollama`` (and also mounted bare at ``/api``).
+
+Reference analogue: server/src/routes/ollama.ts (714 LoC). Endpoints:
+- POST /api/generate  (:161-319) — incl. empty-prompt load/unload semantics
+  (:177-214), stream default TRUE (:51), NDJSON streaming
+- POST /api/chat      (:322-504) — FIXED vs reference (SURVEY.md §2.8):
+  structured messages are carried end-to-end with requestType "chat" instead
+  of being flattened into a prompt
+- GET  /api/tags      (:507-571) — cross-worker aggregation with
+  gridllm_metadata.num_workers_with_model
+- POST /api/embed     (:574-643), POST /api/embeddings legacy (:646-711)
+Plus endpoints the reference README claims but never implemented
+(README.md:149, 207-211; SURVEY.md §2.2): /api/version, /api/ps, /api/show.
+/api/pull, /api/delete, /api/copy, /api/push return a structured 501 until
+worker-side model management lands.
+
+Validation mirrors the Joi schemas (ollama.ts:17-117): prompt ≤ 100 kB,
+model required.
+"""
+
+from __future__ import annotations
+
+import uuid
+from typing import Any
+
+from aiohttp import web
+
+from gridllm_tpu.gateway.convert import (
+    start_ndjson,
+    to_ollama_chat,
+    to_ollama_generate,
+    write_ndjson,
+)
+from gridllm_tpu.gateway.common import guarded_stream, response_dict, submit
+from gridllm_tpu.gateway.errors import ApiError
+from gridllm_tpu.scheduler import JobScheduler, WorkerRegistry
+from gridllm_tpu.utils.logging import get_logger
+from gridllm_tpu.utils.types import InferenceRequest, StreamChunk, iso_now
+
+log = get_logger("gateway.ollama")
+
+MAX_PROMPT = 100 * 1024  # Joi max (ollama.ts:19)
+
+
+def _require_model(body: dict, registry: WorkerRegistry) -> str:
+    model = body.get("model")
+    if not model or not isinstance(model, str):
+        raise ApiError("Validation error: \"model\" is required", 400)
+    if not registry.get_workers_with_model(model):
+        raise ApiError(
+            f"Model '{model}' is not available on any worker", 404, "MODEL_NOT_FOUND")
+    return model
+
+
+def _validate_prompt(body: dict) -> str | None:
+    prompt = body.get("prompt")
+    if prompt is not None:
+        if not isinstance(prompt, str):
+            raise ApiError("Validation error: \"prompt\" must be a string", 400)
+        if len(prompt) > MAX_PROMPT:
+            raise ApiError(
+                f"Validation error: \"prompt\" length must be less than or equal to "
+                f"{MAX_PROMPT} characters long", 400)
+    return prompt
+
+
+def build_routes(registry: WorkerRegistry, scheduler: JobScheduler,
+                 version: str, default_timeout_ms: int = 300_000) -> list[web.RouteDef]:
+    routes: list[web.RouteDef] = []
+    DEFAULT_TIMEOUT_MS = default_timeout_ms
+
+    # ---------------- /api/generate ----------------
+    async def generate(request: web.Request) -> web.StreamResponse:
+        body = await request.json()
+        model = _require_model(body, registry)
+        prompt = _validate_prompt(body)
+        stream = body.get("stream", True)  # Ollama default (ollama.ts:51)
+
+        # empty prompt → load/unload semantics (ollama.ts:177-214)
+        if not prompt or not prompt.strip():
+            payload: dict[str, Any] = {
+                "model": model, "created_at": iso_now(), "response": "", "done": True}
+            if body.get("keep_alive") == 0:
+                payload["done_reason"] = "unload"
+            if stream:
+                resp = await start_ndjson(request)
+                await write_ndjson(resp, payload)
+                await resp.write_eof()
+                return resp
+            return web.json_response(payload)
+
+        req = InferenceRequest(
+            id=str(uuid.uuid4()), model=model, prompt=prompt, stream=stream,
+            options=body.get("options") or {},
+            timeout=DEFAULT_TIMEOUT_MS,
+            metadata={
+                "ollamaEndpoint": "/api/generate",
+                "requestType": "inference",
+                "suffix": body.get("suffix"),
+                "images": body.get("images"),
+                "think": body.get("think"),
+                "format": body.get("format"),
+                "system": body.get("system"),
+                "template": body.get("template"),
+                "raw": body.get("raw"),
+                "keep_alive": body.get("keep_alive"),
+                "context": body.get("context"),
+                "submittedAt": iso_now(),
+            },
+        )
+        log.job("ollama generate submitted", req.id, model=model, stream=stream)
+
+        if not stream:
+            result = await submit(req, scheduler)
+            return web.json_response(
+                to_ollama_generate(response_dict(result), model))
+
+        resp = await start_ndjson(request)
+
+        async def on_chunk(chunk: StreamChunk) -> None:
+            await write_ndjson(resp, to_ollama_generate(
+                chunk.model_dump(exclude_none=True), model))
+
+        async def run() -> None:
+            result = await scheduler.submit_streaming_job(req, on_chunk)
+            if result.success:
+                await write_ndjson(resp, to_ollama_generate(response_dict(result), model))
+            else:
+                await on_error(result.error or "Inference failed")
+
+        async def on_error(message: str) -> None:
+            await write_ndjson(resp, {
+                "model": model, "created_at": iso_now(), "response": "",
+                "done": True, "error": message})
+
+        return await guarded_stream(resp, run, on_error)
+
+    # ---------------- /api/chat ----------------
+    async def chat(request: web.Request) -> web.StreamResponse:
+        body = await request.json()
+        model = _require_model(body, registry)
+        messages = body.get("messages")
+        if not isinstance(messages, list) or not messages:
+            raise ApiError("Validation error: \"messages\" is required", 400)
+        stream = body.get("stream", True)
+
+        req = InferenceRequest(
+            id=str(uuid.uuid4()), model=model, stream=stream,
+            messages=messages,
+            tools=body.get("tools"),
+            format=body.get("format"),
+            options=body.get("options") or {},
+            timeout=DEFAULT_TIMEOUT_MS,
+            metadata={
+                "ollamaEndpoint": "/api/chat",
+                "requestType": "chat",   # fix: reference never set this (§2.8)
+                "think": body.get("think"),
+                "keep_alive": body.get("keep_alive"),
+                "submittedAt": iso_now(),
+            },
+        )
+        log.job("ollama chat submitted", req.id, model=model,
+                stream=stream, messages=len(messages))
+
+        if not stream:
+            result = await submit(req, scheduler)
+            return web.json_response(to_ollama_chat(response_dict(result), model))
+
+        resp = await start_ndjson(request)
+
+        async def on_chunk(chunk: StreamChunk) -> None:
+            d = chunk.model_dump(exclude_none=True)
+            if "message" not in d:
+                d["message"] = {"role": "assistant", "content": d.get("response", "")}
+            await write_ndjson(resp, to_ollama_chat(d, model))
+
+        async def run() -> None:
+            result = await scheduler.submit_streaming_job(req, on_chunk)
+            if result.success:
+                await write_ndjson(resp, to_ollama_chat(response_dict(result), model))
+            else:
+                await on_error(result.error or "Inference failed")
+
+        async def on_error(message: str) -> None:
+            await write_ndjson(resp, {
+                "model": model, "created_at": iso_now(),
+                "message": {"role": "assistant", "content": ""},
+                "done": True, "error": message})
+
+        return await guarded_stream(resp, run, on_error)
+
+    # ---------------- /api/tags ----------------
+    async def tags(request: web.Request) -> web.Response:
+        models_map: dict[str, dict] = {}
+        count: dict[str, int] = {}
+        for worker in registry.get_all_workers():
+            for m in worker.capabilities.availableModels:
+                count[m.name] = count.get(m.name, 0) + 1
+                if m.name not in models_map:
+                    models_map[m.name] = {
+                        "name": m.name,
+                        "model": m.model or m.name,
+                        "modified_at": m.modified_at or iso_now(),
+                        "size": m.size or 0,
+                        "digest": m.digest or "",
+                        "details": m.details or {
+                            "parent_model": "", "format": "safetensors",
+                            "family": "unknown", "families": ["unknown"],
+                            "parameter_size": "Unknown",
+                            "quantization_level": "Unknown",
+                        },
+                        "gridllm_metadata": {"num_workers_with_model": 0},
+                    }
+        for name, entry in models_map.items():
+            entry["gridllm_metadata"]["num_workers_with_model"] = count[name]
+        models = sorted(models_map.values(), key=lambda m: m["name"])
+        return web.json_response({"models": models})
+
+    # ---------------- /api/embed (+ legacy /api/embeddings) ----------------
+    async def embed(request: web.Request) -> web.Response:
+        body = await request.json()
+        model = _require_model(body, registry)
+        input_val = body.get("input")
+        if input_val is None or (isinstance(input_val, list) and not input_val):
+            raise ApiError("Validation error: \"input\" is required", 400)
+        req = InferenceRequest(
+            id=str(uuid.uuid4()), model=model, input=input_val,
+            truncate=body.get("truncate"),
+            options=body.get("options") or {},
+            timeout=DEFAULT_TIMEOUT_MS,
+            metadata={"ollamaEndpoint": "/api/embed",
+                      "requestType": "embedding", "submittedAt": iso_now()},
+        )
+        result = await submit(req, scheduler)
+        d = response_dict(result)
+        return web.json_response({
+            "model": model,
+            "embeddings": d.get("embeddings") or [],
+            "total_duration": d.get("total_duration") or 0,
+            "load_duration": d.get("load_duration") or 0,
+            "prompt_eval_count": d.get("prompt_eval_count") or 0,
+        })
+
+    async def embeddings_legacy(request: web.Request) -> web.Response:
+        """Single-embedding legacy shape (ollama.ts:646-711)."""
+        body = await request.json()
+        model = _require_model(body, registry)
+        prompt = body.get("prompt")
+        if prompt is None:
+            raise ApiError("Validation error: \"prompt\" is required", 400)
+        req = InferenceRequest(
+            id=str(uuid.uuid4()), model=model, input=prompt,
+            options=body.get("options") or {},
+            timeout=DEFAULT_TIMEOUT_MS,
+            metadata={"ollamaEndpoint": "/api/embeddings",
+                      "requestType": "embedding", "submittedAt": iso_now()},
+        )
+        result = await submit(req, scheduler)
+        d = response_dict(result)
+        embeddings = d.get("embeddings") or []
+        return web.json_response({
+            "embedding": embeddings[0] if embeddings else (d.get("embedding") or [])})
+
+    # ---------------- parity endpoints beyond the reference ----------------
+    version_str = version
+
+    async def api_version(request: web.Request) -> web.Response:
+        return web.json_response({"version": version_str})
+
+    async def ps(request: web.Request) -> web.Response:
+        """Running models across workers (real Ollama /api/ps shape)."""
+        seen: dict[str, dict] = {}
+        for worker in registry.get_online_workers():
+            for m in worker.capabilities.availableModels:
+                entry = seen.setdefault(m.name, {
+                    "name": m.name, "model": m.model or m.name,
+                    "size": m.size or 0, "digest": m.digest or "",
+                    "details": m.details or {},
+                    "expires_at": "",
+                    "size_vram": 0,
+                    "gridllm_metadata": {"workers": []},
+                })
+                entry["gridllm_metadata"]["workers"].append(worker.workerId)
+        return web.json_response({"models": sorted(seen.values(), key=lambda m: m["name"])})
+
+    async def show(request: web.Request) -> web.Response:
+        body = await request.json()
+        model = _require_model(body, registry)
+        for worker in registry.get_all_workers():
+            for m in worker.capabilities.availableModels:
+                if m.name == model:
+                    details = m.details or {}
+                    return web.json_response({
+                        "modelfile": "", "parameters": "", "template": "",
+                        "details": details,
+                        "model_info": {"general.name": model,
+                                       "general.size": m.size or 0},
+                        "capabilities": ["completion"],
+                    })
+        raise ApiError(f"Model '{model}' not found", 404, "MODEL_NOT_FOUND")
+
+    async def not_supported(request: web.Request) -> web.Response:
+        raise ApiError(
+            "Model management is handled by worker configuration in GridLLM-TPU; "
+            f"{request.path} is not supported by the gateway", 501, "NOT_SUPPORTED")
+
+    routes.append(web.post("/api/generate", generate))
+    routes.append(web.post("/api/chat", chat))
+    routes.append(web.get("/api/tags", tags))
+    routes.append(web.post("/api/embed", embed))
+    routes.append(web.post("/api/embeddings", embeddings_legacy))
+    routes.append(web.get("/api/version", api_version))
+    routes.append(web.get("/api/ps", ps))
+    routes.append(web.post("/api/show", show))
+    for path in ("/api/pull", "/api/push", "/api/copy"):
+        routes.append(web.post(path, not_supported))
+    routes.append(web.delete("/api/delete", not_supported))
+    return routes
+

@@ -1,0 +1,59 @@
+"""Per-IP fixed-window rate limiter.
+
+Reference analogue: client/src/middleware/rateLimiter.ts (130 LoC) — built
+but never mounted (SURVEY.md §2.4); here it is actually applied, with the
+same semantics: fixed window, X-RateLimit-* headers, health-path bypass,
+429 with Retry-After on exceed. Config keys match the reference's env
+(RATE_LIMIT_WINDOW_MS / RATE_LIMIT_MAX_REQUESTS).
+"""
+
+from __future__ import annotations
+
+import time
+
+from aiohttp import web
+
+from gridllm_tpu.utils.config import GatewayConfig
+
+_BYPASS_PREFIXES = ("/health", "/live", "/ready")
+
+
+def rate_limit_middleware(config: GatewayConfig):
+    window_s = config.rate_limit_window_ms / 1000
+    limit = config.rate_limit_max_requests
+    buckets: dict[str, tuple[float, int]] = {}  # ip → (window start, count)
+
+    @web.middleware
+    async def middleware(request: web.Request, handler):
+        if not config.rate_limit_enabled or request.path.startswith(_BYPASS_PREFIXES):
+            return await handler(request)
+        ip = request.remote or "unknown"
+        now = time.monotonic()
+        start, count = buckets.get(ip, (now, 0))
+        if now - start >= window_s:
+            start, count = now, 0
+        count += 1
+        buckets[ip] = (start, count)
+        if len(buckets) > 10_000:  # bound memory under IP churn
+            cutoff = now - window_s
+            for k in [k for k, (s, _) in buckets.items() if s < cutoff]:
+                del buckets[k]
+        remaining = max(0, limit - count)
+        reset_s = int(start + window_s - now) + 1
+        if count > limit:
+            return web.json_response(
+                {"error": {"message": "Too many requests", "code": "RATE_LIMITED"}},
+                status=429,
+                headers={
+                    "Retry-After": str(reset_s),
+                    "X-RateLimit-Limit": str(limit),
+                    "X-RateLimit-Remaining": "0",
+                    "X-RateLimit-Reset": str(reset_s),
+                })
+        response = await handler(request)
+        response.headers["X-RateLimit-Limit"] = str(limit)
+        response.headers["X-RateLimit-Remaining"] = str(remaining)
+        response.headers["X-RateLimit-Reset"] = str(reset_s)
+        return response
+
+    return middleware

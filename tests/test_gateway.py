@@ -1,0 +1,365 @@
+"""Gateway API tests: stub worker over the in-memory bus, real HTTP via
+aiohttp TestClient (SURVEY.md §7 step 3: 'the differential-shape e2e can run
+with a stub worker')."""
+
+import asyncio
+import json
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from gridllm_tpu.bus import InMemoryBus
+from gridllm_tpu.gateway.app import create_app
+from gridllm_tpu.scheduler import JobScheduler, WorkerRegistry
+from gridllm_tpu.utils.config import Config
+
+from .helpers import FakeWorker, fast_config
+
+
+async def make_client(rate_limit: int | None = None):
+    bus = InMemoryBus(key_prefix="G:")
+    await bus.connect()
+    cfg = fast_config()
+    registry = WorkerRegistry(bus, cfg)
+    scheduler = JobScheduler(bus, registry, cfg)
+    await registry.initialize()
+    await scheduler.initialize()
+    app_cfg = Config(scheduler=cfg)
+    if rate_limit is not None:
+        app_cfg.gateway.rate_limit_max_requests = rate_limit
+    app = create_app(bus, registry, scheduler, app_cfg)
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    return client, bus, registry, scheduler
+
+
+async def teardown(client, bus, registry, scheduler, *workers):
+    for w in workers:
+        await w.stop(announce=False)
+    await client.close()
+    await scheduler.shutdown()
+    await registry.shutdown()
+    await bus.disconnect()
+
+
+async def start_worker(bus, **kw):
+    w = FakeWorker(bus, kw.pop("worker_id", "w1"), kw.pop("models", ["m1"]), **kw)
+    await w.start()
+    await bus.flush()
+    return w
+
+
+async def test_root_summary():
+    client, bus, registry, scheduler = await make_client()
+    resp = await client.get("/")
+    body = await resp.json()
+    assert resp.status == 200
+    assert body["name"] == "GridLLM-TPU Server"
+    assert "workers" in body and "jobs" in body
+    await teardown(client, bus, registry, scheduler)
+
+
+async def test_generate_non_streaming():
+    client, bus, registry, scheduler = await make_client()
+    w = await start_worker(bus, reply="four")
+    resp = await client.post("/ollama/api/generate", json={
+        "model": "m1", "prompt": "2+2?", "stream": False})
+    body = await resp.json()
+    assert resp.status == 200
+    # Ollama response shape: all timing fields present
+    for key in ("model", "created_at", "response", "done", "context",
+                "total_duration", "load_duration", "prompt_eval_count",
+                "prompt_eval_duration", "eval_count", "eval_duration"):
+        assert key in body, f"missing {key}"
+    assert body["response"] == "four" and body["done"] is True
+    await teardown(client, bus, registry, scheduler, w)
+
+
+async def test_generate_streaming_ndjson():
+    client, bus, registry, scheduler = await make_client()
+    toks = ["a", "b", "c"]
+    w = await start_worker(bus, stream_tokens=toks)
+    resp = await client.post("/ollama/api/generate", json={
+        "model": "m1", "prompt": "go"})  # stream defaults TRUE
+    assert resp.status == 200
+    assert "ndjson" in resp.headers["Content-Type"]
+    lines = [json.loads(l) for l in (await resp.text()).strip().split("\n")]
+    assert [l["response"] for l in lines[:-1]] == toks
+    assert lines[-1]["done"] is True
+    assert lines[-1]["response"] == "abc"
+    await teardown(client, bus, registry, scheduler, w)
+
+
+async def test_generate_empty_prompt_load_unload():
+    client, bus, registry, scheduler = await make_client()
+    w = await start_worker(bus)
+    # load (no prompt, stream False)
+    resp = await client.post("/ollama/api/generate", json={
+        "model": "m1", "stream": False})
+    body = await resp.json()
+    assert body["done"] is True and body["response"] == ""
+    assert "done_reason" not in body
+    # unload (keep_alive 0)
+    resp = await client.post("/ollama/api/generate", json={
+        "model": "m1", "keep_alive": 0, "stream": False})
+    body = await resp.json()
+    assert body["done_reason"] == "unload"
+    await teardown(client, bus, registry, scheduler, w)
+
+
+async def test_generate_validation_errors():
+    client, bus, registry, scheduler = await make_client()
+    w = await start_worker(bus)
+    resp = await client.post("/ollama/api/generate", json={"prompt": "no model"})
+    assert resp.status == 400
+    body = await resp.json()
+    assert "error" in body and "model" in body["error"]["message"]
+
+    resp = await client.post("/ollama/api/generate", json={
+        "model": "nope", "prompt": "x"})
+    assert resp.status == 404
+
+    resp = await client.post("/ollama/api/generate", json={
+        "model": "m1", "prompt": "x" * (100 * 1024 + 1)})
+    assert resp.status == 400
+    await teardown(client, bus, registry, scheduler, w)
+
+
+async def test_chat_keeps_structured_messages():
+    """The §2.8 fix: /api/chat must deliver structured messages to the worker."""
+    client, bus, registry, scheduler = await make_client()
+    seen = {}
+
+    class SpyWorker(FakeWorker):
+        async def _execute(self, assignment):
+            seen["messages"] = assignment.request.messages
+            seen["requestType"] = assignment.request.metadata.get("requestType")
+            await super()._execute(assignment)
+
+    w = SpyWorker(bus, "w1", ["m1"], reply="hi there")
+    await w.start()
+    await bus.flush()
+    msgs = [{"role": "system", "content": "be nice"},
+            {"role": "user", "content": "hello"}]
+    resp = await client.post("/ollama/api/chat", json={
+        "model": "m1", "messages": msgs, "stream": False})
+    body = await resp.json()
+    assert resp.status == 200
+    assert body["message"]["role"] == "assistant"
+    assert seen["messages"] == msgs
+    assert seen["requestType"] == "chat"
+    await teardown(client, bus, registry, scheduler, w)
+
+
+async def test_tags_aggregation():
+    client, bus, registry, scheduler = await make_client()
+    w1 = await start_worker(bus, worker_id="w1", models=["alpha", "beta"])
+    w2 = await start_worker(bus, worker_id="w2", models=["alpha"])
+    resp = await client.get("/ollama/api/tags")
+    body = await resp.json()
+    models = {m["name"]: m for m in body["models"]}
+    assert models["alpha"]["gridllm_metadata"]["num_workers_with_model"] == 2
+    assert models["beta"]["gridllm_metadata"]["num_workers_with_model"] == 1
+    assert [m["name"] for m in body["models"]] == sorted(models)
+    await teardown(client, bus, registry, scheduler, w1, w2)
+
+
+async def test_openai_chat_completions():
+    client, bus, registry, scheduler = await make_client()
+    w = await start_worker(bus, reply="chat reply")
+    resp = await client.post("/v1/chat/completions", json={
+        "model": "m1", "messages": [{"role": "user", "content": "hi"}]})
+    body = await resp.json()
+    assert resp.status == 200
+    assert body["object"] == "chat.completion"
+    assert body["id"].startswith("chatcmpl-")
+    assert body["choices"][0]["message"]["role"] == "assistant"
+    assert body["choices"][0]["message"]["content"] == "chat reply"
+    assert set(body["usage"]) == {"prompt_tokens", "completion_tokens", "total_tokens"}
+    await teardown(client, bus, registry, scheduler, w)
+
+
+async def test_openai_chat_streaming_sse():
+    client, bus, registry, scheduler = await make_client()
+    toks = ["he", "llo"]
+    w = await start_worker(bus, stream_tokens=toks)
+    resp = await client.post("/v1/chat/completions", json={
+        "model": "m1", "messages": [{"role": "user", "content": "hi"}],
+        "stream": True,
+        "stream_options": {"include_usage": True}})
+    assert resp.status == 200
+    assert "text/event-stream" in resp.headers["Content-Type"]
+    text = await resp.text()
+    events = [l[len("data: "):] for l in text.split("\n") if l.startswith("data: ")]
+    assert events[-1] == "[DONE]"
+    chunks = [json.loads(e) for e in events[:-1]]
+    assert chunks[0]["choices"][0]["delta"].get("role") == "assistant"
+    deltas = [c["choices"][0]["delta"].get("content", "") for c in chunks[:-1]]
+    assert "".join(deltas) == "hello"
+    assert chunks[-1]["choices"][0]["finish_reason"] == "stop"
+    assert "usage" in chunks[-1]
+    await teardown(client, bus, registry, scheduler, w)
+
+
+async def test_openai_completions_echo():
+    client, bus, registry, scheduler = await make_client()
+    w = await start_worker(bus, reply=" world")
+    resp = await client.post("/v1/completions", json={
+        "model": "m1", "prompt": "hello", "echo": True})
+    body = await resp.json()
+    assert body["object"] == "text_completion"
+    assert body["choices"][0]["text"] == "hello world"
+    await teardown(client, bus, registry, scheduler, w)
+
+
+async def test_openai_models_list():
+    client, bus, registry, scheduler = await make_client()
+    w = await start_worker(bus, models=["zeta", "alpha"])
+    resp = await client.get("/v1/models")
+    body = await resp.json()
+    assert body["object"] == "list"
+    assert [m["id"] for m in body["data"]] == ["alpha", "zeta"]
+    assert all(m["object"] == "model" and m["owned_by"] == "gridllm"
+               for m in body["data"])
+    await teardown(client, bus, registry, scheduler, w)
+
+
+async def test_embeddings_paths():
+    client, bus, registry, scheduler = await make_client()
+
+    class EmbedWorker(FakeWorker):
+        async def _execute(self, assignment):
+            from gridllm_tpu.utils.types import InferenceResponse, JobResult
+
+            req = assignment.request
+            inputs = req.input if isinstance(req.input, list) else [req.input]
+            resp = InferenceResponse(
+                id=assignment.jobId, model=req.model,
+                embeddings=[[0.1, 0.2, 0.3] for _ in inputs],
+                prompt_eval_count=len(inputs), done=True)
+            result = JobResult(jobId=assignment.jobId, workerId=self.worker_id,
+                               success=True, response=resp)
+            await self.bus.publish("job:completed", result.model_dump_json())
+            await self.bus.publish(f"job:result:{assignment.jobId}",
+                                   result.model_dump_json())
+
+    w = EmbedWorker(bus, "w1", ["emb"])
+    await w.start()
+    await bus.flush()
+    resp = await client.post("/ollama/api/embed", json={
+        "model": "emb", "input": ["a", "b"]})
+    body = await resp.json()
+    assert len(body["embeddings"]) == 2
+    # legacy single-embedding shape
+    resp = await client.post("/ollama/api/embeddings", json={
+        "model": "emb", "prompt": "a"})
+    body = await resp.json()
+    assert body["embedding"] == [0.1, 0.2, 0.3]
+    await teardown(client, bus, registry, scheduler, w)
+
+
+async def test_inference_routes():
+    client, bus, registry, scheduler = await make_client()
+    w = await start_worker(bus)
+    resp = await client.post("/inference", json={"model": "m1", "prompt": "x"})
+    body = await resp.json()
+    assert resp.status == 200 and body["done"] is True
+    assert body["worker"] == "w1"
+
+    resp = await client.get("/inference/models")
+    body = await resp.json()
+    assert body["models"][0]["name"] == "m1"
+
+    resp = await client.get("/inference/queue")
+    body = await resp.json()
+    assert body["queue"]["totalProcessed"] == 1
+
+    resp = await client.get("/inference/unknown-id/status")
+    assert resp.status == 404
+    await teardown(client, bus, registry, scheduler, w)
+
+
+async def test_health_routes():
+    client, bus, registry, scheduler = await make_client()
+    for path, expected in [("/health", 200), ("/health/live", 200),
+                           ("/health/ready", 200), ("/health/system", 200),
+                           ("/health/workers", 200), ("/health/jobs", 200)]:
+        resp = await client.get(path)
+        assert resp.status == expected, path
+    body = await (await client.get("/health/ready")).json()
+    assert body["status"] == "ready"
+    await teardown(client, bus, registry, scheduler)
+
+
+async def test_404_envelope():
+    client, bus, registry, scheduler = await make_client()
+    resp = await client.get("/nope")
+    assert resp.status == 404
+    body = await resp.json()
+    assert body["error"]["code"] == "NOT_FOUND"
+    assert body["path"] == "/nope"
+    await teardown(client, bus, registry, scheduler)
+
+
+async def test_rate_limit():
+    client, bus, registry, scheduler = await make_client(rate_limit=3)
+    for i in range(3):
+        resp = await client.get("/")
+        assert resp.status == 200
+        assert resp.headers["X-RateLimit-Remaining"] == str(2 - i)
+    resp = await client.get("/")
+    assert resp.status == 429
+    assert "Retry-After" in resp.headers
+    # health bypassed
+    resp = await client.get("/health")
+    assert resp.status == 200
+    await teardown(client, bus, registry, scheduler)
+
+
+async def test_api_version_and_ps():
+    client, bus, registry, scheduler = await make_client()
+    w = await start_worker(bus)
+    resp = await client.get("/ollama/api/version")
+    assert "version" in await resp.json()
+    # bare mount too
+    resp = await client.get("/api/version")
+    assert resp.status == 200
+    resp = await client.get("/api/ps")
+    body = await resp.json()
+    assert body["models"][0]["name"] == "m1"
+    resp = await client.post("/api/pull", json={"model": "m1"})
+    assert resp.status == 501
+    await teardown(client, bus, registry, scheduler, w)
+
+
+async def test_openai_streaming_failure_delivers_error_frame():
+    """A permanently failed job must surface as an SSE error, not a clean
+    completion."""
+    client, bus, registry, scheduler = await make_client()
+    w = await start_worker(bus, fail_times=99)
+    resp = await client.post("/v1/chat/completions", json={
+        "model": "m1", "messages": [{"role": "user", "content": "hi"}],
+        "stream": True})
+    text = await resp.text()
+    events = [l[len("data: "):] for l in text.split("\n") if l.startswith("data: ")]
+    assert events[-1] == "[DONE]"
+    parsed = [json.loads(e) for e in events[:-1]]
+    assert any("error" in p for p in parsed), f"no error frame in {parsed}"
+    assert not any(p.get("choices", [{}])[0].get("finish_reason") == "stop"
+                   for p in parsed)
+    await teardown(client, bus, registry, scheduler, w)
+
+
+async def test_malformed_field_types_return_400():
+    client, bus, registry, scheduler = await make_client()
+    w = await start_worker(bus, models=["m1", "emb"])
+    # options as a string → pydantic rejects → 400 not 500
+    resp = await client.post("/ollama/api/generate", json={
+        "model": "m1", "prompt": "x", "options": "bad", "stream": False})
+    assert resp.status == 400
+    body = await resp.json()
+    assert body["error"]["code"] == "VALIDATION_ERROR"
+    # embed with numeric input
+    resp = await client.post("/ollama/api/embed", json={"model": "emb", "input": 123})
+    assert resp.status == 400
+    await teardown(client, bus, registry, scheduler, w)
