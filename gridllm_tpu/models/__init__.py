@@ -1,0 +1,6 @@
+"""Model families (Llama, Mixtral, embeddings) — the capability the
+reference delegates to Ollama's model store (SURVEY.md §0)."""
+
+from gridllm_tpu.models.configs import ModelConfig, get_config, REGISTRY
+
+__all__ = ["ModelConfig", "get_config", "REGISTRY"]

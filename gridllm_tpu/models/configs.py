@@ -1,0 +1,152 @@
+"""Model architecture configs + the name registry.
+
+Maps Ollama-style model names (the scheduler routes on these —
+reference: server/src/services/JobScheduler.ts:317-360 selects workers by
+model name string) to architecture configs. Dimensions follow the public
+HF configs for each family; `hf_config()` round-trips to a transformers
+config so golden tests can instantiate the torch twin locally.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+from gridllm_tpu.ops.layers import RopeScaling
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelConfig:
+    name: str
+    family: str = "llama"            # llama | mixtral | bert_embed
+    vocab_size: int = 128_256
+    hidden_size: int = 4096
+    intermediate_size: int = 14_336
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: int = 8
+    head_dim: int | None = None      # None → hidden_size // num_heads
+    rope_theta: float = 500_000.0
+    rope_scaling: RopeScaling | None = None
+    rms_eps: float = 1e-5
+    tie_embeddings: bool = False
+    max_seq_len: int = 8192
+    # MoE (mixtral family)
+    num_experts: int = 0
+    experts_per_token: int = 2
+    # attention variants
+    attn_logit_softcap: float = 0.0
+    sliding_window: int = 0          # 0 → full attention
+
+    @property
+    def head_dim_(self) -> int:
+        return self.head_dim or self.hidden_size // self.num_heads
+
+    def hf_config(self) -> Any:
+        """Equivalent transformers config (for golden tests, local only)."""
+        common = dict(
+            vocab_size=self.vocab_size,
+            hidden_size=self.hidden_size,
+            intermediate_size=self.intermediate_size,
+            num_hidden_layers=self.num_layers,
+            num_attention_heads=self.num_heads,
+            num_key_value_heads=self.num_kv_heads,
+            rope_theta=self.rope_theta,
+            rms_norm_eps=self.rms_eps,
+            tie_word_embeddings=self.tie_embeddings,
+            max_position_embeddings=self.max_seq_len,
+            attention_bias=False,
+        )
+        if self.family == "mixtral":
+            from transformers import MixtralConfig
+
+            return MixtralConfig(
+                num_local_experts=self.num_experts,
+                num_experts_per_tok=self.experts_per_token,
+                sliding_window=self.sliding_window or None,
+                **common,
+            )
+        from transformers import LlamaConfig
+
+        if self.rope_scaling is not None:
+            common["rope_scaling"] = {
+                "rope_type": "llama3",
+                "factor": self.rope_scaling.factor,
+                "low_freq_factor": self.rope_scaling.low_freq_factor,
+                "high_freq_factor": self.rope_scaling.high_freq_factor,
+                "original_max_position_embeddings": self.rope_scaling.original_max_position_embeddings,
+            }
+        return LlamaConfig(**common)
+
+
+_LLAMA3_SCALING = RopeScaling(
+    factor=8.0, low_freq_factor=1.0, high_freq_factor=4.0,
+    original_max_position_embeddings=8192,
+)
+
+# Registry keyed by Ollama model names (BASELINE.md configs 1-5) plus
+# tiny/debug configs used by tests and the synthetic bench path.
+REGISTRY: dict[str, ModelConfig] = {}
+
+
+def register(cfg: ModelConfig) -> ModelConfig:
+    REGISTRY[cfg.name] = cfg
+    return cfg
+
+
+register(ModelConfig(
+    name="llama3.2:1b", vocab_size=128_256, hidden_size=2048,
+    intermediate_size=8192, num_layers=16, num_heads=32, num_kv_heads=8,
+    head_dim=64, rope_theta=500_000.0, rope_scaling=_LLAMA3_SCALING,
+    tie_embeddings=True, max_seq_len=131_072,
+))
+register(ModelConfig(
+    name="llama3.2:3b", vocab_size=128_256, hidden_size=3072,
+    intermediate_size=8192, num_layers=28, num_heads=24, num_kv_heads=8,
+    head_dim=128, rope_theta=500_000.0, rope_scaling=_LLAMA3_SCALING,
+    tie_embeddings=True, max_seq_len=131_072,
+))
+register(ModelConfig(
+    name="llama3:8b", vocab_size=128_256, hidden_size=4096,
+    intermediate_size=14_336, num_layers=32, num_heads=32, num_kv_heads=8,
+    rope_theta=500_000.0, max_seq_len=8192,
+))
+register(ModelConfig(
+    name="llama3.1:8b", vocab_size=128_256, hidden_size=4096,
+    intermediate_size=14_336, num_layers=32, num_heads=32, num_kv_heads=8,
+    rope_theta=500_000.0, rope_scaling=_LLAMA3_SCALING, max_seq_len=131_072,
+))
+register(ModelConfig(
+    name="llama3:70b", vocab_size=128_256, hidden_size=8192,
+    intermediate_size=28_672, num_layers=80, num_heads=64, num_kv_heads=8,
+    rope_theta=500_000.0, max_seq_len=8192,
+))
+register(ModelConfig(
+    name="mixtral:8x7b", family="mixtral", vocab_size=32_000,
+    hidden_size=4096, intermediate_size=14_336, num_layers=32,
+    num_heads=32, num_kv_heads=8, rope_theta=1_000_000.0,
+    num_experts=8, experts_per_token=2, max_seq_len=32_768, rms_eps=1e-5,
+))
+
+# Tiny configs: architecture-faithful, test/bench-sized.
+register(ModelConfig(
+    name="tiny-llama", vocab_size=256, hidden_size=64, intermediate_size=128,
+    num_layers=2, num_heads=4, num_kv_heads=2, head_dim=16,
+    rope_theta=10_000.0, max_seq_len=256, tie_embeddings=False,
+))
+register(ModelConfig(
+    name="tiny-mixtral", family="mixtral", vocab_size=256, hidden_size=64,
+    intermediate_size=128, num_layers=2, num_heads=4, num_kv_heads=2,
+    head_dim=16, rope_theta=10_000.0, max_seq_len=256,
+    num_experts=4, experts_per_token=2,
+))
+
+
+def get_config(name: str) -> ModelConfig:
+    if name in REGISTRY:
+        return REGISTRY[name]
+    # Ollama-style tag normalization: "llama3.2:3b-instruct-fp16" → "llama3.2:3b"
+    base = name.split("-")[0]
+    if base in REGISTRY:
+        return REGISTRY[base]
+    raise KeyError(f"unknown model: {name!r} (known: {sorted(REGISTRY)})")

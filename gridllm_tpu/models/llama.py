@@ -1,0 +1,258 @@
+"""Llama-family decoder (Llama 3/3.1/3.2, and the dense core Mixtral shares).
+
+TPU-first design choices (SURVEY.md §7 step 4):
+- Params are a plain pytree with per-layer weights STACKED on a leading [L]
+  axis and the layer loop is `lax.scan` — one traced layer body, O(1)
+  compile time in depth, and XLA donates the KV pool buffers through the
+  scan so cache updates are in-place in HBM.
+- Three entry points, all static-shape: `forward` (full logits, golden
+  tests / graft entry), `prefill` (one slot, bucketed T, writes the paged
+  cache), `decode_step` (all slots, one token each).
+- No data-dependent Python control flow anywhere; active/inactive slots are
+  masked, not branched.
+
+The reference has no model code to mirror (compute delegated to Ollama,
+client/src/services/OllamaService.ts:17-27); HF Llama is the weight-layout
+contract (see convert_hf_state_dict).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from gridllm_tpu.models.configs import ModelConfig
+from gridllm_tpu.ops.attention import attention_prefill, paged_attention_decode
+from gridllm_tpu.ops.kvcache import PagedKVCache, write_decode, write_prefill
+from gridllm_tpu.ops.layers import apply_rope, precompute_rope, rms_norm
+
+Params = dict[str, Any]
+
+
+def _precision(x: jnp.ndarray):
+    # fp32 runs (goldens) need exact matmuls; bf16 uses the MXU default.
+    return jax.lax.Precision.HIGHEST if x.dtype == jnp.float32 else None
+
+
+def init_params(cfg: ModelConfig, key: jax.Array, dtype=jnp.bfloat16) -> Params:
+    """Random-init params (tests + synthetic bench; real loads go through
+    engine/loader.py)."""
+    e, f, v = cfg.hidden_size, cfg.intermediate_size, cfg.vocab_size
+    h, kvh, d, L = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim_, cfg.num_layers
+    ks = iter(jax.random.split(key, 16))
+
+    def w(k, *shape, scale=None):
+        scale = scale if scale is not None else (shape[-2] ** -0.5)
+        return (jax.random.normal(k, shape, jnp.float32) * scale).astype(dtype)
+
+    params: Params = {
+        "embed": w(next(ks), v, e, scale=0.02),
+        "layers": {
+            "attn_norm": jnp.ones((L, e), dtype),
+            "wq": w(next(ks), L, e, h * d),
+            "wk": w(next(ks), L, e, kvh * d),
+            "wv": w(next(ks), L, e, kvh * d),
+            "wo": w(next(ks), L, h * d, e),
+            "mlp_norm": jnp.ones((L, e), dtype),
+            "w_gate": w(next(ks), L, e, f),
+            "w_up": w(next(ks), L, e, f),
+            "w_down": w(next(ks), L, f, e),
+        },
+        "final_norm": jnp.ones((e,), dtype),
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = w(next(ks), e, v, scale=0.02)
+    return params
+
+
+def _mlp(lp: Params, x: jnp.ndarray) -> jnp.ndarray:
+    p = _precision(x)
+    gate = jnp.dot(x, lp["w_gate"], precision=p)
+    up = jnp.dot(x, lp["w_up"], precision=p)
+    return jnp.dot(jax.nn.silu(gate) * up, lp["w_down"], precision=p)
+
+
+def _qkv(cfg: ModelConfig, lp: Params, x: jnp.ndarray):
+    """x: [..., T, E] → q [..., T, H, D], k/v [..., T, KVH, D]."""
+    p = _precision(x)
+    d = cfg.head_dim_
+    q = jnp.dot(x, lp["wq"], precision=p).reshape(*x.shape[:-1], cfg.num_heads, d)
+    k = jnp.dot(x, lp["wk"], precision=p).reshape(*x.shape[:-1], cfg.num_kv_heads, d)
+    v = jnp.dot(x, lp["wv"], precision=p).reshape(*x.shape[:-1], cfg.num_kv_heads, d)
+    return q, k, v
+
+
+def _unembed(cfg: ModelConfig, params: Params, x: jnp.ndarray) -> jnp.ndarray:
+    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    return jnp.dot(
+        x, head, precision=_precision(x), preferred_element_type=jnp.float32
+    )
+
+
+def forward(params: Params, cfg: ModelConfig, tokens: jnp.ndarray) -> jnp.ndarray:
+    """Cache-free full forward: tokens [B, T] → logits [B, T, V] (fp32).
+
+    The oracle path — golden tests compare this against HF; prefill/decode
+    must agree with it (tested in tests/test_models.py).
+    """
+    b, t = tokens.shape
+    inv_freq = precompute_rope(cfg.head_dim_, cfg.rope_theta, cfg.rope_scaling)
+    x = params["embed"][tokens]
+    pos = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32), (b, t))
+    seq_lens = jnp.full((b,), t, jnp.int32)
+
+    def layer(x, lp):
+        hx = rms_norm(x, lp["attn_norm"], cfg.rms_eps)
+        q, k, v = _qkv(cfg, lp, hx)
+        q = apply_rope(q, pos, inv_freq)
+        k = apply_rope(k, pos, inv_freq)
+        attn = attention_prefill(q, k, v, seq_lens)
+        attn = attn.reshape(b, t, -1)
+        x = x + jnp.dot(attn, lp["wo"], precision=_precision(x))
+        hx = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
+        return x + _mlp(lp, hx), None
+
+    x, _ = jax.lax.scan(layer, x, params["layers"])
+    x = rms_norm(x, params["final_norm"], cfg.rms_eps)
+    return _unembed(cfg, params, x)
+
+
+def prefill(
+    params: Params,
+    cfg: ModelConfig,
+    tokens: jnp.ndarray,
+    length: jnp.ndarray,
+    cache: PagedKVCache,
+    slot: jnp.ndarray,
+    table_row: jnp.ndarray,
+) -> tuple[jnp.ndarray, PagedKVCache]:
+    """Prefill ONE slot. tokens: [T] (padded bucket), length: scalar valid
+    count, table_row: [max_pages] this slot's pages. Returns (last-token
+    logits [V] fp32, updated cache). Sets cache.lengths[slot] = length.
+    """
+    t = tokens.shape[0]
+    inv_freq = precompute_rope(cfg.head_dim_, cfg.rope_theta, cfg.rope_scaling)
+    x = params["embed"][tokens][None]  # [1, T, E]
+    pos = jnp.arange(t, dtype=jnp.int32)[None]
+    seq_lens = length[None]
+
+    def layer(x, xs):
+        lp, k_pages, v_pages = xs
+        hx = rms_norm(x, lp["attn_norm"], cfg.rms_eps)
+        q, k, v = _qkv(cfg, lp, hx)
+        q = apply_rope(q, pos, inv_freq)
+        k = apply_rope(k, pos, inv_freq)
+        k_pages, v_pages = write_prefill(
+            k_pages, v_pages, k[0], v[0], table_row,
+            jnp.int32(0), length, cache.page_size,
+        )
+        attn = attention_prefill(q, k, v, seq_lens).reshape(1, t, -1)
+        x = x + jnp.dot(attn, lp["wo"], precision=_precision(x))
+        hx = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
+        return x + _mlp(lp, hx), (k_pages, v_pages)
+
+    x, (k_new, v_new) = jax.lax.scan(layer, x, (params["layers"], cache.k, cache.v))
+    x = rms_norm(x, params["final_norm"], cfg.rms_eps)
+    # last *valid* token's logits
+    last = x[0, jnp.maximum(length - 1, 0)]
+    logits = _unembed(cfg, params, last)
+
+    cache = PagedKVCache(
+        k=k_new, v=v_new,
+        page_table=cache.page_table.at[slot].set(table_row),
+        lengths=cache.lengths.at[slot].set(length),
+        page_size=cache.page_size,
+    )
+    return logits, cache
+
+
+def decode_step(
+    params: Params,
+    cfg: ModelConfig,
+    tokens: jnp.ndarray,
+    cache: PagedKVCache,
+    active: jnp.ndarray,
+) -> tuple[jnp.ndarray, PagedKVCache]:
+    """One decode step for ALL slots. tokens: [S] (last sampled token per
+    slot), active: [S] bool. Returns (logits [S, V] fp32, updated cache
+    with lengths advanced for active slots).
+    """
+    s = tokens.shape[0]
+    inv_freq = precompute_rope(cfg.head_dim_, cfg.rope_theta, cfg.rope_scaling)
+    x = params["embed"][tokens]  # [S, E]
+    positions = cache.lengths  # new token's position per slot
+    new_lengths = cache.lengths + active.astype(jnp.int32)
+
+    def layer(x, xs):
+        lp, k_pages, v_pages = xs
+        hx = rms_norm(x, lp["attn_norm"], cfg.rms_eps)
+        q, k, v = _qkv(cfg, lp, hx)  # q: [S, H, D] (T-less), k/v: [S, KVH, D]
+        q = apply_rope(q[:, None], positions[:, None], inv_freq)[:, 0]
+        k = apply_rope(k[:, None], positions[:, None], inv_freq)[:, 0]
+        k_pages, v_pages = write_decode(
+            k_pages, v_pages, k, v, cache.page_table, positions, active,
+            cache.page_size,
+        )
+        attn = paged_attention_decode(
+            q, k_pages, v_pages, cache.page_table, new_lengths, cache.page_size
+        ).reshape(s, -1)
+        x = x + jnp.dot(attn, lp["wo"], precision=_precision(x))
+        hx = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
+        return x + _mlp(lp, hx), (k_pages, v_pages)
+
+    x, (k_new, v_new) = jax.lax.scan(layer, x, (params["layers"], cache.k, cache.v))
+    x = rms_norm(x, params["final_norm"], cfg.rms_eps)
+    logits = _unembed(cfg, params, x)
+
+    cache = PagedKVCache(
+        k=k_new, v=v_new, page_table=cache.page_table,
+        lengths=new_lengths, page_size=cache.page_size,
+    )
+    return logits, cache
+
+
+# ---------------------------------------------------------------------------
+# HF weight conversion (layout contract with transformers LlamaForCausalLM)
+# ---------------------------------------------------------------------------
+
+def convert_hf_state_dict(cfg: ModelConfig, sd: dict[str, Any], dtype=jnp.bfloat16) -> Params:
+    """HF `LlamaForCausalLM.state_dict()`-style mapping → our pytree.
+
+    Accepts numpy/torch tensors (anything np.asarray handles). HF stores
+    projections as [out, in]; we keep [in, out] so the forward is x @ W.
+    """
+    import numpy as np
+
+    def get(name):
+        t = sd[name]
+        if hasattr(t, "detach"):
+            t = t.detach().to("cpu").float().numpy()
+        return np.asarray(t)
+
+    L = cfg.num_layers
+
+    def stack(fmt, transpose=True):
+        ws = [get(fmt.format(i)) for i in range(L)]
+        ws = [w.T if transpose else w for w in ws]
+        return jnp.asarray(np.stack(ws), dtype)
+
+    params: Params = {
+        "embed": jnp.asarray(get("model.embed_tokens.weight"), dtype),
+        "layers": {
+            "attn_norm": stack("model.layers.{}.input_layernorm.weight", transpose=False),
+            "wq": stack("model.layers.{}.self_attn.q_proj.weight"),
+            "wk": stack("model.layers.{}.self_attn.k_proj.weight"),
+            "wv": stack("model.layers.{}.self_attn.v_proj.weight"),
+            "wo": stack("model.layers.{}.self_attn.o_proj.weight"),
+            "mlp_norm": stack("model.layers.{}.post_attention_layernorm.weight", transpose=False),
+            "w_gate": stack("model.layers.{}.mlp.gate_proj.weight"),
+            "w_up": stack("model.layers.{}.mlp.up_proj.weight"),
+            "w_down": stack("model.layers.{}.mlp.down_proj.weight"),
+        },
+        "final_norm": jnp.asarray(get("model.norm.weight"), dtype),
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = jnp.asarray(get("lm_head.weight").T, dtype)
+    return params

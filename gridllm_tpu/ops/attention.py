@@ -1,0 +1,97 @@
+"""Attention: causal prefill and paged decode.
+
+Pure-jnp reference implementations — correct on CPU and TPU, numerically
+the oracle for the Pallas kernels in `ops/pallas_kernels.py`. Softmax is
+computed in fp32 regardless of input dtype (bf16 accumulation loses real
+accuracy at long context).
+
+GQA convention: q has H heads, k/v have KVH heads, H % KVH == 0; kv heads
+are logically repeated H//KVH times (implemented via reshape-grouping, no
+materialized repeat).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from gridllm_tpu.ops.kvcache import gather_kv
+
+_NEG_INF = -1e30
+
+
+def attention_prefill(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    seq_lens: jnp.ndarray,
+) -> jnp.ndarray:
+    """Causal self-attention over one self-contained chunk (whole prompt).
+
+    q: [B, T, H, D]; k/v: [B, T, KVH, D]; seq_lens: [B] valid tokens
+    (padding keys masked out). Chunked prefill against an existing cached
+    prefix is NOT handled here — that variant must read prefix K/V from the
+    page pool and will land with the Pallas kernels. Returns [B, T, H, D].
+    """
+    b, t, h, d = q.shape
+    kvh = k.shape[2]
+    g = h // kvh
+    scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32))
+
+    qf = q.astype(jnp.float32).reshape(b, t, kvh, g, d)
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+
+    # [B, KVH, G, Tq, Tk]
+    logits = jnp.einsum("btkgd,bskd->bkgts", qf, kf, precision=jax.lax.Precision.HIGHEST) * scale
+
+    q_pos = jnp.arange(t)[:, None]  # [Tq, 1]
+    k_pos = jnp.arange(t)[None, :]  # [1, Tk]
+    causal = q_pos >= k_pos
+    valid = k_pos < seq_lens[:, None, None, None, None]
+    mask = causal[None, None, None] & valid
+    logits = jnp.where(mask, logits, _NEG_INF)
+
+    probs = jnp.exp(logits - logits.max(axis=-1, keepdims=True))
+    probs = probs / probs.sum(axis=-1, keepdims=True)
+    out = jnp.einsum("bkgts,bskd->btkgd", probs, vf, precision=jax.lax.Precision.HIGHEST)
+    return out.reshape(b, t, h, d).astype(q.dtype)
+
+
+def paged_attention_decode(
+    q: jnp.ndarray,
+    k_pages: jnp.ndarray,
+    v_pages: jnp.ndarray,
+    page_table: jnp.ndarray,
+    lengths: jnp.ndarray,
+    page_size: int,
+) -> jnp.ndarray:
+    """One-token-per-slot decode attention against the paged cache.
+
+    q: [S, H, D] (the single new token per slot, post-rope);
+    k_pages/v_pages: [P, page_size, KVH, D] (one layer's pool);
+    page_table: [S, max_pages]; lengths: [S] valid tokens per slot
+    *including* the current token (already written to the cache).
+    Returns [S, H, D].
+
+    Reference implementation: materializes each slot's max context via
+    gather. The Pallas kernel (ops/pallas_kernels.py) streams only valid
+    pages instead.
+    """
+    s, h, d = q.shape
+    kvh = k_pages.shape[2]
+    g = h // kvh
+    scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32))
+
+    def one_slot(qi, row, ln):
+        ks, vs = gather_kv(k_pages, v_pages, row, page_size)  # [N, KVH, D]
+        qf = qi.astype(jnp.float32).reshape(kvh, g, d)
+        logits = jnp.einsum("kgd,nkd->kgn", qf, ks.astype(jnp.float32), precision=jax.lax.Precision.HIGHEST) * scale
+        valid = jnp.arange(ks.shape[0]) < ln
+        logits = jnp.where(valid[None, None, :], logits, _NEG_INF)
+        probs = jnp.exp(logits - logits.max(axis=-1, keepdims=True))
+        probs = probs / probs.sum(axis=-1, keepdims=True)
+        return jnp.einsum("kgn,nkd->kgd", probs, vs.astype(jnp.float32), precision=jax.lax.Precision.HIGHEST).reshape(h, d)
+
+    out = jax.vmap(one_slot)(q, page_table, lengths)
+    return out.astype(q.dtype)

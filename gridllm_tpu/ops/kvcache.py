@@ -1,0 +1,194 @@
+"""Paged KV cache.
+
+The reference has no KV cache (Ollama owns it externally; SURVEY.md §0). This
+is the TPU-native replacement per SURVEY.md §5.7/§7 step 5: a single static
+page pool shared by all batch slots, so HBM is sized by total live tokens
+rather than slots × max_seq_len, and shapes stay static under jit.
+
+Layout (per model):
+  k/v: [num_layers, num_pages, page_size, num_kv_heads, head_dim]
+  page_table: [max_slots, max_pages_per_slot] int32 page ids (-1 = unmapped)
+  lengths: [max_slots] int32 tokens stored per slot
+
+Page *allocation* is host-side Python (engine/scheduling concern, cheap,
+O(pages)); device ops only read/scatter through the tables. Page 0 is a real,
+usable page — unmapped entries are -1 and writes to them are dropped
+(scatter mode="drop").
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@partial(
+    jax.tree_util.register_dataclass,
+    data_fields=["k", "v", "page_table", "lengths"],
+    meta_fields=["page_size"],
+)
+@dataclasses.dataclass
+class PagedKVCache:
+    k: jnp.ndarray           # [L, P, page_size, KVH, D]
+    v: jnp.ndarray           # [L, P, page_size, KVH, D]
+    page_table: jnp.ndarray  # [S, max_pages] int32
+    lengths: jnp.ndarray     # [S] int32
+    page_size: int = 128
+
+    @staticmethod
+    def create(
+        num_layers: int,
+        num_pages: int,
+        page_size: int,
+        num_kv_heads: int,
+        head_dim: int,
+        max_slots: int,
+        max_pages_per_slot: int,
+        dtype=jnp.bfloat16,
+    ) -> "PagedKVCache":
+        shape = (num_layers, num_pages, page_size, num_kv_heads, head_dim)
+        return PagedKVCache(
+            k=jnp.zeros(shape, dtype),
+            v=jnp.zeros(shape, dtype),
+            page_table=jnp.full((max_slots, max_pages_per_slot), -1, jnp.int32),
+            lengths=jnp.zeros((max_slots,), jnp.int32),
+            page_size=page_size,
+        )
+
+    @property
+    def num_layers(self) -> int:
+        return self.k.shape[0]
+
+    @property
+    def max_slots(self) -> int:
+        return self.page_table.shape[0]
+
+    @property
+    def max_context(self) -> int:
+        return self.page_table.shape[1] * self.page_size
+
+
+def write_prefill(
+    k_pages: jnp.ndarray,
+    v_pages: jnp.ndarray,
+    k_new: jnp.ndarray,
+    v_new: jnp.ndarray,
+    table_row: jnp.ndarray,
+    start: jnp.ndarray,
+    length: jnp.ndarray,
+    page_size: int,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Scatter a prefill chunk for ONE slot into the (single-layer) page pool.
+
+    k_pages/v_pages: [P, page_size, KVH, D] — one layer's pool.
+    k_new/v_new: [T, KVH, D] (T = padded bucket length).
+    table_row: [max_pages] page ids for this slot.
+    start: scalar — absolute position of k_new[0] (0 for fresh prompts,
+    cached length for chunked prefill). length: scalar — valid tokens in
+    k_new; positions >= length are dropped.
+    """
+    t = jnp.arange(k_new.shape[0], dtype=jnp.int32)
+    pos = start + t
+    page_idx = jnp.where(t < length, table_row[pos // page_size], -1)
+    offset = pos % page_size
+    k_pages = k_pages.at[page_idx, offset].set(k_new, mode="drop")
+    v_pages = v_pages.at[page_idx, offset].set(v_new, mode="drop")
+    return k_pages, v_pages
+
+
+def write_decode(
+    k_pages: jnp.ndarray,
+    v_pages: jnp.ndarray,
+    k_new: jnp.ndarray,
+    v_new: jnp.ndarray,
+    page_table: jnp.ndarray,
+    positions: jnp.ndarray,
+    active: jnp.ndarray,
+    page_size: int,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Scatter one new token per slot into the (single-layer) page pool.
+
+    k_new/v_new: [S, KVH, D]; positions: [S] absolute write position per
+    slot; active: [S] bool — inactive slots are dropped.
+    """
+    s = jnp.arange(page_table.shape[0], dtype=jnp.int32)
+    page_idx = jnp.where(active, page_table[s, positions // page_size], -1)
+    offset = positions % page_size
+    k_pages = k_pages.at[page_idx, offset].set(k_new, mode="drop")
+    v_pages = v_pages.at[page_idx, offset].set(v_new, mode="drop")
+    return k_pages, v_pages
+
+
+def gather_kv(
+    k_pages: jnp.ndarray,
+    v_pages: jnp.ndarray,
+    table_row: jnp.ndarray,
+    page_size: int,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Materialize one slot's K/V [max_pages*page_size, KVH, D] from the pool.
+
+    Reference implementation (CPU-testable); the Pallas paged-attention
+    kernel reads pages in place instead of materializing.
+    """
+    pages_k = k_pages[jnp.maximum(table_row, 0)]  # [maxp, ps, KVH, D]
+    pages_v = v_pages[jnp.maximum(table_row, 0)]
+    kvh, d = k_pages.shape[-2], k_pages.shape[-1]
+    n = table_row.shape[0] * page_size
+    return pages_k.reshape(n, kvh, d), pages_v.reshape(n, kvh, d)
+
+
+class PageAllocator:
+    """Host-side free-list page allocator (plain Python, not traced).
+
+    Owns which pages back which slot; the device only sees the resulting
+    int32 tables. O(1) alloc/free per page.
+    """
+
+    def __init__(self, num_pages: int, page_size: int, max_pages_per_slot: int):
+        self.page_size = page_size
+        self.max_pages_per_slot = max_pages_per_slot
+        self._free: list[int] = list(range(num_pages - 1, -1, -1))
+        self._owned: dict[int, list[int]] = {}
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    def pages_for(self, num_tokens: int) -> int:
+        return -(-num_tokens // self.page_size)
+
+    def can_fit(self, num_tokens: int) -> bool:
+        """True iff a FRESH slot could ever hold num_tokens: within both the
+        per-slot page cap (permanent) and the current free pool (transient)."""
+        need = self.pages_for(num_tokens)
+        return need <= self.max_pages_per_slot and need <= len(self._free)
+
+    def fits_slot_cap(self, num_tokens: int) -> bool:
+        """Permanent-capacity check only (retrying can't fix a False)."""
+        return self.pages_for(num_tokens) <= self.max_pages_per_slot
+
+    def alloc(self, slot: int, num_tokens: int) -> list[int] | None:
+        """Ensure `slot` owns enough pages for `num_tokens` total tokens.
+        Returns the slot's full page list, or None if the pool is exhausted
+        (caller must preempt/queue — mirrors the scheduler holding jobs when
+        no worker has capacity, reference JobScheduler.ts:176-204)."""
+        owned = self._owned.setdefault(slot, [])
+        need = self.pages_for(num_tokens) - len(owned)
+        if need > len(self._free):
+            return None
+        if need > self.max_pages_per_slot - len(owned):
+            return None
+        for _ in range(max(0, need)):
+            owned.append(self._free.pop())
+        return owned
+
+    def free(self, slot: int) -> None:
+        for p in self._owned.pop(slot, []):
+            self._free.append(p)
+
+    def table_row(self, slot: int) -> list[int]:
+        owned = self._owned.get(slot, [])
+        return owned + [-1] * (self.max_pages_per_slot - len(owned))

@@ -1,0 +1,81 @@
+"""Core transformer primitives: RMSNorm and rotary embeddings.
+
+No reference analogue (the reference has no compute path of its own —
+SURVEY.md §0); conventions follow the HF Llama formulation (split-half
+rotate, norm in fp32) so HF checkpoints load bit-compatibly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+
+import jax.numpy as jnp
+
+
+def rms_norm(x: jnp.ndarray, weight: jnp.ndarray, eps: float = 1e-5) -> jnp.ndarray:
+    """RMSNorm computed in fp32, cast back to the input dtype."""
+    dtype = x.dtype
+    x = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(x), axis=-1, keepdims=True)
+    x = x * jnp.reciprocal(jnp.sqrt(var + eps))
+    return (x * weight.astype(jnp.float32)).astype(dtype)
+
+
+@dataclasses.dataclass(frozen=True)
+class RopeScaling:
+    """Llama-3-style NTK rope rescaling (HF `rope_scaling` dict)."""
+
+    factor: float = 8.0
+    low_freq_factor: float = 1.0
+    high_freq_factor: float = 4.0
+    original_max_position_embeddings: int = 8192
+
+
+def precompute_rope(
+    head_dim: int,
+    theta: float = 10000.0,
+    scaling: RopeScaling | None = None,
+) -> jnp.ndarray:
+    """Inverse frequencies [head_dim//2], fp32, with optional llama3 scaling."""
+    inv_freq = 1.0 / (
+        theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim)
+    )
+    if scaling is not None:
+        low_wavelen = scaling.original_max_position_embeddings / scaling.low_freq_factor
+        high_wavelen = scaling.original_max_position_embeddings / scaling.high_freq_factor
+        wavelen = 2.0 * math.pi / inv_freq
+        # smooth interpolation between scaled and unscaled bands
+        smooth = (scaling.original_max_position_embeddings / wavelen - scaling.low_freq_factor) / (
+            scaling.high_freq_factor - scaling.low_freq_factor
+        )
+        smooth = jnp.clip(smooth, 0.0, 1.0)
+        scaled = inv_freq / scaling.factor
+        inv_freq = jnp.where(
+            wavelen > low_wavelen,
+            scaled,
+            jnp.where(wavelen < high_wavelen, inv_freq, (1.0 - smooth) * scaled + smooth * inv_freq),
+        )
+    return inv_freq
+
+
+def apply_rope(
+    x: jnp.ndarray,
+    positions: jnp.ndarray,
+    inv_freq: jnp.ndarray,
+) -> jnp.ndarray:
+    """Rotate `x` [..., T, H, D] by position-dependent angles.
+
+    Uses the HF split-half convention: the first D/2 lanes pair with the
+    last D/2 (`rotate_half`), NOT interleaved pairs — this is what HF Llama
+    checkpoints are trained with.
+    `positions`: [..., T] int32 absolute positions.
+    """
+    dtype = x.dtype
+    angles = positions[..., :, None].astype(jnp.float32) * inv_freq  # [..., T, D/2]
+    cos = jnp.cos(angles)[..., :, None, :]  # [..., T, 1, D/2]
+    sin = jnp.sin(angles)[..., :, None, :]
+    x = x.astype(jnp.float32)
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(dtype)

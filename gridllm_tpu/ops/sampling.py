@@ -1,0 +1,110 @@
+"""Batched, jit-once token sampling.
+
+Implements the Ollama sampler option surface the reference forwards opaquely
+(reference: server/src/routes/ollama.ts:26-48 — temperature, top_k, top_p,
+min_p, seed, repeat_penalty; OllamaService.ts:197-226 passes them through to
+the external engine). Here they are *device-side per-slot arrays*, so one
+compiled sampler serves every concurrent request in the continuous batch —
+no recompiles when options differ across slots.
+
+Determinism contract (Ollama `seed` semantics): token i of a request with
+seed s depends only on (s, i) — threefry fold_in chain, independent of which
+slot the request landed in or what else is batched.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+# Sampling operates on the static top-K logits (full-vocab sort per step is
+# MXU-hostile); mass outside the top 64 is negligible for every supported
+# sampler setting (top_k caps at TOPK; top_p tail beyond 64 tokens ~0).
+TOPK = 64
+
+
+@partial(
+    jax.tree_util.register_dataclass,
+    data_fields=["temperature", "top_k", "top_p", "min_p", "repeat_penalty", "seed", "step"],
+    meta_fields=[],
+)
+@dataclasses.dataclass
+class SamplingParams:
+    """Per-slot sampler state, all arrays of shape [S]."""
+
+    temperature: jnp.ndarray  # f32; <=0 → greedy
+    top_k: jnp.ndarray        # i32; <=0 → disabled
+    top_p: jnp.ndarray        # f32; >=1 → disabled
+    min_p: jnp.ndarray        # f32; <=0 → disabled
+    repeat_penalty: jnp.ndarray  # f32; 1.0 → disabled
+    seed: jnp.ndarray         # i32 per-request seed
+    step: jnp.ndarray         # i32 tokens generated so far (drives the rng chain)
+
+    @staticmethod
+    def defaults(max_slots: int) -> "SamplingParams":
+        s = max_slots
+        return SamplingParams(
+            temperature=jnp.full((s,), 0.8, jnp.float32),
+            top_k=jnp.full((s,), 40, jnp.int32),
+            top_p=jnp.full((s,), 0.9, jnp.float32),
+            min_p=jnp.zeros((s,), jnp.float32),
+            repeat_penalty=jnp.full((s,), 1.1, jnp.float32),
+            seed=jnp.zeros((s,), jnp.int32),
+            step=jnp.zeros((s,), jnp.int32),
+        )
+
+
+def _slot_gumbel(seed: jnp.ndarray, step: jnp.ndarray, k: int) -> jnp.ndarray:
+    key = jax.random.fold_in(jax.random.PRNGKey(seed), step)
+    return jax.random.gumbel(key, (k,), jnp.float32)
+
+
+def sample_tokens(
+    logits: jnp.ndarray,
+    params: SamplingParams,
+    token_counts: jnp.ndarray | None = None,
+) -> jnp.ndarray:
+    """Sample one token per slot. logits: [S, V] → [S] int32.
+
+    token_counts ([S, V] int32, optional): occurrence counts of tokens in
+    each slot's context, for repeat_penalty (CTRL-style: positive logits
+    divided, negative multiplied).
+    """
+    logits = logits.astype(jnp.float32)
+
+    if token_counts is not None:
+        pen = params.repeat_penalty[:, None]
+        seen = token_counts > 0
+        logits = jnp.where(
+            seen, jnp.where(logits > 0, logits / pen, logits * pen), logits
+        )
+
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    topk = min(TOPK, logits.shape[-1])
+    vals, idx = jax.lax.top_k(logits, topk)  # [S, topk], sorted desc
+
+    j = jnp.arange(topk)[None, :]
+    k_eff = jnp.where(params.top_k <= 0, topk, jnp.minimum(params.top_k, topk))
+    keep = j < k_eff[:, None]
+
+    # Ollama/llama.cpp sampler-chain order: truncation (top_k → top_p →
+    # min_p) runs on UNSCALED probabilities; temperature rescales only the
+    # final distribution the draw is taken from.
+    masked = jnp.where(keep, vals, -jnp.inf)
+    probs = jax.nn.softmax(masked, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    keep &= (cum - probs) < params.top_p[:, None]  # token starts inside the p-mass
+    keep &= probs >= params.min_p[:, None] * probs[:, :1]
+    keep = keep.at[:, 0].set(True)  # never mask the argmax
+
+    temp = jnp.maximum(params.temperature, 1e-6)[:, None]
+    scaled = vals / temp
+    gumbel = jax.vmap(lambda s, t: _slot_gumbel(s, t, topk))(params.seed, params.step)
+    choice = jnp.argmax(jnp.where(keep, scaled + gumbel, -jnp.inf), axis=-1)
+    sampled = jnp.take_along_axis(idx, choice[:, None], axis=-1)[:, 0].astype(jnp.int32)
+
+    return jnp.where(params.temperature <= 0.0, greedy, sampled)

@@ -8,12 +8,22 @@ anywhere in the test process.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
 # Keep test compiles fast & deterministic
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+# The environment's TPU-relay plugin (sitecustomize → axon.register) forces
+# jax_platforms="axon,cpu" via jax.config at interpreter startup, which makes
+# the first backends() call initialize the remote TPU client — wrong (and
+# hang-prone) for unit tests. Force the config back to CPU-only BEFORE any
+# test imports jax. The env var alone is not enough: register() overrides it
+# at the config layer.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import asyncio  # noqa: E402
 

@@ -1,0 +1,261 @@
+"""Unit tests for the compute primitives (CPU, fp32 where it matters).
+
+SURVEY.md §4: the reference has zero unit tests; the rebuild adds numerics
+tests the reference never could (its compute lived in Ollama).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gridllm_tpu.ops import (
+    PagedKVCache,
+    RopeScaling,
+    SamplingParams,
+    apply_rope,
+    attention_prefill,
+    paged_attention_decode,
+    precompute_rope,
+    rms_norm,
+    sample_tokens,
+)
+from gridllm_tpu.ops.kvcache import PageAllocator, write_decode, write_prefill
+
+
+def ref_attention(q, k, v, causal=True):
+    """Dense fp32 oracle, GQA via explicit repeat."""
+    t, h, d = q.shape
+    kvh = k.shape[1]
+    k = np.repeat(k, h // kvh, axis=1)
+    v = np.repeat(v, h // kvh, axis=1)
+    logits = np.einsum("thd,shd->hts", q, k) / np.sqrt(d)
+    if causal:
+        mask = np.tril(np.ones((t, t), bool))
+        logits = np.where(mask[None], logits, -1e30)
+    p = np.exp(logits - logits.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    return np.einsum("hts,shd->thd", p, v)
+
+
+class TestLayers:
+    def test_rms_norm_matches_formula(self):
+        x = np.random.RandomState(0).randn(4, 16).astype(np.float32)
+        w = np.random.RandomState(1).rand(16).astype(np.float32)
+        got = rms_norm(jnp.asarray(x), jnp.asarray(w), eps=1e-6)
+        want = x / np.sqrt((x**2).mean(-1, keepdims=True) + 1e-6) * w
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def test_rope_rotation_preserves_norm(self):
+        inv = precompute_rope(64)
+        x = jnp.asarray(np.random.RandomState(0).randn(2, 5, 4, 64).astype(np.float32))
+        pos = jnp.broadcast_to(jnp.arange(5), (2, 5))
+        y = apply_rope(x, pos, inv)
+        np.testing.assert_allclose(
+            np.linalg.norm(np.asarray(y), axis=-1),
+            np.linalg.norm(np.asarray(x), axis=-1),
+            rtol=1e-4,
+        )
+
+    def test_rope_position_zero_is_identity(self):
+        inv = precompute_rope(32)
+        x = jnp.asarray(np.random.RandomState(0).randn(1, 1, 2, 32).astype(np.float32))
+        y = apply_rope(x, jnp.zeros((1, 1), jnp.int32), inv)
+        np.testing.assert_allclose(y, x, atol=1e-6)
+
+    def test_rope_relative_property(self):
+        # <rope(q,m), rope(k,n)> depends only on m-n
+        inv = precompute_rope(64)
+        rs = np.random.RandomState(0)
+        q = jnp.asarray(rs.randn(1, 1, 1, 64).astype(np.float32))
+        k = jnp.asarray(rs.randn(1, 1, 1, 64).astype(np.float32))
+
+        def dot(m, n):
+            qm = apply_rope(q, jnp.full((1, 1), m, jnp.int32), inv)
+            kn = apply_rope(k, jnp.full((1, 1), n, jnp.int32), inv)
+            return float(jnp.sum(qm * kn))
+
+        assert dot(5, 3) == pytest.approx(dot(12, 10), rel=1e-4)
+
+    def test_llama3_scaling_changes_low_freqs_only(self):
+        base = precompute_rope(128, theta=500000.0)
+        scaled = precompute_rope(128, theta=500000.0, scaling=RopeScaling())
+        base, scaled = np.asarray(base), np.asarray(scaled)
+        assert np.allclose(base[:8], scaled[:8])  # high-freq band untouched
+        assert np.allclose(base[-4:] / scaled[-4:], 8.0, rtol=1e-3)  # low-freq /factor
+
+
+class TestAttention:
+    def test_prefill_matches_dense_oracle(self):
+        rs = np.random.RandomState(0)
+        t, h, kvh, d = 7, 8, 2, 16
+        q = rs.randn(1, t, h, d).astype(np.float32)
+        k = rs.randn(1, t, kvh, d).astype(np.float32)
+        v = rs.randn(1, t, kvh, d).astype(np.float32)
+        got = attention_prefill(
+            jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), jnp.array([t])
+        )
+        want = ref_attention(q[0], k[0], v[0])
+        np.testing.assert_allclose(np.asarray(got)[0], want, rtol=1e-4, atol=1e-5)
+
+    def test_prefill_padding_ignored(self):
+        rs = np.random.RandomState(1)
+        t, real = 8, 5
+        q = rs.randn(1, t, 4, 8).astype(np.float32)
+        k = rs.randn(1, t, 4, 8).astype(np.float32)
+        v = rs.randn(1, t, 4, 8).astype(np.float32)
+        full = attention_prefill(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), jnp.array([real]))
+        # zero-out padding kv → same result for the first `real` queries
+        k2, v2 = k.copy(), v.copy()
+        k2[:, real:] = 99.0
+        v2[:, real:] = 99.0
+        poisoned = attention_prefill(jnp.asarray(q), jnp.asarray(k2), jnp.asarray(v2), jnp.array([real]))
+        np.testing.assert_allclose(
+            np.asarray(full)[:, :real], np.asarray(poisoned)[:, :real], rtol=1e-5
+        )
+
+    def test_paged_decode_matches_prefill_last_token(self):
+        """Prefill T-1 tokens into the cache, decode token T → must equal
+        row T-1 of full-prefill attention."""
+        rs = np.random.RandomState(2)
+        t, h, kvh, d, ps = 10, 4, 2, 16, 4
+        k_all = rs.randn(t, kvh, d).astype(np.float32)
+        v_all = rs.randn(t, kvh, d).astype(np.float32)
+        q_all = rs.randn(t, h, d).astype(np.float32)
+
+        cache = PagedKVCache.create(1, 8, ps, kvh, d, max_slots=2, max_pages_per_slot=4, dtype=jnp.float32)
+        alloc = PageAllocator(8, ps, 4)
+        alloc.alloc(0, t)
+        row = jnp.asarray(alloc.table_row(0), jnp.int32)
+
+        kp, vp = write_prefill(
+            cache.k[0], cache.v[0],
+            jnp.asarray(k_all), jnp.asarray(v_all),
+            row, jnp.int32(0), jnp.int32(t), ps,
+        )
+        table = cache.page_table.at[0].set(row)
+        q_last = jnp.asarray(q_all[t - 1 : t])  # [1, H, D] → use as slot 0
+        q_batch = jnp.concatenate([q_last, jnp.zeros_like(q_last)], axis=0)
+        out = paged_attention_decode(
+            q_batch, kp, vp, table, jnp.array([t, 0], jnp.int32), ps
+        )
+        want = ref_attention(q_all, k_all, v_all)[t - 1]
+        np.testing.assert_allclose(np.asarray(out)[0], want, rtol=1e-4, atol=1e-5)
+
+    def test_write_decode_then_attend(self):
+        rs = np.random.RandomState(3)
+        kvh, d, ps = 2, 8, 4
+        cache = PagedKVCache.create(1, 4, ps, kvh, d, max_slots=1, max_pages_per_slot=2, dtype=jnp.float32)
+        alloc = PageAllocator(4, ps, 2)
+        ks, vs = [], []
+        kp, vp = cache.k[0], cache.v[0]
+        table = cache.page_table
+        for i in range(6):
+            alloc.alloc(0, i + 1)
+            table = table.at[0].set(jnp.asarray(alloc.table_row(0), jnp.int32))
+            kn = rs.randn(1, kvh, d).astype(np.float32)
+            vn = rs.randn(1, kvh, d).astype(np.float32)
+            ks.append(kn[0]); vs.append(vn[0])
+            kp, vp = write_decode(
+                kp, vp, jnp.asarray(kn), jnp.asarray(vn), table,
+                jnp.array([i], jnp.int32), jnp.array([True]), ps,
+            )
+        q = rs.randn(1, 4, d).astype(np.float32)
+        out = paged_attention_decode(jnp.asarray(q), kp, vp, table, jnp.array([6], jnp.int32), ps)
+        want = ref_attention(
+            q, np.stack(ks), np.stack(vs), causal=False
+        )  # single query attends all 6
+        np.testing.assert_allclose(np.asarray(out)[0], want[0], rtol=1e-4, atol=1e-5)
+
+
+class TestPageAllocator:
+    def test_alloc_grow_free_cycle(self):
+        a = PageAllocator(num_pages=4, page_size=8, max_pages_per_slot=3)
+        assert a.alloc(0, 8) is not None and a.free_pages == 3
+        assert a.alloc(0, 9) is not None and a.free_pages == 2  # grew by one page
+        assert a.alloc(1, 17) is None  # needs 3, only 2 free
+        a.free(0)
+        assert a.free_pages == 4
+        assert a.alloc(1, 17) is not None
+
+    def test_per_slot_cap(self):
+        a = PageAllocator(num_pages=10, page_size=4, max_pages_per_slot=2)
+        assert a.alloc(0, 9) is None  # 3 pages > per-slot cap
+        assert a.alloc(0, 8) is not None
+
+    def test_table_row_padded(self):
+        a = PageAllocator(num_pages=4, page_size=8, max_pages_per_slot=3)
+        a.alloc(0, 10)
+        row = a.table_row(0)
+        assert len(row) == 3 and row.count(-1) == 1
+
+
+class TestSampling:
+    def _params(self, **kw):
+        p = SamplingParams.defaults(2)
+        for k, v in kw.items():
+            setattr(p, k, jnp.asarray(v))
+        return p
+
+    def test_greedy_when_temperature_zero(self):
+        logits = jnp.asarray(np.random.RandomState(0).randn(2, 100).astype(np.float32))
+        p = self._params(temperature=[0.0, 0.0], repeat_penalty=[1.0, 1.0])
+        tok = sample_tokens(logits, p)
+        np.testing.assert_array_equal(np.asarray(tok), np.argmax(np.asarray(logits), -1))
+
+    def test_seed_determinism_and_step_variation(self):
+        logits = jnp.asarray(np.random.RandomState(1).randn(2, 50).astype(np.float32))
+        p1 = self._params(temperature=[1.5, 1.5], seed=[7, 7], step=[0, 0])
+        a = sample_tokens(logits, p1)
+        b = sample_tokens(logits, p1)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))  # same (seed, step)
+        # same params, advancing step → the rng chain must eventually differ
+        many_same = all(
+            np.array_equal(
+                np.asarray(sample_tokens(logits, self._params(temperature=[1.5, 1.5], seed=[7, 7], step=[s, s]))),
+                np.asarray(a),
+            )
+            for s in range(1, 8)
+        )
+        assert not many_same  # steps advance the rng chain
+
+    def test_top_k_one_is_greedy(self):
+        logits = jnp.asarray(np.random.RandomState(2).randn(2, 64).astype(np.float32))
+        p = self._params(temperature=[2.0, 2.0], top_k=[1, 1], repeat_penalty=[1.0, 1.0])
+        tok = sample_tokens(logits, p)
+        np.testing.assert_array_equal(np.asarray(tok), np.argmax(np.asarray(logits), -1))
+
+    def test_top_p_tiny_is_greedy(self):
+        logits = jnp.asarray(np.random.RandomState(3).randn(2, 64).astype(np.float32))
+        p = self._params(temperature=[2.0, 2.0], top_p=[1e-6, 1e-6], repeat_penalty=[1.0, 1.0])
+        tok = sample_tokens(logits, p)
+        np.testing.assert_array_equal(np.asarray(tok), np.argmax(np.asarray(logits), -1))
+
+    def test_repeat_penalty_suppresses_seen_token(self):
+        # token 0 hugely preferred but heavily penalized and already seen
+        logits = np.full((1, 10), -5.0, np.float32)
+        logits[0, 0] = 2.0
+        logits[0, 1] = 1.9
+        counts = np.zeros((1, 10), np.int32)
+        counts[0, 0] = 3
+        p = SamplingParams.defaults(1)
+        p.temperature = jnp.asarray([0.0])
+        p.repeat_penalty = jnp.asarray([50.0])
+        tok = sample_tokens(jnp.asarray(logits), p, jnp.asarray(counts))
+        assert int(tok[0]) == 1
+
+    def test_sampling_respects_distribution(self):
+        # two-token distribution ~[0.88, 0.12] at temp 1 — frequencies should track
+        logits = jnp.asarray([[2.0, 0.0] + [-30.0] * 62], jnp.float32)
+        sampler = jax.jit(sample_tokens)
+        n = 200
+        hits = 0
+        for s in range(n):
+            p = SamplingParams.defaults(1)
+            p.temperature = jnp.asarray([1.0])
+            p.top_k = jnp.asarray([0])
+            p.top_p = jnp.asarray([1.0])
+            p.repeat_penalty = jnp.asarray([1.0])
+            p.step = jnp.asarray([s])
+            hits += int(sampler(logits, p)[0] == 0)
+        assert 0.75 * n < hits < 0.99 * n
