@@ -36,6 +36,15 @@ def _precision(x: jnp.ndarray):
     return jax.lax.Precision.HIGHEST if x.dtype == jnp.float32 else None
 
 
+def _check_supported(cfg: ModelConfig) -> None:
+    # Loud failure beats silently-wrong attention for knobs the ops layer
+    # doesn't implement yet (ModelConfig carries them for future families).
+    if cfg.attn_logit_softcap:
+        raise NotImplementedError(f"{cfg.name}: attn_logit_softcap")
+    if cfg.sliding_window:
+        raise NotImplementedError(f"{cfg.name}: sliding_window")
+
+
 def init_params(cfg: ModelConfig, key: jax.Array, dtype=jnp.bfloat16) -> Params:
     """Random-init params (tests + synthetic bench; real loads go through
     engine/loader.py)."""
@@ -97,6 +106,7 @@ def forward(params: Params, cfg: ModelConfig, tokens: jnp.ndarray) -> jnp.ndarra
     The oracle path — golden tests compare this against HF; prefill/decode
     must agree with it (tested in tests/test_models.py).
     """
+    _check_supported(cfg)
     b, t = tokens.shape
     inv_freq = precompute_rope(cfg.head_dim_, cfg.rope_theta, cfg.rope_scaling)
     x = params["embed"][tokens]
@@ -132,6 +142,7 @@ def prefill(
     count, table_row: [max_pages] this slot's pages. Returns (last-token
     logits [V] fp32, updated cache). Sets cache.lengths[slot] = length.
     """
+    _check_supported(cfg)
     t = tokens.shape[0]
     inv_freq = precompute_rope(cfg.head_dim_, cfg.rope_theta, cfg.rope_scaling)
     x = params["embed"][tokens][None]  # [1, T, E]
@@ -179,6 +190,7 @@ def decode_step(
     slot), active: [S] bool. Returns (logits [S, V] fp32, updated cache
     with lengths advanced for active slots).
     """
+    _check_supported(cfg)
     s = tokens.shape[0]
     inv_freq = precompute_rope(cfg.head_dim_, cfg.rope_theta, cfg.rope_scaling)
     x = params["embed"][tokens]  # [S, E]

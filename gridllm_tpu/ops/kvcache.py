@@ -12,8 +12,10 @@ Layout (per model):
 
 Page *allocation* is host-side Python (engine/scheduling concern, cheap,
 O(pages)); device ops only read/scatter through the tables. Page 0 is a real,
-usable page — unmapped entries are -1 and writes to them are dropped
-(scatter mode="drop").
+usable page — unmapped entries are -1; the write paths remap them (and
+inactive slots) to index `num_pages`, which is out of bounds so scatter
+mode="drop" actually drops them (a raw -1 would WRAP to the last page —
+jax negative indexing applies in scatter too).
 """
 
 from __future__ import annotations
@@ -90,9 +92,15 @@ def write_prefill(
     cached length for chunked prefill). length: scalar — valid tokens in
     k_new; positions >= length are dropped.
     """
+    oob = k_pages.shape[0]  # one past the pool: genuinely out of bounds, so
+    # mode="drop" really drops (negative indices would WRAP in jax scatter)
     t = jnp.arange(k_new.shape[0], dtype=jnp.int32)
     pos = start + t
-    page_idx = jnp.where(t < length, table_row[pos // page_size], -1)
+    # capacity guard: past-the-row positions would be CLAMPED by jax gather
+    # to the row's last entry (a real page) — mask them out explicitly
+    in_cap = pos < table_row.shape[0] * page_size
+    mapped = table_row[jnp.minimum(pos // page_size, table_row.shape[0] - 1)]
+    page_idx = jnp.where((t < length) & in_cap & (mapped >= 0), mapped, oob)
     offset = pos % page_size
     k_pages = k_pages.at[page_idx, offset].set(k_new, mode="drop")
     v_pages = v_pages.at[page_idx, offset].set(v_new, mode="drop")
@@ -114,8 +122,12 @@ def write_decode(
     k_new/v_new: [S, KVH, D]; positions: [S] absolute write position per
     slot; active: [S] bool — inactive slots are dropped.
     """
+    oob = k_pages.shape[0]  # see write_prefill: -1 would wrap, oob drops
+    max_pages = page_table.shape[1]
     s = jnp.arange(page_table.shape[0], dtype=jnp.int32)
-    page_idx = jnp.where(active, page_table[s, positions // page_size], -1)
+    in_cap = positions < max_pages * page_size  # gather would clamp, not trap
+    mapped = page_table[s, jnp.minimum(positions // page_size, max_pages - 1)]
+    page_idx = jnp.where(active & in_cap & (mapped >= 0), mapped, oob)
     offset = positions % page_size
     k_pages = k_pages.at[page_idx, offset].set(k_new, mode="drop")
     v_pages = v_pages.at[page_idx, offset].set(v_new, mode="drop")

@@ -35,11 +35,6 @@ def event_loop_policy():
     return asyncio.DefaultEventLoopPolicy()
 
 
-def pytest_collection_modifyitems(config, items):
-    # Auto-mark async tests to run under asyncio via our simple runner.
-    pass
-
-
 # Minimal asyncio test support without pytest-asyncio: run `async def` tests.
 def pytest_pyfunc_call(pyfuncitem):
     import inspect

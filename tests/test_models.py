@@ -1,0 +1,173 @@
+"""Model numerics goldens (SURVEY.md §4 rebuild plan: "numerical golden
+tests for the new JAX engine (logits vs HF reference per layer)").
+
+Two layers of oracle:
+1. `forward` vs transformers' torch implementation on an identical tiny
+   config + identical weights (fp32, CPU) — catches convention drift
+   (rope pairing, norm placement, GQA grouping, weight transposes).
+2. `prefill`+`decode_step` vs `forward` — the paged-cache path must
+   reproduce the cache-free path token-for-token.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from gridllm_tpu.models import llama
+from gridllm_tpu.models.configs import get_config
+from gridllm_tpu.ops.kvcache import PagedKVCache, PageAllocator
+
+CFG = get_config("tiny-llama")
+
+
+@pytest.fixture(scope="module")
+def params_fp32():
+    return llama.init_params(CFG, jax.random.PRNGKey(0), dtype=jnp.float32)
+
+
+def _hf_model(params):
+    torch = pytest.importorskip("torch")
+    from transformers import LlamaForCausalLM
+
+    model = LlamaForCausalLM(CFG.hf_config()).eval()
+    sd = {}
+
+    def put(name, arr, transpose):
+        a = np.asarray(arr, np.float32)
+        sd[name] = torch.from_numpy(a.T.copy() if transpose else a.copy())
+
+    put("model.embed_tokens.weight", params["embed"], False)
+    lp = params["layers"]
+    for i in range(CFG.num_layers):
+        pre = f"model.layers.{i}."
+        put(pre + "input_layernorm.weight", lp["attn_norm"][i], False)
+        put(pre + "self_attn.q_proj.weight", lp["wq"][i], True)
+        put(pre + "self_attn.k_proj.weight", lp["wk"][i], True)
+        put(pre + "self_attn.v_proj.weight", lp["wv"][i], True)
+        put(pre + "self_attn.o_proj.weight", lp["wo"][i], True)
+        put(pre + "post_attention_layernorm.weight", lp["mlp_norm"][i], False)
+        put(pre + "mlp.gate_proj.weight", lp["w_gate"][i], True)
+        put(pre + "mlp.up_proj.weight", lp["w_up"][i], True)
+        put(pre + "mlp.down_proj.weight", lp["w_down"][i], True)
+    put("model.norm.weight", params["final_norm"], False)
+    put("lm_head.weight", params["lm_head"], True)
+    model.load_state_dict(sd)
+    return model, torch
+
+
+def test_forward_matches_hf(params_fp32):
+    model, torch = _hf_model(params_fp32)
+    tokens = np.array([[5, 17, 99, 3, 42, 7, 250, 1]], np.int32)
+    ours = np.asarray(llama.forward(params_fp32, CFG, jnp.asarray(tokens)))
+    with torch.no_grad():
+        theirs = model(torch.from_numpy(tokens).long()).logits.numpy()
+    np.testing.assert_allclose(ours, theirs, rtol=2e-4, atol=2e-4)
+
+
+def test_convert_hf_state_dict_roundtrip(params_fp32):
+    """convert_hf_state_dict(hf_model.state_dict()) reproduces our params."""
+    model, _torch = _hf_model(params_fp32)
+    back = llama.convert_hf_state_dict(CFG, model.state_dict(), dtype=jnp.float32)
+    tokens = jnp.asarray([[9, 8, 7, 6, 5]], jnp.int32)
+    a = llama.forward(params_fp32, CFG, tokens)
+    b = llama.forward(back, CFG, tokens)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-5)
+
+
+def _make_cache(dtype=jnp.float32, page_size=8, num_pages=16, slots=4, maxp=8):
+    cache = PagedKVCache.create(
+        CFG.num_layers, num_pages, page_size, CFG.num_kv_heads, CFG.head_dim_,
+        slots, maxp, dtype=dtype,
+    )
+    alloc = PageAllocator(num_pages, page_size, maxp)
+    return cache, alloc
+
+
+def test_prefill_decode_match_forward(params_fp32):
+    """Greedy continuation via prefill+decode == argmax chain of `forward`."""
+    prompt = [5, 17, 99, 3, 42]
+    n_gen = 6
+    # Oracle: repeatedly run the cache-free forward on the growing sequence.
+    seq = list(prompt)
+    oracle = []
+    for _ in range(n_gen):
+        logits = llama.forward(params_fp32, CFG, jnp.asarray([seq], jnp.int32))
+        nxt = int(jnp.argmax(logits[0, -1]))
+        oracle.append(nxt)
+        seq.append(nxt)
+
+    # Paged path: prefill slot 2 (arbitrary), then decode step by step.
+    cache, alloc = _make_cache()
+    slot = 2
+    bucket = 8  # padded prompt bucket
+    total = len(prompt) + n_gen
+    alloc.alloc(slot, total)
+    row = jnp.asarray(alloc.table_row(slot), jnp.int32)
+    padded = jnp.asarray(prompt + [0] * (bucket - len(prompt)), jnp.int32)
+    logits, cache = llama.prefill(
+        params_fp32, CFG, padded, jnp.int32(len(prompt)), cache,
+        jnp.int32(slot), row,
+    )
+    got = [int(jnp.argmax(logits))]
+    tokens = jnp.zeros((cache.max_slots,), jnp.int32).at[slot].set(got[0])
+    active = jnp.zeros((cache.max_slots,), bool).at[slot].set(True)
+    for _ in range(n_gen - 1):
+        logits, cache = llama.decode_step(params_fp32, CFG, tokens, cache, active)
+        nxt = int(jnp.argmax(logits[slot]))
+        got.append(nxt)
+        tokens = tokens.at[slot].set(nxt)
+    assert got == oracle
+
+
+def test_decode_inactive_slots_untouched(params_fp32):
+    """Inactive slots must not advance lengths or corrupt the pool."""
+    cache, alloc = _make_cache()
+    alloc.alloc(1, 8)
+    row = jnp.asarray(alloc.table_row(1), jnp.int32)
+    padded = jnp.asarray([5, 6, 7, 0, 0, 0, 0, 0], jnp.int32)
+    _, cache = llama.prefill(
+        params_fp32, CFG, padded, jnp.int32(3), cache, jnp.int32(1), row
+    )
+    lengths_before = np.asarray(cache.lengths)
+    tokens = jnp.zeros((cache.max_slots,), jnp.int32)
+    active = jnp.zeros((cache.max_slots,), bool)  # nobody active
+    _, cache2 = llama.decode_step(params_fp32, CFG, tokens, cache, active)
+    np.testing.assert_array_equal(np.asarray(cache2.lengths), lengths_before)
+    np.testing.assert_allclose(np.asarray(cache2.k), np.asarray(cache.k))
+
+
+def test_two_slot_isolation(params_fp32):
+    """Two concurrent slots produce the same tokens as each alone (continuous
+    batching must not cross-contaminate)."""
+    prompts = {0: [5, 17, 99], 3: [250, 1, 2, 3, 4]}
+    outs = {}
+    for mode in ("together", "alone0", "alone3"):
+        cache, alloc = _make_cache()
+        slots = (
+            list(prompts) if mode == "together"
+            else [0] if mode == "alone0" else [3]
+        )
+        tokens = jnp.zeros((cache.max_slots,), jnp.int32)
+        active = jnp.zeros((cache.max_slots,), bool)
+        for s in slots:
+            p = prompts[s]
+            alloc.alloc(s, len(p) + 4)
+            row = jnp.asarray(alloc.table_row(s), jnp.int32)
+            padded = jnp.asarray(p + [0] * (8 - len(p)), jnp.int32)
+            logits, cache = llama.prefill(
+                params_fp32, CFG, padded, jnp.int32(len(p)), cache,
+                jnp.int32(s), row,
+            )
+            tokens = tokens.at[s].set(int(jnp.argmax(logits)))
+            active = active.at[s].set(True)
+        gen = {s: [int(tokens[s])] for s in slots}
+        for _ in range(3):
+            logits, cache = llama.decode_step(params_fp32, CFG, tokens, cache, active)
+            for s in slots:
+                nxt = int(jnp.argmax(logits[s]))
+                gen[s].append(nxt)
+                tokens = tokens.at[s].set(nxt)
+        outs[mode] = gen
+    assert outs["together"][0] == outs["alone0"][0]
+    assert outs["together"][3] == outs["alone3"][3]
