@@ -1,0 +1,160 @@
+#!/usr/bin/env python
+"""Headline benchmark (driver contract: ONE JSON line on stdout).
+
+Metric (BASELINE.md): output tokens/sec via /ollama/api/generate. The run
+drives the FULL stack in one process — gateway HTTP → scheduler → in-memory
+bus → WorkerService → InferenceEngine on whatever accelerator jax sees —
+with N concurrent streaming requests (continuous batching), and reports
+aggregate decode throughput + p50 TTFT.
+
+vs_baseline anchors to BASELINE.json's comparison point ("Ollama-on-A100
+output tokens/sec"); the reference publishes no numbers (BASELINE.md), so
+the anchor values below are approximate public single-stream Ollama-on-A100
+figures for each model. vs_baseline = measured_aggregate / anchor.
+
+Usage: python bench.py [--model llama3.2:3b] [--requests 8] [--tokens 128]
+       [--tiny] (tiny-llama on CPU, smoke test)
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import statistics
+import sys
+import time
+
+# Approximate public Ollama single-stream numbers on A100 (the BASELINE.json
+# comparison anchor; nothing is published by the reference itself).
+A100_OLLAMA_TOK_S = {
+    "llama3:8b": 110.0,
+    "llama3.1:8b": 110.0,
+    "llama3.2:3b": 220.0,
+    "llama3.2:1b": 350.0,
+    "tiny-llama": 1.0,  # smoke-test placeholder
+}
+
+
+async def run_bench(model: str, n_requests: int, n_tokens: int,
+                    max_slots: int, prompt_len: int) -> dict:
+    import aiohttp
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from gridllm_tpu.bus.memory import InMemoryBus
+    from gridllm_tpu.engine import EngineConfig, InferenceEngine
+    from gridllm_tpu.gateway.app import create_app
+    from gridllm_tpu.scheduler import JobScheduler, WorkerRegistry
+    from gridllm_tpu.utils.config import Config, WorkerConfig
+    from gridllm_tpu.worker.service import WorkerService
+
+    engine = InferenceEngine(EngineConfig(
+        model=model,
+        max_slots=max_slots,
+        page_size=64,
+        num_pages=max(256, max_slots * 48),
+        max_pages_per_slot=48,
+        prefill_buckets=(256, 1024),
+    ))
+    bus = InMemoryBus()
+    await bus.connect()
+    config = Config()
+    registry = WorkerRegistry(bus, config.scheduler)
+    scheduler = JobScheduler(bus, registry, config.scheduler)
+    await registry.initialize()
+    await scheduler.initialize()
+    app = create_app(bus, registry, scheduler, config)
+    worker = WorkerService(bus, {model: engine}, WorkerConfig(),
+                           stream_flush_ms=5)
+    await worker.start()
+    await asyncio.sleep(0.1)
+    client = TestClient(TestServer(app))
+    await client.start_server()
+
+    prompt = "The quick brown fox jumps over the lazy dog. " * (prompt_len // 10)
+
+    # warmup: trigger prefill+decode compiles before timing
+    warm = await client.post("/ollama/api/generate", json={
+        "model": model, "prompt": "warmup", "stream": False,
+        "options": {"temperature": 0, "num_predict": 4},
+    })
+    assert warm.status == 200, await warm.text()
+
+    ttfts: list[float] = []
+    tokens_out = [0]
+
+    async def one(i: int) -> None:
+        t0 = time.perf_counter()
+        first = True
+        async with client.post("/ollama/api/generate", json={
+            "model": model, "prompt": f"[{i}] {prompt}",
+            "options": {"temperature": 0.7, "seed": i, "num_predict": n_tokens},
+        }) as resp:
+            assert resp.status == 200, await resp.text()
+            async for line in resp.content:
+                if not line.strip():
+                    continue
+                if first:
+                    ttfts.append(time.perf_counter() - t0)
+                    first = False
+                frame = json.loads(line)
+                if frame.get("done"):
+                    tokens_out[0] += frame.get("eval_count") or 0
+
+    t_start = time.perf_counter()
+    await asyncio.gather(*(one(i) for i in range(n_requests)))
+    wall = time.perf_counter() - t_start
+
+    await client.close()
+    await worker.stop()
+    await scheduler.shutdown()
+    await registry.shutdown()
+    await bus.disconnect()
+
+    return {
+        "tok_s": tokens_out[0] / wall,
+        "p50_ttft_ms": statistics.median(ttfts) * 1000,
+        "tokens": tokens_out[0],
+        "wall_s": wall,
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="llama3.2:3b")
+    ap.add_argument("--requests", type=int, default=8)
+    ap.add_argument("--tokens", type=int, default=128)
+    ap.add_argument("--slots", type=int, default=8)
+    ap.add_argument("--prompt-len", type=int, default=120)
+    ap.add_argument("--tiny", action="store_true",
+                    help="tiny-llama CPU smoke test")
+    args = ap.parse_args()
+    if args.tiny:
+        import os
+
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        args.model = "tiny-llama"
+        args.tokens = min(args.tokens, 16)
+        args.prompt_len = 20
+
+    r = asyncio.run(run_bench(
+        args.model, args.requests, args.tokens, args.slots, args.prompt_len
+    ))
+    baseline = A100_OLLAMA_TOK_S.get(args.model, 0.0)
+    print(json.dumps({
+        "metric": f"output tokens/sec via /ollama/api/generate ({args.model}, "
+                  f"{args.requests} concurrent streams)",
+        "value": round(r["tok_s"], 2),
+        "unit": "tok/s",
+        "vs_baseline": round(r["tok_s"] / baseline, 3) if baseline else None,
+        "p50_ttft_ms": round(r["p50_ttft_ms"], 1),
+        "tokens": r["tokens"],
+        "wall_s": round(r["wall_s"], 2),
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

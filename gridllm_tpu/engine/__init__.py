@@ -1,0 +1,26 @@
+"""TPU inference engine (SURVEY.md §7 `engine/`).
+
+This is the subsystem the reference outsources to Ollama
+(client/src/services/OllamaService.ts:17-27 — an HTTP adapter to an external
+daemon). Here it is native: JAX model + paged KV cache + continuous-batching
+decode loop + sampler, producing the same behavioral surface the worker
+needs (streamed tokens, Ollama timing fields, embeddings).
+"""
+
+from gridllm_tpu.engine.engine import (
+    EngineConfig,
+    GenerationRequest,
+    GenerationResult,
+    InferenceEngine,
+)
+from gridllm_tpu.engine.tokenizer import ByteTokenizer, Tokenizer, get_tokenizer
+
+__all__ = [
+    "EngineConfig",
+    "GenerationRequest",
+    "GenerationResult",
+    "InferenceEngine",
+    "Tokenizer",
+    "ByteTokenizer",
+    "get_tokenizer",
+]

@@ -1,0 +1,480 @@
+"""Continuous-batching inference engine.
+
+Replaces the reference's external Ollama daemon (SURVEY.md §0: the entire
+compute path was `client/src/services/OllamaService.ts` HTTP calls). Design
+(SURVEY.md §7 steps 4-5):
+
+- One static device state: paged KV pool shared by `max_slots` concurrent
+  requests, per-slot sampler params, per-slot context token counts. All
+  compiled functions are shape-static; prompts pad to the smallest bucket.
+- Continuous batching: requests join/leave the batch between decode steps
+  (the reference capped workers at 1 job, server/src/config/index.ts:31 —
+  here concurrency is a device-state property, not a scheduler constant).
+- The decode step is ONE fused jit call: model step + sampler + bookkeeping,
+  so each loop iteration is a single dispatch and one [S] token transfer
+  back to the host.
+- Ollama semantics honored at this layer: sampler option surface (via
+  ops/sampling), `seed` determinism per request (unseeded requests draw a
+  random seed host-side — seed 0 is NOT a fixed default), real timing
+  fields in nanoseconds (the reference zeroed them, SURVEY.md §2.8),
+  `stop` sequences, `num_predict`, EOS from the tokenizer.
+
+Known divergence from Ollama: repeat_penalty counts the whole context
+(prompt + generated), not a sliding `repeat_last_n` window.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+import threading
+import time
+from collections import deque
+from functools import partial
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from gridllm_tpu.engine.tokenizer import DetokState, Tokenizer, get_tokenizer
+from gridllm_tpu.models import llama
+from gridllm_tpu.models.configs import ModelConfig, get_config
+from gridllm_tpu.ops.kvcache import PagedKVCache, PageAllocator
+from gridllm_tpu.ops.sampling import SamplingParams, sample_tokens
+from gridllm_tpu.parallel.mesh import MeshConfig, build_mesh
+from gridllm_tpu.parallel.sharding import shard_cache, shard_params
+from gridllm_tpu.utils.logging import get_logger
+
+log = get_logger("engine")
+
+
+def _model_module(cfg: ModelConfig):
+    if cfg.family == "mixtral":
+        from gridllm_tpu.models import mixtral
+
+        return mixtral
+    return llama
+
+
+@dataclasses.dataclass
+class EngineConfig:
+    model: str
+    checkpoint_path: str | None = None   # None → random init (tests/synthetic bench)
+    tokenizer: str | None = None         # None/"byte" → ByteTokenizer
+    dtype: str = "bfloat16"
+    max_slots: int = 8
+    page_size: int = 64
+    num_pages: int = 1024
+    max_pages_per_slot: int = 128
+    prefill_buckets: tuple[int, ...] = (64, 256, 1024, 4096)
+    mesh: MeshConfig | None = None       # None → no mesh (single device)
+    max_queue: int = 512
+    seed: int | None = None              # engine-level seed for unseeded reqs
+
+
+@dataclasses.dataclass
+class GenerationRequest:
+    id: str
+    prompt: str | None = None
+    prompt_ids: list[int] | None = None  # pre-tokenized (Ollama `context` path)
+    options: dict[str, Any] = dataclasses.field(default_factory=dict)
+    raw: bool = False                    # skip BOS when prompt_ids is None
+    # called from the engine loop: (text_delta, done, result|None)
+    on_chunk: Callable[[str, bool, "GenerationResult | None"], None] | None = None
+
+
+@dataclasses.dataclass
+class GenerationResult:
+    id: str
+    text: str = ""
+    token_ids: list[int] = dataclasses.field(default_factory=list)
+    context: list[int] = dataclasses.field(default_factory=list)
+    done_reason: str = "stop"
+    prompt_eval_count: int = 0
+    prompt_eval_duration_ns: int = 0
+    eval_count: int = 0
+    eval_duration_ns: int = 0
+    load_duration_ns: int = 0
+    total_duration_ns: int = 0
+
+
+class _Slot:
+    __slots__ = (
+        "req", "ids", "prompt_len", "generated", "detok", "text", "emitted_len",
+        "num_predict", "stop_seqs", "eos_ids", "capacity",
+        "t_start", "t_prefill_ns", "t_first_decode",
+    )
+
+    def __init__(self, req: GenerationRequest, ids: list[int], capacity: int,
+                 num_predict: int, stop_seqs: list[str], eos_ids: frozenset[int]):
+        self.req = req
+        self.ids = ids                   # prompt ids (grows with generation)
+        self.prompt_len = len(ids)
+        self.generated: list[int] = []
+        self.detok = DetokState()
+        self.text = ""
+        self.emitted_len = 0             # chars of `text` already sent out
+        self.num_predict = num_predict
+        self.stop_seqs = stop_seqs
+        self.eos_ids = eos_ids
+        self.capacity = capacity         # max total tokens this slot may hold
+        self.t_start = time.perf_counter_ns()
+        self.t_prefill_ns = 0
+        self.t_first_decode = 0
+
+    def holdback(self) -> int:
+        """Chars at the tail of `text` that could still become a stop
+        sequence (longest proper-prefix match) — must not be emitted yet."""
+        hold = 0
+        for seq in self.stop_seqs:
+            for k in range(min(len(seq), len(self.text)), 0, -1):
+                if self.text.endswith(seq[:k]):
+                    hold = max(hold, k)
+                    break
+        return hold
+
+
+class InferenceEngine:
+    """Synchronous core; drive with step() (tests) or the worker's async
+    facade (worker/service.py wraps step() in a thread executor)."""
+
+    def __init__(self, config: EngineConfig):
+        self.config = config
+        self.cfg = get_config(config.model)
+        self.mod = _model_module(self.cfg)
+        self.tokenizer: Tokenizer = get_tokenizer(
+            config.tokenizer, self.cfg.vocab_size
+        )
+        self.mesh = build_mesh(config.mesh) if config.mesh else None
+        self._rng = random.Random(config.seed)
+        self._lock = threading.Lock()
+        self._pending: deque[GenerationRequest] = deque()
+        self._slots: dict[int, _Slot] = {}
+        self._free_slots = list(range(config.max_slots - 1, -1, -1))
+        self._load()
+        self._build_fns()
+
+    # ---------------------------------------------------------- state setup
+
+    def _load(self) -> None:
+        c, mc = self.config, self.cfg
+        dtype = jnp.dtype(c.dtype)
+        t0 = time.perf_counter_ns()
+        if c.checkpoint_path:
+            from gridllm_tpu.engine.loader import load_checkpoint
+            from gridllm_tpu.parallel.sharding import param_shardings
+
+            shardings = None
+            if self.mesh is not None:
+                proto = jax.eval_shape(
+                    lambda: self.mod.init_params(mc, jax.random.PRNGKey(0), dtype)
+                )
+                shardings = param_shardings(proto, self.mesh)
+            self.params = load_checkpoint(mc, c.checkpoint_path, dtype, shardings)
+        else:
+            self.params = self.mod.init_params(mc, jax.random.PRNGKey(0), dtype)
+            if self.mesh is not None:
+                self.params = shard_params(self.params, self.mesh)
+        cache = PagedKVCache.create(
+            mc.num_layers, c.num_pages, c.page_size, mc.num_kv_heads,
+            mc.head_dim_, c.max_slots, c.max_pages_per_slot, dtype=dtype,
+        )
+        self.cache = shard_cache(cache, self.mesh) if self.mesh else cache
+        self.alloc = PageAllocator(c.num_pages, c.page_size, c.max_pages_per_slot)
+        self.sampling = SamplingParams.defaults(c.max_slots)
+        self.counts = jnp.zeros((c.max_slots, mc.vocab_size), jnp.int32)
+        self.tokens = jnp.zeros((c.max_slots,), jnp.int32)
+        self.active = jnp.zeros((c.max_slots,), bool)
+        self.load_duration_ns = time.perf_counter_ns() - t0
+        self.max_context = min(
+            mc.max_seq_len, c.max_pages_per_slot * c.page_size
+        )
+        self._buckets = sorted(
+            {min(b, self.max_context) for b in c.prefill_buckets}
+        )
+
+    def _build_fns(self) -> None:
+        mc = self.cfg
+
+        @partial(jax.jit, donate_argnums=(2, 3))
+        def prefill_fn(params, tokens, cache, counts, length, slot, table_row, sp):
+            logits, cache = self.mod.prefill(
+                params, mc, tokens, length, cache, slot, table_row
+            )
+            # count prompt tokens for repeat_penalty (valid positions only)
+            t = jnp.arange(tokens.shape[0])
+            ids = jnp.where(t < length, tokens, mc.vocab_size)  # OOB drops
+            counts = counts.at[slot, ids].add(1, mode="drop")
+            tok = sample_tokens(logits[None], _gather_sp(sp, slot), counts[slot][None])[0]
+            counts = counts.at[slot, tok].add(1, mode="drop")
+            return tok, cache, counts
+
+        @partial(jax.jit, donate_argnums=(1, 4))
+        def decode_fn(params, cache, tokens, active, counts, sp):
+            logits, cache = self.mod.decode_step(params, mc, tokens, cache, active)
+            sampled = sample_tokens(logits, sp, counts)
+            s = jnp.arange(tokens.shape[0])
+            ids = jnp.where(active, sampled, mc.vocab_size)
+            counts = counts.at[s, ids].add(1, mode="drop")
+            sp = dataclasses.replace(sp, step=sp.step + active.astype(jnp.int32))
+            return jnp.where(active, sampled, tokens), cache, counts, sp
+
+        def _gather_sp(sp: SamplingParams, slot) -> SamplingParams:
+            return jax.tree.map(lambda a: a[slot][None], sp)
+
+        self._prefill_fn = prefill_fn
+        self._decode_fn = decode_fn
+
+    # ------------------------------------------------------------ admission
+
+    def submit(self, req: GenerationRequest) -> None:
+        with self._lock:
+            if len(self._pending) >= self.config.max_queue:
+                raise RuntimeError("engine queue full")
+            self._pending.append(req)
+
+    def _tokenize(self, req: GenerationRequest) -> list[int]:
+        if req.prompt_ids is not None:
+            return list(req.prompt_ids)
+        return self.tokenizer.encode(req.prompt or "", add_bos=not req.raw)
+
+    def _bucket_for(self, n: int) -> int:
+        for b in self._buckets:
+            if n <= b:
+                return b
+        return self._buckets[-1]
+
+    def _fail(self, req: GenerationRequest, msg: str) -> None:
+        log.warning("request rejected", id=req.id, reason=msg)
+        res = GenerationResult(id=req.id, done_reason="error", text=msg)
+        if req.on_chunk:
+            req.on_chunk("", True, res)
+
+    def _try_admit(self) -> bool:
+        """Admit one pending request into a free slot. Returns True if
+        admitted (caller loops until False)."""
+        with self._lock:
+            if not self._pending or not self._free_slots:
+                return False
+            req = self._pending.popleft()
+        ids = self._tokenize(req)
+        opts = req.options or {}
+        if len(ids) >= self.max_context:
+            ids = ids[-(self.max_context - 1):]  # Ollama truncates from the left
+        num_predict = int(opts.get("num_predict", -1))
+        want = (
+            len(ids) + num_predict
+            if num_predict >= 0
+            else self.max_context
+        )
+        want = min(max(want, len(ids) + 1), self.max_context)
+        if not self.alloc.fits_slot_cap(want):
+            self._fail(req, f"context {want} exceeds slot capacity")
+            return True
+        slot = self._free_slots[-1]
+        pages = self.alloc.alloc(slot, want)
+        if pages is None:
+            # pool exhausted: requeue at front, wait for a slot to free pages
+            with self._lock:
+                self._pending.appendleft(req)
+            return False
+        self._free_slots.pop()
+
+        stop = opts.get("stop") or []
+        stop_seqs = [stop] if isinstance(stop, str) else list(stop)
+        st = _Slot(req, ids, want, num_predict, stop_seqs, self.tokenizer.eos_ids)
+
+        # per-slot sampler params (Ollama option names)
+        seed = opts.get("seed")
+        if seed is None:
+            seed = self._rng.getrandbits(31)
+        upd = {
+            "temperature": float(opts.get("temperature", 0.8)),
+            "top_k": int(opts.get("top_k", 40)),
+            "top_p": float(opts.get("top_p", 0.9)),
+            "min_p": float(opts.get("min_p", 0.0)),
+            "repeat_penalty": float(opts.get("repeat_penalty", 1.1)),
+            "seed": int(seed) & 0x7FFFFFFF,
+            "step": 0,
+        }
+        self.sampling = SamplingParams(**{
+            f.name: getattr(self.sampling, f.name).at[slot].set(upd[f.name])
+            for f in dataclasses.fields(SamplingParams)
+        })
+        self.counts = self.counts.at[slot].set(0)
+
+        bucket = self._bucket_for(len(ids))
+        padded = jnp.asarray(
+            ids + [0] * (bucket - len(ids)), jnp.int32
+        )
+        row = jnp.asarray(self.alloc.table_row(slot), jnp.int32)
+        t0 = time.perf_counter_ns()
+        tok, self.cache, self.counts = self._prefill_fn(
+            self.params, padded, self.cache, self.counts,
+            jnp.int32(len(ids)), jnp.int32(slot), row, self.sampling,
+        )
+        tok = int(tok)
+        st.t_prefill_ns = time.perf_counter_ns() - t0
+        self.tokens = self.tokens.at[slot].set(tok)
+        self.active = self.active.at[slot].set(True)
+        self._slots[slot] = st
+        self._ingest(slot, st, tok)
+        return True
+
+    # ------------------------------------------------------------ stepping
+
+    def _ingest(self, slot: int, st: _Slot, tok: int) -> None:
+        """Record one sampled token; emit text; finish the slot if done."""
+        st.generated.append(tok)
+        st.ids.append(tok)
+        done_reason = None
+        if tok in st.eos_ids:
+            st.generated.pop()  # EOS is not part of the visible output
+            st.ids.pop()
+            done_reason = "stop"
+        else:
+            st.text += st.detok.delta(self.tokenizer, st.generated)
+            for s in st.stop_seqs:  # stop sequences: trim at first match
+                i = st.text.find(s)
+                if i >= 0:
+                    st.text = st.text[:i]
+                    done_reason = "stop"
+                    break
+        if done_reason is None:
+            if 0 <= st.num_predict <= len(st.generated):
+                done_reason = "length"
+            elif st.prompt_len + len(st.generated) >= st.capacity:
+                # try to grow within the slot cap; else out of context
+                grown = self.alloc.alloc(slot, st.prompt_len + len(st.generated) + 1)
+                if grown is None:
+                    done_reason = "length"
+                else:
+                    st.capacity = len(grown) * self.alloc.page_size
+                    self.cache = dataclasses.replace(
+                        self.cache,
+                        page_table=self.cache.page_table.at[slot].set(
+                            jnp.asarray(self.alloc.table_row(slot), jnp.int32)
+                        ),
+                    )
+        if done_reason is not None:
+            self._finish(slot, st, done_reason)
+            return
+        # emit finalized text only: hold back anything that may yet turn
+        # into a stop sequence (emitted chunks cannot be retracted)
+        safe = len(st.text) - st.holdback()
+        if safe > st.emitted_len and st.req.on_chunk:
+            delta = st.text[st.emitted_len : safe]
+            st.emitted_len = safe
+            st.req.on_chunk(delta, False, None)
+
+    def _finish(self, slot: int, st: _Slot, reason: str) -> None:
+        now = time.perf_counter_ns()
+        last_delta = st.text[st.emitted_len :]
+        st.emitted_len = len(st.text)
+        res = GenerationResult(
+            id=st.req.id,
+            text=st.text,
+            token_ids=list(st.generated),
+            context=list(st.ids),
+            done_reason=reason,
+            prompt_eval_count=st.prompt_len,
+            prompt_eval_duration_ns=st.t_prefill_ns,
+            eval_count=len(st.generated),
+            eval_duration_ns=(now - st.t_first_decode) if st.t_first_decode else 0,
+            load_duration_ns=self.load_duration_ns,
+            total_duration_ns=now - st.t_start,
+        )
+        self.active = self.active.at[slot].set(False)
+        self.alloc.free(slot)
+        del self._slots[slot]
+        self._free_slots.append(slot)
+        if st.req.on_chunk:
+            st.req.on_chunk(last_delta, True, res)
+
+    def step(self) -> bool:
+        """One engine iteration: admit what fits, then one decode step for
+        all active slots. Returns False when completely idle."""
+        while self._try_admit():
+            pass
+        if not self._slots:
+            return bool(self._pending)
+        for st in self._slots.values():
+            if not st.t_first_decode:
+                st.t_first_decode = time.perf_counter_ns()
+        self.tokens, self.cache, self.counts, self.sampling = _unpack4(
+            self._decode_fn(
+                self.params, self.cache, self.tokens, self.active,
+                self.counts, self.sampling,
+            )
+        )
+        toks = np.asarray(jax.device_get(self.tokens))
+        for slot, st in list(self._slots.items()):
+            self._ingest(slot, st, int(toks[slot]))
+        return True
+
+    # ---------------------------------------------------------- public API
+
+    def generate(self, req: GenerationRequest) -> GenerationResult:
+        """Blocking convenience: submit and drive until THIS request is done."""
+        box: list[GenerationResult] = []
+        user_cb = req.on_chunk
+
+        def cb(delta: str, done: bool, res: GenerationResult | None):
+            if user_cb:
+                user_cb(delta, done, res)
+            if done and res is not None:
+                box.append(res)
+
+        req.on_chunk = cb
+        self.submit(req)
+        while not box:
+            if not self.step() and not box:
+                time.sleep(0.001)
+        return box[0]
+
+    def embed(self, texts: list[str]) -> list[list[float]]:
+        """Mean-pooled, L2-normalized final hidden states (the llama-family
+        embedding path; dedicated embed model families plug in via configs)."""
+        out = []
+        for text in texts:
+            ids = self.tokenizer.encode(text)[: self.max_context]
+            b = self._bucket_for(len(ids))
+            padded = jnp.asarray([ids + [0] * (b - len(ids))], jnp.int32)
+            h = self.mod.hidden_states(self.params, self.cfg, padded)[0]
+            mask = (jnp.arange(b) < len(ids))[:, None]
+            pooled = (h * mask).sum(0) / jnp.maximum(mask.sum(), 1)
+            vec = pooled / jnp.maximum(jnp.linalg.norm(pooled), 1e-9)
+            out.append(np.asarray(vec, np.float32).tolist())
+        return out
+
+    def cancel(self, req_id: str) -> bool:
+        """Cancel a pending or running request (reference analogue: job
+        cancellation publish, JobScheduler.ts:530-536 → worker). The
+        request's on_chunk gets a final done with done_reason='cancel'."""
+        with self._lock:
+            for i, r in enumerate(self._pending):
+                if r.id == req_id:
+                    del self._pending[i]
+                    res = GenerationResult(id=req_id, done_reason="cancel")
+                    if r.on_chunk:
+                        r.on_chunk("", True, res)
+                    return True
+        for slot, st in list(self._slots.items()):
+            if st.req.id == req_id:
+                self._finish(slot, st, "cancel")
+                return True
+        return False
+
+    @property
+    def active_requests(self) -> int:
+        return len(self._slots)
+
+    @property
+    def queued_requests(self) -> int:
+        return len(self._pending)
+
+
+def _unpack4(t):
+    a, b, c, d = t
+    return a, b, c, d

@@ -100,12 +100,8 @@ def _unembed(cfg: ModelConfig, params: Params, x: jnp.ndarray) -> jnp.ndarray:
     )
 
 
-def forward(params: Params, cfg: ModelConfig, tokens: jnp.ndarray) -> jnp.ndarray:
-    """Cache-free full forward: tokens [B, T] → logits [B, T, V] (fp32).
-
-    The oracle path — golden tests compare this against HF; prefill/decode
-    must agree with it (tested in tests/test_models.py).
-    """
+def hidden_states(params: Params, cfg: ModelConfig, tokens: jnp.ndarray) -> jnp.ndarray:
+    """Final-norm hidden states [B, T, E] (embeddings path; no unembed)."""
     _check_supported(cfg)
     b, t = tokens.shape
     inv_freq = precompute_rope(cfg.head_dim_, cfg.rope_theta, cfg.rope_scaling)
@@ -118,15 +114,22 @@ def forward(params: Params, cfg: ModelConfig, tokens: jnp.ndarray) -> jnp.ndarra
         q, k, v = _qkv(cfg, lp, hx)
         q = apply_rope(q, pos, inv_freq)
         k = apply_rope(k, pos, inv_freq)
-        attn = attention_prefill(q, k, v, seq_lens)
-        attn = attn.reshape(b, t, -1)
+        attn = attention_prefill(q, k, v, seq_lens).reshape(b, t, -1)
         x = x + jnp.dot(attn, lp["wo"], precision=_precision(x))
         hx = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
         return x + _mlp(lp, hx), None
 
     x, _ = jax.lax.scan(layer, x, params["layers"])
-    x = rms_norm(x, params["final_norm"], cfg.rms_eps)
-    return _unembed(cfg, params, x)
+    return rms_norm(x, params["final_norm"], cfg.rms_eps)
+
+
+def forward(params: Params, cfg: ModelConfig, tokens: jnp.ndarray) -> jnp.ndarray:
+    """Cache-free full forward: tokens [B, T] → logits [B, T, V] (fp32).
+
+    The oracle path — golden tests compare this against HF; prefill/decode
+    must agree with it (tested in tests/test_models.py).
+    """
+    return _unembed(cfg, params, hidden_states(params, cfg, tokens))
 
 
 def prefill(

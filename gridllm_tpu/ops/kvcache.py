@@ -73,6 +73,29 @@ class PagedKVCache:
         return self.page_table.shape[1] * self.page_size
 
 
+def _safe_page_idx(
+    lookup,
+    positions: jnp.ndarray,
+    valid: jnp.ndarray,
+    page_size: int,
+    max_pages: int,
+    num_pages: int,
+) -> jnp.ndarray:
+    """Page index for each write position, with every hazard masked to the
+    out-of-bounds sentinel `num_pages` so scatter mode="drop" really drops:
+
+    - invalid positions (padding / inactive slot) — caller's `valid` mask
+    - past-capacity positions: jax gather CLAMPS out-of-range lookups to
+      the row's last entry (a real page), so mask before looking up
+    - unmapped table entries (-1): negative indices WRAP in jax scatter
+
+    `lookup(page_no)` maps in-range page numbers to page ids.
+    """
+    in_cap = positions < max_pages * page_size
+    mapped = lookup(jnp.minimum(positions // page_size, max_pages - 1))
+    return jnp.where(valid & in_cap & (mapped >= 0), mapped, num_pages)
+
+
 def write_prefill(
     k_pages: jnp.ndarray,
     v_pages: jnp.ndarray,
@@ -92,15 +115,12 @@ def write_prefill(
     cached length for chunked prefill). length: scalar — valid tokens in
     k_new; positions >= length are dropped.
     """
-    oob = k_pages.shape[0]  # one past the pool: genuinely out of bounds, so
-    # mode="drop" really drops (negative indices would WRAP in jax scatter)
     t = jnp.arange(k_new.shape[0], dtype=jnp.int32)
     pos = start + t
-    # capacity guard: past-the-row positions would be CLAMPED by jax gather
-    # to the row's last entry (a real page) — mask them out explicitly
-    in_cap = pos < table_row.shape[0] * page_size
-    mapped = table_row[jnp.minimum(pos // page_size, table_row.shape[0] - 1)]
-    page_idx = jnp.where((t < length) & in_cap & (mapped >= 0), mapped, oob)
+    page_idx = _safe_page_idx(
+        lambda p: table_row[p], pos, t < length, page_size,
+        table_row.shape[0], k_pages.shape[0],
+    )
     offset = pos % page_size
     k_pages = k_pages.at[page_idx, offset].set(k_new, mode="drop")
     v_pages = v_pages.at[page_idx, offset].set(v_new, mode="drop")
@@ -122,12 +142,11 @@ def write_decode(
     k_new/v_new: [S, KVH, D]; positions: [S] absolute write position per
     slot; active: [S] bool — inactive slots are dropped.
     """
-    oob = k_pages.shape[0]  # see write_prefill: -1 would wrap, oob drops
-    max_pages = page_table.shape[1]
     s = jnp.arange(page_table.shape[0], dtype=jnp.int32)
-    in_cap = positions < max_pages * page_size  # gather would clamp, not trap
-    mapped = page_table[s, jnp.minimum(positions // page_size, max_pages - 1)]
-    page_idx = jnp.where(active & in_cap & (mapped >= 0), mapped, oob)
+    page_idx = _safe_page_idx(
+        lambda p: page_table[s, p], positions, active, page_size,
+        page_table.shape[1], k_pages.shape[0],
+    )
     offset = positions % page_size
     k_pages = k_pages.at[page_idx, offset].set(k_new, mode="drop")
     v_pages = v_pages.at[page_idx, offset].set(v_new, mode="drop")

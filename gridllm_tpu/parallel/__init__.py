@@ -1,0 +1,24 @@
+"""Parallelism layer: device meshes + sharding specs (SURVEY.md §2.5, §7).
+
+The reference's only parallelism is request-level DP across whole workers
+(server/src/services/JobScheduler.ts:317-360). Everything here is NEW TPU
+capability living inside one logical worker: tensor/expert sharding over an
+ICI mesh, with XLA inserting the collectives (scaling-book recipe: pick a
+mesh, annotate shardings, let pjit do the rest).
+"""
+
+from gridllm_tpu.parallel.mesh import MeshConfig, build_mesh, local_mesh
+from gridllm_tpu.parallel.sharding import (
+    cache_shardings,
+    data_shardings,
+    param_shardings,
+)
+
+__all__ = [
+    "MeshConfig",
+    "build_mesh",
+    "local_mesh",
+    "param_shardings",
+    "cache_shardings",
+    "data_shardings",
+]

@@ -1,0 +1,71 @@
+"""Device-mesh construction.
+
+Axis vocabulary (fixed across the framework):
+  "dp" — replica/data axis: independent continuous batches (slots split here)
+  "tp" — tensor axis: attention heads + MLP hidden sharded here; the decode
+         all-reduce rides this axis over ICI
+  "ep" — expert axis (MoE): experts distributed here, tokens all-to-all'd
+  "sp" — sequence axis: long-context prefill splits the time dimension here
+         (ring attention via ppermute)
+
+One logical worker = one mesh. Multi-host slices build the same mesh from
+jax.devices() after jax.distributed.initialize (SURVEY.md §5.8(b)); the bus
+protocol only ever sees the single logical worker.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+AXES = ("dp", "ep", "tp", "sp")
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """Logical mesh shape. -1 on at most one axis means "absorb the rest"."""
+
+    dp: int = 1
+    ep: int = 1
+    tp: int = -1
+    sp: int = 1
+
+    def resolve(self, n_devices: int) -> tuple[int, int, int, int]:
+        dims = [self.dp, self.ep, self.tp, self.sp]
+        wild = [i for i, d in enumerate(dims) if d == -1]
+        if len(wild) > 1:
+            raise ValueError("at most one mesh axis may be -1")
+        fixed = math.prod(d for d in dims if d != -1)
+        if wild:
+            if n_devices % fixed:
+                raise ValueError(f"{n_devices} devices not divisible by {fixed}")
+            dims[wild[0]] = n_devices // fixed
+        if math.prod(dims) != n_devices:
+            raise ValueError(
+                f"mesh {dims} needs {math.prod(dims)} devices, have {n_devices}"
+            )
+        return tuple(dims)  # type: ignore[return-value]
+
+
+def build_mesh(config: MeshConfig | None = None, devices=None) -> Mesh:
+    """Build the worker mesh over `devices` (default: all of jax.devices()).
+
+    Axis order puts "sp" innermost → ring-attention ppermute neighbours are
+    ICI-adjacent; "dp" outermost → replicas may span DCN without putting
+    per-token collectives on it.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    config = config or MeshConfig()
+    shape = config.resolve(len(devices))
+    arr = np.asarray(devices).reshape(shape)
+    return Mesh(arr, AXES)
+
+
+def local_mesh(n: int | None = None) -> Mesh:
+    """All-local-devices mesh with everything on "tp" (single-host default)."""
+    devices = jax.devices()[: n or len(jax.devices())]
+    return build_mesh(MeshConfig(tp=-1), devices)

@@ -1,0 +1,116 @@
+"""Sharding specs for params, KV cache, and per-step data.
+
+Megatron-style tensor layout on the "tp" axis (the JAX way: annotate leaf
+shardings, let GSPMD insert the all-reduces — no hand-written collectives in
+the model code):
+
+  wq/wk/wv, w_gate/w_up   [L, E, out]   out sharded     (column parallel)
+  wo, w_down              [L, in, E]    in  sharded     (row parallel → the
+                                        per-layer psum XLA inserts is the
+                                        decode-critical ICI all-reduce)
+  embed                   [V, E]        vocab sharded
+  lm_head                 [E, V]        vocab sharded (logits all-gathered
+                                        once per step for the sampler)
+  norms                   replicated
+  kv page pools  [L, P, ps, KVH, D]     KVH sharded (GQA: each tp shard owns
+                                        its kv groups; q heads shard the same
+                                        way via wq's out dim)
+  experts (MoE)  [L, X, ...]            X sharded over "ep"
+
+Any dim not divisible by its mesh axis falls back to replicated for that dim
+(e.g. tiny test configs with KVH=2 on tp=8) — correctness first, the memory
+win only where the layout allows it.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def _fit(mesh: Mesh, shape: tuple[int, ...], spec: tuple[str | None, ...]) -> NamedSharding:
+    """NamedSharding with per-dim divisibility fallback to replicated."""
+    dims = []
+    for size, ax in zip(shape, spec):
+        ok = ax is not None and size % mesh.shape[ax] == 0
+        dims.append(ax if ok else None)
+    return NamedSharding(mesh, P(*dims))
+
+
+# leaf-name → spec template, by trailing path component. Templates are for
+# the STACKED [L, ...] layout of `layers` leaves; non-layer leaves listed
+# with their own rank.
+_LAYER_SPECS: dict[str, tuple[str | None, ...]] = {
+    "wq": (None, None, "tp"),
+    "wk": (None, None, "tp"),
+    "wv": (None, None, "tp"),
+    "wo": (None, "tp", None),
+    "w_gate": (None, None, "tp"),
+    "w_up": (None, None, "tp"),
+    "w_down": (None, "tp", None),
+    "attn_norm": (None, None),
+    "mlp_norm": (None, None),
+    # MoE router + experts (mixtral): experts stacked on a [L, X, ...] axis
+    "router": (None, None, None),
+    "we_gate": (None, "ep", None, "tp"),
+    "we_up": (None, "ep", None, "tp"),
+    "we_down": (None, "ep", "tp", None),
+}
+_TOP_SPECS: dict[str, tuple[str | None, ...]] = {
+    "embed": ("tp", None),
+    "lm_head": (None, "tp"),
+    "final_norm": (None,),
+}
+
+
+def param_shardings(params: Any, mesh: Mesh) -> Any:
+    """Pytree of NamedSharding congruent with a model params pytree."""
+
+    def spec_for(path, leaf) -> NamedSharding:
+        name = None
+        for entry in reversed(path):
+            if isinstance(entry, jax.tree_util.DictKey):
+                name = entry.key
+                break
+        table = _LAYER_SPECS if any(
+            isinstance(e, jax.tree_util.DictKey) and e.key == "layers" for e in path
+        ) else _TOP_SPECS
+        spec = table.get(name, (None,) * leaf.ndim)
+        if len(spec) != leaf.ndim:  # unknown leaf → replicate
+            spec = (None,) * leaf.ndim
+        return _fit(mesh, leaf.shape, spec)
+
+    return jax.tree_util.tree_map_with_path(spec_for, params)
+
+
+def cache_shardings(cache: Any, mesh: Mesh) -> Any:
+    """PagedKVCache-shaped pytree of shardings: pools KVH-sharded on tp,
+    tables/lengths replicated (they are tiny and host-authored)."""
+    pool = _fit(mesh, cache.k.shape, (None, None, None, "tp", None))
+    rep_t = NamedSharding(mesh, P(*(None,) * cache.page_table.ndim))
+    rep_l = NamedSharding(mesh, P(None))
+    return jax.tree_util.tree_unflatten(
+        jax.tree_util.tree_structure(cache),
+        [pool, pool, rep_t, rep_l],
+    )
+
+
+def data_shardings(mesh: Mesh) -> NamedSharding:
+    """Per-step scalars/vectors (tokens, lengths, active masks): replicated —
+    every tp shard needs the full batch, and the arrays are bytes-sized."""
+    return NamedSharding(mesh, P())
+
+
+def shard_params(params: Any, mesh: Mesh) -> Any:
+    """Place a params pytree onto the mesh per param_shardings."""
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, s), params, param_shardings(params, mesh)
+    )
+
+
+def shard_cache(cache: Any, mesh: Mesh) -> Any:
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, s), cache, cache_shardings(cache, mesh)
+    )

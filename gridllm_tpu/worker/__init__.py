@@ -1,0 +1,10 @@
+"""TPU worker runtime (SURVEY.md §7 `worker/`).
+
+Reference analogue: client/src/services/WorkerClientService.ts (760 LoC) —
+registration, heartbeats, job execution, streaming. The Ollama HTTP adapter
+(OllamaService.ts) is replaced by in-process InferenceEngine instances.
+"""
+
+from gridllm_tpu.worker.service import WorkerService
+
+__all__ = ["WorkerService"]

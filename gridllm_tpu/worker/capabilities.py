@@ -1,0 +1,106 @@
+"""Worker capability gathering.
+
+Reference analogue: WorkerClientService.gatherNodeCapabilities
+(client/src/services/WorkerClientService.ts:129-154) — which never filled
+systemResources or performanceTier (SURVEY.md §2.3 ⚠). Fix-by-design: both
+are populated here, plus the TPU additions (topology, shard layouts) the
+scheduler's topology-aware routing uses.
+"""
+
+from __future__ import annotations
+
+import os
+import platform
+
+from gridllm_tpu.utils.types import (
+    ModelInfo,
+    ModelShardLayout,
+    NodeCapabilities,
+    SystemResources,
+    TpuTopology,
+    iso_now,
+)
+
+
+def _meminfo_mb() -> tuple[float, float]:
+    try:
+        fields = {}
+        with open("/proc/meminfo") as f:
+            for line in f:
+                k, v = line.split(":", 1)
+                fields[k] = float(v.strip().split()[0]) / 1024.0
+        return fields.get("MemTotal", 0.0), fields.get("MemAvailable", 0.0)
+    except OSError:  # non-linux
+        return 0.0, 0.0
+
+
+def system_resources() -> SystemResources:
+    total, avail = _meminfo_mb()
+    try:
+        load1 = os.getloadavg()[0]
+        cores = os.cpu_count() or 1
+        cpu_pct = min(100.0, 100.0 * load1 / cores)
+    except OSError:
+        cpu_pct = 0.0
+    return SystemResources(
+        cpuCores=os.cpu_count() or 1,
+        totalMemoryMB=total,
+        availableMemoryMB=avail,
+        cpuUsagePercent=round(cpu_pct, 1),
+        memoryUsagePercent=round(100.0 * (1 - avail / total), 1) if total else 0.0,
+        platform=platform.system().lower(),
+        architecture=platform.machine(),
+    )
+
+
+def tpu_topology() -> TpuTopology:
+    import jax
+
+    devices = jax.devices()
+    kinds = {d.device_kind for d in devices}
+    hosts = {getattr(d, "process_index", 0) for d in devices}
+    return TpuTopology(
+        platform=devices[0].platform,
+        numDevices=len(devices),
+        numHosts=len(hosts),
+        deviceKind=", ".join(sorted(kinds)),
+    )
+
+
+def gather_capabilities(
+    worker_id: str,
+    engines: dict[str, object],
+    performance_tier: str | None = None,
+) -> NodeCapabilities:
+    topo = tpu_topology()
+    if performance_tier is None:
+        performance_tier = "high" if topo.platform == "tpu" else "medium"
+    models, layouts = [], []
+    max_slots = 0
+    for name, eng in engines.items():
+        c = getattr(eng, "config", None)
+        mc = getattr(eng, "cfg", None)
+        max_slots += getattr(c, "max_slots", 1)
+        models.append(ModelInfo(name=name, model=name))
+        mesh = getattr(eng, "mesh", None)
+        layouts.append(ModelShardLayout(
+            name=name,
+            strategy="tensor" if mesh is not None and mesh.shape.get("tp", 1) > 1
+            else "expert" if mesh is not None and mesh.shape.get("ep", 1) > 1
+            else "replicated",
+            meshAxes=dict(mesh.shape) if mesh is not None else {},
+            dtype=str(getattr(c, "dtype", "bfloat16")),
+            maxSeqLen=getattr(eng, "max_context", 8192),
+            maxBatchSlots=getattr(c, "max_slots", 1),
+        ))
+    return NodeCapabilities(
+        workerId=worker_id,
+        availableModels=models,
+        systemResources=system_resources(),
+        performanceTier=performance_tier,  # type: ignore[arg-type]
+        maxConcurrentTasks=max(max_slots, 1),
+        supportedFormats=["json"],
+        lastUpdated=iso_now(),
+        topology=topo,
+        shardLayouts=layouts,
+    )

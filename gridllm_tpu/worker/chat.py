@@ -1,0 +1,43 @@
+"""Chat-message templating: structured messages → a model prompt.
+
+Fixes reference defect SURVEY.md §2.8: `/ollama/api/chat` flattened messages
+to `role: content` lines AND routed them down the generate path
+(server/src/routes/ollama.ts:367-370). Here messages survive to the worker
+(metadata.requestType == "chat") and are templated per-model:
+
+- HF tokenizers with a chat_template use `apply_chat_template` (the
+  model's own trained format).
+- Otherwise (byte tokenizer / templateless): a llama3-style plain-text
+  header framing that keeps roles distinguishable.
+
+Multimodal `images` are not yet supported and raise (loud > silently
+dropped — the reference dropped them on the Ollama chat path too).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from gridllm_tpu.engine.tokenizer import Tokenizer
+
+
+def render_chat(messages: list[dict[str, Any]], tokenizer: Tokenizer) -> str:
+    for m in messages:
+        if m.get("images"):
+            raise ValueError("multimodal chat (images) not supported yet")
+    inner = getattr(tokenizer, "_tok", None)
+    if inner is not None and getattr(inner, "chat_template", None):
+        return inner.apply_chat_template(
+            messages, tokenize=False, add_generation_prompt=True
+        )
+    parts = []
+    for m in messages:
+        role = m.get("role", "user")
+        content = m.get("content", "")
+        if isinstance(content, list):  # OpenAI content-part arrays
+            content = "".join(
+                p.get("text", "") for p in content if isinstance(p, dict)
+            )
+        parts.append(f"<|{role}|>\n{content}\n")
+    parts.append("<|assistant|>\n")
+    return "".join(parts)

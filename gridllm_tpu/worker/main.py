@@ -1,0 +1,139 @@
+"""Worker process entry (``gridllm-worker``).
+
+Reference analogue: client/src/index.ts (WorkerApplication) — health-only
+HTTP app + the worker service. Models to serve come from GRIDLLM_MODELS
+(comma-separated registry names); checkpoints from GRIDLLM_CHECKPOINT_DIR
+({dir}/{name-with-:-replaced-by-_}).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import platform
+
+from aiohttp import web
+
+import gridllm_tpu
+from gridllm_tpu.bus import create_bus
+from gridllm_tpu.engine import EngineConfig, InferenceEngine
+from gridllm_tpu.parallel.mesh import MeshConfig
+from gridllm_tpu.utils.config import Config, load_config
+from gridllm_tpu.utils.logging import get_logger
+from gridllm_tpu.utils.types import iso_now
+from gridllm_tpu.worker.capabilities import system_resources
+from gridllm_tpu.worker.service import WorkerService
+
+log = get_logger("worker.main")
+
+
+def build_engines(config: Config) -> dict[str, InferenceEngine]:
+    engines: dict[str, InferenceEngine] = {}
+    names = [m.strip() for m in config.engine.models.split(",") if m.strip()]
+    mesh = None
+    if config.engine.mesh_shape:
+        axes = dict(
+            kv.split(":") for kv in config.engine.mesh_shape.split(",") if kv
+        )
+        mesh = MeshConfig(**{k: int(v) for k, v in axes.items()})
+    for name in names:
+        ckpt = None
+        if config.engine.checkpoint_dir:
+            cand = os.path.join(
+                config.engine.checkpoint_dir, name.replace(":", "_")
+            )
+            ckpt = cand if os.path.isdir(cand) else None
+        buckets = tuple(
+            int(b) for b in config.engine.prefill_buckets.split(",") if b
+        )
+        engines[name] = InferenceEngine(EngineConfig(
+            model=name,
+            checkpoint_path=ckpt,
+            tokenizer=os.path.join(ckpt, "tokenizer") if ckpt and os.path.isdir(
+                os.path.join(ckpt, "tokenizer")) else (ckpt if ckpt else None),
+            dtype=config.engine.dtype,
+            max_slots=config.engine.max_batch_slots,
+            page_size=config.engine.kv_page_size,
+            prefill_buckets=buckets,
+            mesh=mesh,
+        ))
+        log.info("engine ready", model=name, checkpoint=ckpt or "random-init")
+    return engines
+
+
+def build_health_app(service: WorkerService) -> web.Application:
+    """reference: client/src/routes/health.ts:8-59 + /worker/status
+    (client/src/index.ts:75-82)."""
+    app = web.Application()
+    started = iso_now()
+
+    async def health(_):
+        return web.json_response({
+            "status": "healthy", "timestamp": iso_now(),
+            "worker": service.worker_id, "version": gridllm_tpu.__version__,
+        })
+
+    async def live(_):
+        return web.json_response({"status": "alive", "timestamp": iso_now()})
+
+    async def ready(_):
+        return web.json_response({"status": "ready", "timestamp": iso_now()})
+
+    async def system(_):
+        res = system_resources()
+        return web.json_response({
+            "status": "ok", "timestamp": iso_now(), "startedAt": started,
+            "resources": res.model_dump(), "platform": platform.system().lower(),
+        })
+
+    async def status(_):
+        return web.json_response({
+            "workerId": service.worker_id,
+            "status": service._status(),
+            "currentJobs": service.current_jobs,
+            "totalJobsProcessed": service.total_processed,
+            "models": list(service.engines),
+        })
+
+    app.add_routes([
+        web.get("/health", health), web.get("/health/live", live),
+        web.get("/health/ready", ready), web.get("/health/system", system),
+        web.get("/worker/status", status),
+    ])
+    return app
+
+
+async def run(config: Config | None = None) -> None:
+    config = config or load_config()
+    bus = create_bus(config.bus.url, key_prefix=config.bus.key_prefix,
+                     password=config.bus.password, db=config.bus.db)
+    await bus.connect()
+    engines = build_engines(config)
+    if not engines:
+        raise SystemExit("no models configured: set GRIDLLM_MODELS")
+    service = WorkerService(
+        bus, engines, config.worker,
+        stream_flush_ms=config.engine.stream_flush_ms,
+    )
+    await service.start()
+    app = build_health_app(service)
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, config.worker.host, config.worker.port)
+    await site.start()
+    log.info("worker http listening", port=config.worker.port)
+    stop = asyncio.Event()
+    try:
+        await stop.wait()
+    finally:
+        await service.stop()
+        await runner.cleanup()
+        await bus.disconnect()
+
+
+def main() -> None:  # pragma: no cover
+    asyncio.run(run())
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

@@ -1,0 +1,365 @@
+"""WorkerService: the active worker runtime.
+
+Reference analogue: client/src/services/WorkerClientService.ts — connect,
+self-register, heartbeat, execute assigned jobs, stream results back over
+the bus. Deliberate divergences (fix-by-design, SURVEY.md §2.8):
+
+- concurrency: the engine's continuous batch supersedes the reference's
+  1-job gate; over-capacity assignments are NACKed with job:failed (the
+  reference silently DROPPED them, WorkerClientService.ts:500-505, leaving
+  recovery to the 10-minute timeout)
+- chat keeps structured messages end-to-end (requestType "chat" actually
+  reaches the chat path — unreachable in the reference, §2.2)
+- stream frames may batch several tokens inside a flush window (the
+  reference crossed Redis once per token, §3.4)
+- timing fields are real engine measurements (the reference zeroed them on
+  its OpenAI-facade path, §2.8)
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+from typing import Any
+
+from gridllm_tpu.bus.base import MessageBus, Subscription
+from gridllm_tpu.engine import GenerationRequest, GenerationResult, InferenceEngine
+from gridllm_tpu.utils.config import WorkerConfig
+from gridllm_tpu.utils.events import EventEmitter
+from gridllm_tpu.utils.logging import get_logger
+from gridllm_tpu.utils.types import (
+    InferenceResponse,
+    JobAssignment,
+    JobResult,
+    StreamChunk,
+    WorkerInfo,
+    iso_now,
+)
+from gridllm_tpu.worker.capabilities import gather_capabilities
+from gridllm_tpu.worker.chat import render_chat
+
+log = get_logger("worker")
+
+
+class WorkerService(EventEmitter):
+    """Events: registered, job_started, job_completed, job_failed, stopped."""
+
+    def __init__(
+        self,
+        bus: MessageBus,
+        engines: dict[str, InferenceEngine],
+        config: WorkerConfig | None = None,
+        stream_flush_ms: int = 20,
+    ):
+        super().__init__()
+        self.bus = bus
+        self.engines = engines
+        self.config = config or WorkerConfig()
+        self.worker_id = self.config.worker_id
+        self.stream_flush_s = stream_flush_ms / 1000.0
+        self.current_jobs = 0
+        self.total_processed = 0
+        self.max_concurrent = max(
+            sum(e.config.max_slots for e in engines.values()), 1
+        )
+        self._running = False
+        self._subs: list[Subscription] = []
+        self._tasks: list[asyncio.Task] = []
+        self._pump_wake = asyncio.Event()
+        self._cancelled: set[str] = set()
+        self._last_status: str | None = None
+
+    # ---------------------------------------------------------- lifecycle
+
+    async def start(self) -> None:
+        self._running = True
+        self._subs.append(await self.bus.subscribe(
+            f"worker:{self.worker_id}:job", self._on_job_message))
+        self._subs.append(await self.bus.subscribe(
+            f"worker:reregister:{self.worker_id}", self._on_reregister))
+        await self.register()
+        self._tasks.append(asyncio.create_task(self._heartbeat_loop()))
+        self._tasks.append(asyncio.create_task(self._resource_loop()))
+        self._tasks.append(asyncio.create_task(self._pump()))
+        log.info("worker started", workerId=self.worker_id,
+                 models=list(self.engines))
+
+    async def stop(self, announce: bool = True) -> None:
+        self._running = False
+        self._pump_wake.set()
+        for t in self._tasks:
+            t.cancel()
+        self._tasks.clear()
+        for s in self._subs:
+            await s.unsubscribe()
+        self._subs.clear()
+        if announce:
+            await self.bus.publish(
+                "worker:unregistered", json.dumps({"workerId": self.worker_id})
+            )
+        self.emit("stopped")
+
+    def _info(self) -> WorkerInfo:
+        return WorkerInfo(
+            workerId=self.worker_id,
+            capabilities=gather_capabilities(
+                self.worker_id, self.engines,
+                self.config.performance_tier or None,  # type: ignore[arg-type]
+            ),
+            status=self._status(),
+            currentJobs=self.current_jobs,
+            totalJobsProcessed=self.total_processed,
+        )
+
+    def _status(self) -> str:
+        return "busy" if self.current_jobs >= self.max_concurrent else "online"
+
+    async def register(self) -> None:
+        info = self._info()
+        await self.bus.hset("workers", self.worker_id, info.model_dump_json())
+        await self.bus.publish("worker:registered", info.model_dump_json())
+        self.emit("registered", info)
+
+    async def _on_reregister(self, _ch: str, _raw: str) -> None:
+        log.info("re-registration requested", workerId=self.worker_id)
+        await self.register()
+
+    # -------------------------------------------------------------- loops
+
+    async def _heartbeat_loop(self) -> None:
+        interval = self.config.heartbeat_interval_ms / 1000.0
+        while self._running:
+            try:
+                await self.bus.set_with_expiry(
+                    f"heartbeat:{self.worker_id}", str(time.time()), ttl_s=interval * 2
+                )
+                await self.bus.publish("worker:heartbeat", json.dumps({
+                    "workerId": self.worker_id,
+                    "status": self._status(),
+                    "currentJobs": self.current_jobs,
+                }))
+            except Exception as e:  # bus hiccup: keep beating
+                log.warning("heartbeat failed", error=str(e))
+            await asyncio.sleep(interval)
+
+    async def _resource_loop(self) -> None:
+        """Refresh capabilities + change-deduped status publishing
+        (reference: WorkerClientService.ts:355-440)."""
+        interval = self.config.resource_monitor_interval_ms / 1000.0
+        while self._running:
+            await asyncio.sleep(interval)
+            try:
+                info = self._info()
+                await self.bus.hset("workers", self.worker_id, info.model_dump_json())
+                await self._publish_status_if_changed()
+            except Exception as e:
+                log.warning("resource refresh failed", error=str(e))
+
+    async def _publish_status_if_changed(self) -> None:
+        status = self._status()
+        if status != self._last_status:
+            self._last_status = status
+            await self.bus.publish("worker:status_update", json.dumps({
+                "workerId": self.worker_id,
+                "status": status,
+                "currentJobs": self.current_jobs,
+            }))
+
+    async def _pump(self) -> None:
+        """Drive all engines' step loops off the event loop thread."""
+        while self._running:
+            busy = False
+            for eng in self.engines.values():
+                if eng.active_requests or eng.queued_requests:
+                    busy = True
+                    await asyncio.to_thread(eng.step)
+            if not busy:
+                self._pump_wake.clear()
+                try:
+                    await self._pump_wake.wait()
+                except asyncio.CancelledError:
+                    return
+            else:
+                await asyncio.sleep(0)
+
+    # ---------------------------------------------------------------- jobs
+
+    async def _on_job_message(self, _ch: str, raw: str) -> None:
+        msg = json.loads(raw)
+        if msg.get("type") == "job_cancellation":
+            job_id = msg.get("jobId", "")
+            self._cancelled.add(job_id)
+            for eng in self.engines.values():
+                if eng.cancel(job_id):
+                    break
+            return
+        if msg.get("type") != "job_assignment":
+            return
+        assignment = JobAssignment.model_validate(msg["job"])
+        if self.current_jobs >= self.max_concurrent:
+            # NACK instead of the reference's silent drop
+            await self._publish_failure(
+                assignment, "worker at capacity", nack=True
+            )
+            return
+        asyncio.ensure_future(self._execute(assignment))
+
+    def _resolve_engine(self, model: str) -> InferenceEngine | None:
+        if model in self.engines:
+            return self.engines[model]
+        base = model.split("-")[0]
+        return self.engines.get(base)
+
+    async def _execute(self, assignment: JobAssignment) -> None:
+        req = assignment.request
+        self.current_jobs += 1
+        await self._publish_status_if_changed()
+        started = time.time()
+        self.emit("job_started", assignment)
+        try:
+            engine = self._resolve_engine(req.model)
+            if engine is None:
+                raise ValueError(f"model not served here: {req.model}")
+            rtype = req.request_type
+            if rtype == "embedding":
+                response = await self._run_embedding(engine, req)
+            else:
+                response = await self._run_generation(engine, assignment)
+            if response is None:  # cancelled — scheduler already resolved it
+                return
+            result = JobResult(
+                jobId=req.id, workerId=self.worker_id, success=True,
+                response=response,
+                processingTimeMs=(time.time() - started) * 1000,
+            )
+            self.total_processed += 1
+            await self.bus.publish("job:completed", result.model_dump_json())
+            await self.bus.publish(f"job:result:{req.id}", result.model_dump_json())
+            self.emit("job_completed", result)
+        except Exception as e:
+            log.warning("job failed", jobId=req.id, error=str(e))
+            await self._publish_failure(assignment, str(e))
+        finally:
+            self.current_jobs -= 1
+            await self._publish_status_if_changed()
+
+    async def _publish_failure(
+        self, assignment: JobAssignment, error: str, nack: bool = False
+    ) -> None:
+        result = JobResult(
+            jobId=assignment.jobId, workerId=self.worker_id,
+            success=False, error=error,
+        )
+        await self.bus.publish("job:failed", result.model_dump_json())
+        if not nack:
+            self.emit("job_failed", result)
+
+    async def _run_embedding(
+        self, engine: InferenceEngine, req
+    ) -> InferenceResponse:
+        texts = req.input if req.input is not None else req.prompt
+        single = isinstance(texts, str)
+        texts = [texts] if single else list(texts or [])
+        t0 = time.perf_counter_ns()
+        vecs = await asyncio.to_thread(engine.embed, texts)
+        dur = time.perf_counter_ns() - t0
+        return InferenceResponse(
+            id=req.id, model=req.model, created_at=iso_now(), done=True,
+            embeddings=vecs, embedding=vecs[0] if single and vecs else None,
+            total_duration=dur,
+            prompt_eval_count=sum(len(t) for t in texts),
+        )
+
+    async def _run_generation(
+        self, engine: InferenceEngine, assignment: JobAssignment
+    ) -> InferenceResponse | None:
+        req = assignment.request
+        streaming = bool(req.stream)
+        is_chat = req.request_type == "chat" or (
+            req.messages is not None and req.prompt is None
+        )
+        if is_chat:
+            prompt = render_chat(req.messages or [], engine.tokenizer)
+        else:
+            prompt = req.prompt or ""
+
+        loop = asyncio.get_running_loop()
+        q: asyncio.Queue = asyncio.Queue()
+
+        def on_chunk(delta: str, done: bool, res: GenerationResult | None):
+            loop.call_soon_threadsafe(q.put_nowait, (delta, done, res))
+
+        opts = dict(req.options or {})
+        context = opts.pop("context", None) or getattr(req, "context", None)
+        gen = GenerationRequest(
+            id=req.id, prompt=prompt, options=opts,
+            raw=bool(opts.get("raw")), on_chunk=on_chunk,
+        )
+        if context:
+            gen.prompt_ids = list(context) + engine.tokenizer.encode(
+                prompt, add_bos=False
+            )
+        engine.submit(gen)
+        self._pump_wake.set()
+
+        buf = ""
+        eval_count = 0
+        last_flush = time.monotonic()
+        while True:
+            timeout = self.stream_flush_s if (streaming and buf) else None
+            try:
+                delta, done, res = await asyncio.wait_for(q.get(), timeout)
+            except asyncio.TimeoutError:
+                await self._flush_stream(req, buf, eval_count)
+                buf, last_flush = "", time.monotonic()
+                continue
+            buf += delta
+            if done:
+                assert res is not None
+                if res.done_reason == "cancel":
+                    return None
+                if res.done_reason == "error":
+                    raise RuntimeError(res.text or "generation failed")
+                return await self._finalize_generation(
+                    req, res, buf, is_chat, streaming
+                )
+            eval_count += 1
+            if streaming and buf and (
+                time.monotonic() - last_flush >= self.stream_flush_s
+            ):
+                await self._flush_stream(req, buf, eval_count)
+                buf, last_flush = "", time.monotonic()
+
+    async def _flush_stream(self, req, text: str, eval_count: int) -> None:
+        if not text:
+            return
+        chunk = StreamChunk(
+            id=req.id, model=req.model, created_at=iso_now(),
+            response=text, done=False, eval_count=eval_count,
+        )
+        if req.request_type == "chat":
+            chunk.message = {"role": "assistant", "content": text}
+        await self.bus.publish(f"job:stream:{req.id}", chunk.model_dump_json())
+
+    async def _finalize_generation(
+        self, req, res: GenerationResult, tail: str, is_chat: bool, streaming: bool
+    ) -> InferenceResponse:
+        if streaming and tail:
+            await self._flush_stream(req, tail, res.eval_count)
+        response = InferenceResponse(
+            id=req.id, model=req.model, created_at=iso_now(),
+            done=True, done_reason=res.done_reason,
+            total_duration=res.total_duration_ns,
+            load_duration=res.load_duration_ns,
+            prompt_eval_count=res.prompt_eval_count,
+            prompt_eval_duration=res.prompt_eval_duration_ns,
+            eval_count=res.eval_count,
+            eval_duration=res.eval_duration_ns,
+        )
+        if is_chat:
+            response.message = {"role": "assistant", "content": res.text}
+        else:
+            response.response = res.text
+            response.context = res.context
+        return response

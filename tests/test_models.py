@@ -28,6 +28,7 @@ def params_fp32():
 
 def _hf_model(params):
     torch = pytest.importorskip("torch")
+    pytest.importorskip("transformers")
     from transformers import LlamaForCausalLM
 
     model = LlamaForCausalLM(CFG.hf_config()).eval()

@@ -1,0 +1,107 @@
+"""Sharding/mesh tests on the virtual 8-device CPU mesh (SURVEY.md §4:
+"Multi-host TPU tests can run the real protocol with jax.devices('cpu')
+meshes")."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from gridllm_tpu.models import llama
+from gridllm_tpu.models.configs import get_config
+from gridllm_tpu.ops.kvcache import PagedKVCache, PageAllocator
+from gridllm_tpu.parallel import (
+    MeshConfig,
+    build_mesh,
+    cache_shardings,
+    param_shardings,
+)
+from gridllm_tpu.parallel.sharding import shard_cache, shard_params
+
+CFG = get_config("tiny-llama")
+
+
+def test_mesh_config_resolve():
+    assert MeshConfig(tp=-1).resolve(8) == (1, 1, 8, 1)
+    assert MeshConfig(dp=2, tp=-1).resolve(8) == (2, 1, 4, 1)
+    assert MeshConfig(dp=2, ep=2, tp=2, sp=1).resolve(8) == (2, 2, 2, 1)
+    with pytest.raises(ValueError):
+        MeshConfig(dp=3, tp=-1).resolve(8)
+    with pytest.raises(ValueError):
+        MeshConfig(dp=-1, tp=-1).resolve(8)
+    with pytest.raises(ValueError):
+        MeshConfig(dp=2, tp=2).resolve(8)
+
+
+def test_param_shardings_layout():
+    mesh = build_mesh(MeshConfig(dp=4, tp=2))  # tp=2 divides KVH=2 and heads=4
+    params = llama.init_params(CFG, jax.random.PRNGKey(0), dtype=jnp.float32)
+    sh = param_shardings(params, mesh)
+    assert sh["layers"]["wq"].spec == P(None, None, "tp")
+    assert sh["layers"]["wo"].spec == P(None, "tp", None)
+    assert sh["layers"]["attn_norm"].spec == P(None, None)
+    assert sh["embed"].spec == P("tp", None)
+    # lm_head [E=64, V=256]: both divisible by 2 → vocab sharded
+    assert sh["lm_head"].spec == P(None, "tp")
+
+
+def test_indivisible_dims_fall_back_to_replicated():
+    mesh = build_mesh(MeshConfig(tp=-1))  # tp=8
+    params = llama.init_params(CFG, jax.random.PRNGKey(0), dtype=jnp.float32)
+    sh = param_shardings(params, mesh)
+    # wk out dim = KVH*D = 2*16 = 32: divisible by 8 → sharded
+    assert sh["layers"]["wk"].spec == P(None, None, "tp")
+    cache = PagedKVCache.create(CFG.num_layers, 8, 4, CFG.num_kv_heads,
+                                CFG.head_dim_, 2, 4)
+    csh = cache_shardings(cache, mesh)
+    # KVH=2 not divisible by tp=8 → pool replicated on that dim
+    assert csh.k.spec == P(None, None, None, None, None)
+
+
+def test_sharded_forward_matches_single_device():
+    params = llama.init_params(CFG, jax.random.PRNGKey(1), dtype=jnp.float32)
+    tokens = jnp.asarray([[5, 17, 99, 3, 42, 7, 250, 1]], jnp.int32)
+    want = np.asarray(llama.forward(params, CFG, tokens))
+
+    mesh = build_mesh(MeshConfig(dp=4, tp=2))
+    sparams = shard_params(params, mesh)
+    got = np.asarray(jax.jit(llama.forward, static_argnums=1)(sparams, CFG, tokens))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_sharded_prefill_decode_match_single_device():
+    """The full paged pipeline under a tp=2 mesh reproduces unsharded tokens."""
+    params = llama.init_params(CFG, jax.random.PRNGKey(2), dtype=jnp.float32)
+    prompt = [5, 17, 99, 3, 42]
+
+    def run(params, cache):
+        alloc = PageAllocator(16, 8, 8)
+        alloc.alloc(0, 16)
+        row = jnp.asarray(alloc.table_row(0), jnp.int32)
+        padded = jnp.asarray(prompt + [0] * 3, jnp.int32)
+        logits, cache = llama.prefill(
+            params, CFG, padded, jnp.int32(len(prompt)), cache, jnp.int32(0), row
+        )
+        out = [int(jnp.argmax(logits))]
+        tok = jnp.zeros((cache.max_slots,), jnp.int32).at[0].set(out[0])
+        active = jnp.zeros((cache.max_slots,), bool).at[0].set(True)
+        for _ in range(4):
+            logits, cache = llama.decode_step(params, CFG, tok, cache, active)
+            nxt = int(jnp.argmax(logits[0]))
+            out.append(nxt)
+            tok = tok.at[0].set(nxt)
+        return out
+
+    def fresh_cache():
+        c = PagedKVCache.create(CFG.num_layers, 16, 8, CFG.num_kv_heads,
+                                CFG.head_dim_, 4, 8)
+        return PagedKVCache(k=c.k.astype(jnp.float32), v=c.v.astype(jnp.float32),
+                            page_table=c.page_table, lengths=c.lengths,
+                            page_size=c.page_size)
+
+    want = run(params, fresh_cache())
+
+    mesh = build_mesh(MeshConfig(dp=1, tp=2, sp=-1))  # tp=2, sp absorbs 4
+    got = run(shard_params(params, mesh), shard_cache(fresh_cache(), mesh))
+    assert got == want
