@@ -47,32 +47,17 @@ def _open_safetensors(path: str) -> dict[str, Callable[[], np.ndarray]]:
     return index
 
 
-# our leaf path → (HF name template, transpose?). {} is the layer index.
-_LLAMA_MAP: dict[str, tuple[str, bool]] = {
-    "attn_norm": ("model.layers.{}.input_layernorm.weight", False),
-    "wq": ("model.layers.{}.self_attn.q_proj.weight", True),
-    "wk": ("model.layers.{}.self_attn.k_proj.weight", True),
-    "wv": ("model.layers.{}.self_attn.v_proj.weight", True),
-    "wo": ("model.layers.{}.self_attn.o_proj.weight", True),
-    "mlp_norm": ("model.layers.{}.post_attention_layernorm.weight", False),
-    "w_gate": ("model.layers.{}.mlp.gate_proj.weight", True),
-    "w_up": ("model.layers.{}.mlp.up_proj.weight", True),
-    "w_down": ("model.layers.{}.mlp.down_proj.weight", True),
-}
+def _name_map(cfg: ModelConfig) -> dict[str, tuple[str, bool]]:
+    """The family's HF layout contract — owned by the model module
+    (llama.HF_MAP / mixtral.HF_MAP) so loader and state-dict converter
+    cannot drift. {} is the layer index; an extra {} the expert index."""
+    if cfg.family == "mixtral":
+        from gridllm_tpu.models import mixtral
 
-_MIXTRAL_MAP: dict[str, tuple[str, bool]] = {
-    "attn_norm": ("model.layers.{}.input_layernorm.weight", False),
-    "wq": ("model.layers.{}.self_attn.q_proj.weight", True),
-    "wk": ("model.layers.{}.self_attn.k_proj.weight", True),
-    "wv": ("model.layers.{}.self_attn.v_proj.weight", True),
-    "wo": ("model.layers.{}.self_attn.o_proj.weight", True),
-    "mlp_norm": ("model.layers.{}.post_attention_layernorm.weight", False),
-    "router": ("model.layers.{}.block_sparse_moe.gate.weight", True),
-    # expert maps handled specially (extra {} for expert index)
-    "we_gate": ("model.layers.{}.block_sparse_moe.experts.{}.w1.weight", True),
-    "we_down": ("model.layers.{}.block_sparse_moe.experts.{}.w2.weight", True),
-    "we_up": ("model.layers.{}.block_sparse_moe.experts.{}.w3.weight", True),
-}
+        return mixtral.HF_MAP
+    from gridllm_tpu.models import llama
+
+    return llama.HF_MAP
 
 
 def load_checkpoint(
@@ -89,8 +74,7 @@ def load_checkpoint(
     """
     idx = _open_safetensors(path)
     L = cfg.num_layers
-    is_moe = cfg.family == "mixtral"
-    name_map = _MIXTRAL_MAP if is_moe else _LLAMA_MAP
+    name_map = _name_map(cfg)
 
     def place(pathkeys: tuple[str, ...], arr: np.ndarray):
         arr = jnp.asarray(arr, dtype)
@@ -137,8 +121,7 @@ def save_checkpoint(params: Any, cfg: ModelConfig, path: str) -> None:
     from safetensors.numpy import save_file
 
     os.makedirs(path, exist_ok=True)
-    is_moe = cfg.family == "mixtral"
-    name_map = _MIXTRAL_MAP if is_moe else _LLAMA_MAP
+    name_map = _name_map(cfg)
     out: dict[str, np.ndarray] = {
         "model.embed_tokens.weight": np.asarray(params["embed"], np.float32),
         "model.norm.weight": np.asarray(params["final_norm"], np.float32),

@@ -18,7 +18,7 @@ contract (see convert_hf_state_dict).
 
 from __future__ import annotations
 
-from typing import Any
+from typing import Any, Callable
 
 import jax
 import jax.numpy as jnp
@@ -29,6 +29,12 @@ from gridllm_tpu.ops.kvcache import PagedKVCache, write_decode, write_prefill
 from gridllm_tpu.ops.layers import apply_rope, precompute_rope, rms_norm
 
 Params = dict[str, Any]
+
+# Per-layer FFN body: (layer params, normed activations) -> FFN output.
+# llama uses the dense SwiGLU `_mlp`; models/mixtral.py routes its sparse
+# MoE body through the same decoder skeleton (attention/norm/paged-cache
+# structure is identical across both families).
+MlpFn = Callable[["Params", jnp.ndarray], jnp.ndarray]
 
 
 def _precision(x: jnp.ndarray):
@@ -45,9 +51,14 @@ def _check_supported(cfg: ModelConfig) -> None:
         raise NotImplementedError(f"{cfg.name}: sliding_window")
 
 
-def init_params(cfg: ModelConfig, key: jax.Array, dtype=jnp.bfloat16) -> Params:
+def init_params(
+    cfg: ModelConfig, key: jax.Array, dtype=jnp.bfloat16, dense_ffn: bool = True
+) -> Params:
     """Random-init params (tests + synthetic bench; real loads go through
-    engine/loader.py)."""
+    engine/loader.py). `dense_ffn=False` skips the SwiGLU leaves — the MoE
+    family reuses the attention skeleton and supplies its own expert leaves
+    (materializing dense FFNs only to delete them would transiently cost
+    ~11 GB at 8x7b scale)."""
     e, f, v = cfg.hidden_size, cfg.intermediate_size, cfg.vocab_size
     h, kvh, d, L = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim_, cfg.num_layers
     ks = iter(jax.random.split(key, 16))
@@ -65,12 +76,13 @@ def init_params(cfg: ModelConfig, key: jax.Array, dtype=jnp.bfloat16) -> Params:
             "wv": w(next(ks), L, e, kvh * d),
             "wo": w(next(ks), L, h * d, e),
             "mlp_norm": jnp.ones((L, e), dtype),
-            "w_gate": w(next(ks), L, e, f),
-            "w_up": w(next(ks), L, e, f),
-            "w_down": w(next(ks), L, f, e),
         },
         "final_norm": jnp.ones((e,), dtype),
     }
+    if dense_ffn:
+        params["layers"]["w_gate"] = w(next(ks), L, e, f)
+        params["layers"]["w_up"] = w(next(ks), L, e, f)
+        params["layers"]["w_down"] = w(next(ks), L, f, e)
     if not cfg.tie_embeddings:
         params["lm_head"] = w(next(ks), e, v, scale=0.02)
     return params
@@ -100,7 +112,9 @@ def _unembed(cfg: ModelConfig, params: Params, x: jnp.ndarray) -> jnp.ndarray:
     )
 
 
-def hidden_states(params: Params, cfg: ModelConfig, tokens: jnp.ndarray) -> jnp.ndarray:
+def hidden_states(
+    params: Params, cfg: ModelConfig, tokens: jnp.ndarray, mlp: MlpFn = _mlp
+) -> jnp.ndarray:
     """Final-norm hidden states [B, T, E] (embeddings path; no unembed)."""
     _check_supported(cfg)
     b, t = tokens.shape
@@ -117,19 +131,21 @@ def hidden_states(params: Params, cfg: ModelConfig, tokens: jnp.ndarray) -> jnp.
         attn = attention_prefill(q, k, v, seq_lens).reshape(b, t, -1)
         x = x + jnp.dot(attn, lp["wo"], precision=_precision(x))
         hx = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
-        return x + _mlp(lp, hx), None
+        return x + mlp(lp, hx), None
 
     x, _ = jax.lax.scan(layer, x, params["layers"])
     return rms_norm(x, params["final_norm"], cfg.rms_eps)
 
 
-def forward(params: Params, cfg: ModelConfig, tokens: jnp.ndarray) -> jnp.ndarray:
+def forward(
+    params: Params, cfg: ModelConfig, tokens: jnp.ndarray, mlp: MlpFn = _mlp
+) -> jnp.ndarray:
     """Cache-free full forward: tokens [B, T] → logits [B, T, V] (fp32).
 
     The oracle path — golden tests compare this against HF; prefill/decode
     must agree with it (tested in tests/test_models.py).
     """
-    return _unembed(cfg, params, hidden_states(params, cfg, tokens))
+    return _unembed(cfg, params, hidden_states(params, cfg, tokens, mlp))
 
 
 def prefill(
@@ -140,6 +156,7 @@ def prefill(
     cache: PagedKVCache,
     slot: jnp.ndarray,
     table_row: jnp.ndarray,
+    mlp: MlpFn = _mlp,
 ) -> tuple[jnp.ndarray, PagedKVCache]:
     """Prefill ONE slot. tokens: [T] (padded bucket), length: scalar valid
     count, table_row: [max_pages] this slot's pages. Returns (last-token
@@ -165,7 +182,7 @@ def prefill(
         attn = attention_prefill(q, k, v, seq_lens).reshape(1, t, -1)
         x = x + jnp.dot(attn, lp["wo"], precision=_precision(x))
         hx = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
-        return x + _mlp(lp, hx), (k_pages, v_pages)
+        return x + mlp(lp, hx), (k_pages, v_pages)
 
     x, (k_new, v_new) = jax.lax.scan(layer, x, (params["layers"], cache.k, cache.v))
     x = rms_norm(x, params["final_norm"], cfg.rms_eps)
@@ -188,6 +205,7 @@ def decode_step(
     tokens: jnp.ndarray,
     cache: PagedKVCache,
     active: jnp.ndarray,
+    mlp: MlpFn = _mlp,
 ) -> tuple[jnp.ndarray, PagedKVCache]:
     """One decode step for ALL slots. tokens: [S] (last sampled token per
     slot), active: [S] bool. Returns (logits [S, V] fp32, updated cache
@@ -215,7 +233,7 @@ def decode_step(
         ).reshape(s, -1)
         x = x + jnp.dot(attn, lp["wo"], precision=_precision(x))
         hx = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
-        return x + _mlp(lp, hx), (k_pages, v_pages)
+        return x + mlp(lp, hx), (k_pages, v_pages)
 
     x, (k_new, v_new) = jax.lax.scan(layer, x, (params["layers"], cache.k, cache.v))
     x = rms_norm(x, params["final_norm"], cfg.rms_eps)
@@ -232,12 +250,32 @@ def decode_step(
 # HF weight conversion (layout contract with transformers LlamaForCausalLM)
 # ---------------------------------------------------------------------------
 
-def convert_hf_state_dict(cfg: ModelConfig, sd: dict[str, Any], dtype=jnp.bfloat16) -> Params:
-    """HF `LlamaForCausalLM.state_dict()`-style mapping → our pytree.
+# Single source of truth for the HF<->ours layout contract: our layer-leaf
+# name → (HF tensor name template, transpose?). {} is the layer index (an
+# extra {} is the expert index for MoE leaves). engine/loader.py drives the
+# safetensors path off this same table. HF stores projections [out, in];
+# we keep [in, out] so forward is x @ W — hence transpose=True on matmuls.
+HF_MAP: dict[str, tuple[str, bool]] = {
+    "attn_norm": ("model.layers.{}.input_layernorm.weight", False),
+    "wq": ("model.layers.{}.self_attn.q_proj.weight", True),
+    "wk": ("model.layers.{}.self_attn.k_proj.weight", True),
+    "wv": ("model.layers.{}.self_attn.v_proj.weight", True),
+    "wo": ("model.layers.{}.self_attn.o_proj.weight", True),
+    "mlp_norm": ("model.layers.{}.post_attention_layernorm.weight", False),
+    "w_gate": ("model.layers.{}.mlp.gate_proj.weight", True),
+    "w_up": ("model.layers.{}.mlp.up_proj.weight", True),
+    "w_down": ("model.layers.{}.mlp.down_proj.weight", True),
+}
 
-    Accepts numpy/torch tensors (anything np.asarray handles). HF stores
-    projections as [out, in]; we keep [in, out] so the forward is x @ W.
-    """
+
+def convert_state_dict(
+    cfg: ModelConfig,
+    sd: dict[str, Any],
+    name_map: dict[str, tuple[str, bool]],
+    dtype=jnp.bfloat16,
+) -> Params:
+    """Generic HF state_dict → stacked-layer pytree, driven by a name map
+    (llama's HF_MAP or mixtral's). Accepts numpy/torch tensors."""
     import numpy as np
 
     def get(name):
@@ -248,26 +286,27 @@ def convert_hf_state_dict(cfg: ModelConfig, sd: dict[str, Any], dtype=jnp.bfloat
 
     L = cfg.num_layers
 
-    def stack(fmt, transpose=True):
-        ws = [get(fmt.format(i)) for i in range(L)]
-        ws = [w.T if transpose else w for w in ws]
-        return jnp.asarray(np.stack(ws), dtype)
+    def stacked(tmpl: str, transpose: bool):
+        if "experts" in tmpl:
+            def one(i):
+                es = [get(tmpl.format(i, x)) for x in range(cfg.num_experts)]
+                return np.stack([e.T if transpose else e for e in es])
+        else:
+            def one(i):
+                w = get(tmpl.format(i))
+                return w.T if transpose else w
+        return jnp.asarray(np.stack([one(i) for i in range(L)]), dtype)
 
     params: Params = {
         "embed": jnp.asarray(get("model.embed_tokens.weight"), dtype),
-        "layers": {
-            "attn_norm": stack("model.layers.{}.input_layernorm.weight", transpose=False),
-            "wq": stack("model.layers.{}.self_attn.q_proj.weight"),
-            "wk": stack("model.layers.{}.self_attn.k_proj.weight"),
-            "wv": stack("model.layers.{}.self_attn.v_proj.weight"),
-            "wo": stack("model.layers.{}.self_attn.o_proj.weight"),
-            "mlp_norm": stack("model.layers.{}.post_attention_layernorm.weight", transpose=False),
-            "w_gate": stack("model.layers.{}.mlp.gate_proj.weight"),
-            "w_up": stack("model.layers.{}.mlp.up_proj.weight"),
-            "w_down": stack("model.layers.{}.mlp.down_proj.weight"),
-        },
+        "layers": {n: stacked(t, tr) for n, (t, tr) in name_map.items()},
         "final_norm": jnp.asarray(get("model.norm.weight"), dtype),
     }
     if not cfg.tie_embeddings:
         params["lm_head"] = jnp.asarray(get("lm_head.weight").T, dtype)
     return params
+
+
+def convert_hf_state_dict(cfg: ModelConfig, sd: dict[str, Any], dtype=jnp.bfloat16) -> Params:
+    """HF `LlamaForCausalLM.state_dict()`-style mapping → our pytree."""
+    return convert_state_dict(cfg, sd, HF_MAP, dtype)

@@ -1,0 +1,133 @@
+"""Mixtral sparse-MoE decoder (BASELINE.md config #4: mixtral:8x7b EP).
+
+Shares llama's decoder skeleton (attention, norms, paged KV cache) and
+swaps the FFN for a top-k routed mixture of experts. The reference has no
+MoE (or any model) code — SURVEY.md §2.5 marks expert parallelism "No …
+north star names Mixtral 8×7B EP as a target config".
+
+TPU-first routing design: every expert computes every token, with
+non-selected (token, expert) pairs zero-weighted — the einsum over the
+stacked expert axis X keeps the MXU fed with one big batched matmul and,
+under GSPMD, shards cleanly on the "ep" mesh axis (each shard computes
+only its X/ep experts for all tokens, then the weighted combine is the
+all-reduce XLA inserts; see parallel/sharding.py `we_*` specs). This
+trades X/top_k extra FLOPs for zero dynamic shapes, no token dropping,
+and no host-visible dispatch — the right trade at decode batch sizes,
+where the expert matmuls are bandwidth-bound on the weights either way.
+A ragged/sorted dispatch Pallas kernel is the future optimization for
+long-prompt prefill (PAPERS.md MoE dispatch patterns).
+
+Routing numerics follow HF `MixtralSparseMoeBlock`: softmax over ALL
+expert logits in fp32 → top-k → renormalize the selected weights.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from gridllm_tpu.models import llama
+from gridllm_tpu.models.configs import ModelConfig
+from gridllm_tpu.ops.kvcache import PagedKVCache
+
+Params = dict[str, Any]
+
+
+def _moe_mlp(cfg: ModelConfig, lp: Params, x: jnp.ndarray) -> jnp.ndarray:
+    """Sparse-MoE FFN: x [..., E] → [..., E].
+
+    lp carries router [E, X] and stacked experts we_gate/we_up [X, E, F],
+    we_down [X, F, E] (the per-layer slice of the [L, X, ...] leaves).
+    """
+    p = llama._precision(x)
+    probs = jax.nn.softmax(
+        jnp.dot(x.astype(jnp.float32), lp["router"].astype(jnp.float32)), axis=-1
+    )  # [..., X] fp32 — router math stays fp32 (tiny; routing flips are costly)
+    top_w, top_i = jax.lax.top_k(probs, cfg.experts_per_token)
+    top_w = top_w / top_w.sum(axis=-1, keepdims=True)
+    one_hot = jax.nn.one_hot(top_i, cfg.num_experts, dtype=jnp.float32)
+    gates = jnp.einsum("...k,...kx->...x", top_w, one_hot).astype(x.dtype)
+
+    g = jnp.einsum("...e,xef->...xf", x, lp["we_gate"], precision=p)
+    u = jnp.einsum("...e,xef->...xf", x, lp["we_up"], precision=p)
+    y = jax.nn.silu(g) * u * gates[..., None]
+    return jnp.einsum("...xf,xfe->...e", y, lp["we_down"], precision=p)
+
+
+def init_params(cfg: ModelConfig, key: jax.Array, dtype=jnp.bfloat16) -> Params:
+    """Random-init params: llama attention skeleton + MoE expert leaves."""
+    e, f = cfg.hidden_size, cfg.intermediate_size
+    X, L = cfg.num_experts, cfg.num_layers
+    base_key, k_r, k_g, k_u, k_d = jax.random.split(key, 5)
+    params = llama.init_params(cfg, base_key, dtype, dense_ffn=False)
+    lp = params["layers"]
+
+    def w(k, *shape, scale=None):
+        scale = scale if scale is not None else (shape[-2] ** -0.5)
+        return (jax.random.normal(k, shape, jnp.float32) * scale).astype(dtype)
+
+    lp["router"] = w(k_r, L, e, X, scale=0.02)
+    lp["we_gate"] = w(k_g, L, X, e, f)
+    lp["we_up"] = w(k_u, L, X, e, f)
+    lp["we_down"] = w(k_d, L, X, f, e)
+    return params
+
+
+def _mlp_for(cfg: ModelConfig):
+    return partial(_moe_mlp, cfg)
+
+
+def hidden_states(params: Params, cfg: ModelConfig, tokens: jnp.ndarray) -> jnp.ndarray:
+    return llama.hidden_states(params, cfg, tokens, mlp=_mlp_for(cfg))
+
+
+def forward(params: Params, cfg: ModelConfig, tokens: jnp.ndarray) -> jnp.ndarray:
+    return llama.forward(params, cfg, tokens, mlp=_mlp_for(cfg))
+
+
+def prefill(
+    params: Params,
+    cfg: ModelConfig,
+    tokens: jnp.ndarray,
+    length: jnp.ndarray,
+    cache: PagedKVCache,
+    slot: jnp.ndarray,
+    table_row: jnp.ndarray,
+) -> tuple[jnp.ndarray, PagedKVCache]:
+    return llama.prefill(
+        params, cfg, tokens, length, cache, slot, table_row, mlp=_mlp_for(cfg)
+    )
+
+
+def decode_step(
+    params: Params,
+    cfg: ModelConfig,
+    tokens: jnp.ndarray,
+    cache: PagedKVCache,
+    active: jnp.ndarray,
+) -> tuple[jnp.ndarray, PagedKVCache]:
+    return llama.decode_step(params, cfg, tokens, cache, active, mlp=_mlp_for(cfg))
+
+
+# ---------------------------------------------------------------------------
+# HF weight conversion (layout contract with transformers MixtralForCausalLM)
+# ---------------------------------------------------------------------------
+
+# Same single-source-of-truth scheme as llama.HF_MAP (w1=gate, w2=down,
+# w3=up per HF MixtralBlockSparseTop2MLP); engine/loader.py reads this.
+HF_MAP: dict[str, tuple[str, bool]] = {
+    **{k: v for k, v in llama.HF_MAP.items()
+       if k not in ("w_gate", "w_up", "w_down")},
+    "router": ("model.layers.{}.block_sparse_moe.gate.weight", True),
+    "we_gate": ("model.layers.{}.block_sparse_moe.experts.{}.w1.weight", True),
+    "we_down": ("model.layers.{}.block_sparse_moe.experts.{}.w2.weight", True),
+    "we_up": ("model.layers.{}.block_sparse_moe.experts.{}.w3.weight", True),
+}
+
+
+def convert_hf_state_dict(cfg: ModelConfig, sd: dict[str, Any], dtype=jnp.bfloat16) -> Params:
+    """HF `MixtralForCausalLM.state_dict()` → our pytree."""
+    return llama.convert_state_dict(cfg, sd, HF_MAP, dtype)

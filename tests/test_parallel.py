@@ -105,3 +105,22 @@ def test_sharded_prefill_decode_match_single_device():
     mesh = build_mesh(MeshConfig(dp=1, tp=2, sp=-1))  # tp=2, sp absorbs 4
     got = run(shard_params(params, mesh), shard_cache(fresh_cache(), mesh))
     assert got == want
+
+
+def test_ep_sharded_mixtral_matches_single_device():
+    """Mixtral under an ep×tp mesh reproduces unsharded logits — the expert
+    einsum must shard on "ep" (weighted combine becomes the all-reduce)."""
+    from gridllm_tpu.models import mixtral
+
+    mcfg = get_config("tiny-mixtral")
+    params = mixtral.init_params(mcfg, jax.random.PRNGKey(3), dtype=jnp.float32)
+    tokens = jnp.asarray([[5, 17, 99, 3, 42, 7, 250, 1]], jnp.int32)
+    want = np.asarray(mixtral.forward(params, mcfg, tokens))
+
+    mesh = build_mesh(MeshConfig(dp=2, ep=2, tp=2))  # X=4 experts / ep=2
+    sh = param_shardings(params, mesh)
+    assert sh["layers"]["we_gate"].spec == P(None, "ep", None, "tp")
+    assert sh["layers"]["we_down"].spec == P(None, "ep", "tp", None)
+    sparams = shard_params(params, mesh)
+    got = np.asarray(jax.jit(mixtral.forward, static_argnums=1)(sparams, mcfg, tokens))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
