@@ -147,6 +147,12 @@ class InferenceEngine:
             config.tokenizer, self.cfg.vocab_size
         )
         self.mesh = build_mesh(config.mesh) if config.mesh else None
+        if self.mesh is not None:
+            # pallas_call has no GSPMD partitioning rule; under a mesh the
+            # jnp attention path shards correctly — see ops.attention
+            from gridllm_tpu.ops.attention import configure_pallas
+
+            configure_pallas(False)
         self._rng = random.Random(config.seed)
         self._lock = threading.Lock()
         self._pending: deque[GenerationRequest] = deque()

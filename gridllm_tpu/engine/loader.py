@@ -72,47 +72,23 @@ def load_checkpoint(
     the same structure) — each leaf is placed onto its sharding as soon as it
     is assembled.
     """
+    from gridllm_tpu.models import hf_layout
+
     idx = _open_safetensors(path)
-    L = cfg.num_layers
-    name_map = _name_map(cfg)
 
     def place(pathkeys: tuple[str, ...], arr: np.ndarray):
-        arr = jnp.asarray(arr, dtype)
+        out = jnp.asarray(arr, dtype)
         if shardings is not None:
             s = shardings
             for k in pathkeys:
                 s = s[k]
-            arr = jax.device_put(arr, s)
-        return arr
-
-    def leaf(name: str) -> tuple[str, ...]:
-        return ("layers", name)
-
-    def load_stacked(name: str, tmpl: str, transpose: bool):
-        if "experts" in tmpl:
-            def one_layer(i):
-                es = [idx[tmpl.format(i, e)]() for e in range(cfg.num_experts)]
-                es = [e.T if transpose else e for e in es]
-                return np.stack(es)
-        else:
-            def one_layer(i):
-                w = idx[tmpl.format(i)]()
-                return w.T if transpose else w
-        stacked = np.stack([np.asarray(one_layer(i), np.float32) for i in range(L)])
-        out = place(leaf(name), stacked)
-        log.debug("loaded leaf", leaf=name, shape=list(out.shape))
+            out = jax.device_put(out, s)
+        log.debug("loaded leaf", leaf="/".join(pathkeys), shape=list(out.shape))
         return out
 
-    params: dict[str, Any] = {
-        "embed": place(("embed",), np.asarray(idx["model.embed_tokens.weight"]())),
-        "layers": {},
-        "final_norm": place(("final_norm",), np.asarray(idx["model.norm.weight"]())),
-    }
-    for name, (tmpl, transpose) in name_map.items():
-        params["layers"][name] = load_stacked(name, tmpl, transpose)
-    if not cfg.tie_embeddings:
-        params["lm_head"] = place(("lm_head",), np.asarray(idx["lm_head.weight"]()).T)
-    return params
+    return hf_layout.to_pytree(
+        cfg, lambda name: idx[name](), _name_map(cfg), dtype, place
+    )
 
 
 def save_checkpoint(params: Any, cfg: ModelConfig, path: str) -> None:
@@ -120,24 +96,10 @@ def save_checkpoint(params: Any, cfg: ModelConfig, path: str) -> None:
     (round-trip for tests + lets checkpoints produced here load in HF)."""
     from safetensors.numpy import save_file
 
+    from gridllm_tpu.models import hf_layout
+
     os.makedirs(path, exist_ok=True)
-    name_map = _name_map(cfg)
-    out: dict[str, np.ndarray] = {
-        "model.embed_tokens.weight": np.asarray(params["embed"], np.float32),
-        "model.norm.weight": np.asarray(params["final_norm"], np.float32),
-    }
-    for name, (tmpl, transpose) in name_map.items():
-        stacked = np.asarray(params["layers"][name], np.float32)
-        for i in range(cfg.num_layers):
-            if "experts" in tmpl:
-                for e in range(cfg.num_experts):
-                    w = stacked[i, e]
-                    out[tmpl.format(i, e)] = w.T.copy() if transpose else w.copy()
-            else:
-                w = stacked[i]
-                out[tmpl.format(i)] = w.T.copy() if transpose else w.copy()
-    if not cfg.tie_embeddings:
-        out["lm_head.weight"] = np.asarray(params["lm_head"], np.float32).T.copy()
+    out = hf_layout.to_hf_tensors(params, cfg, _name_map(cfg))
     save_file(out, os.path.join(path, "model.safetensors"))
     with open(os.path.join(path, "config.json"), "w") as f:
         json.dump({"model_name": cfg.name}, f)

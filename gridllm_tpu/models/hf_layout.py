@@ -1,0 +1,93 @@
+"""HF checkpoint layout: the ONE place that assembles/flattens our
+stacked-layer pytree from/to HF tensor names.
+
+Consumers: llama/mixtral `convert_hf_state_dict` (torch state dicts) and
+engine/loader.py (safetensors files + sharded placement). Each family owns
+its name map (`llama.HF_MAP` / `mixtral.HF_MAP`: our leaf name → (HF name
+template, transpose?)); this module owns the stacking mechanics so the
+three call sites cannot drift (templates with an expert slot — two `{}`
+placeholders — expand over cfg.num_experts into a [L, X, ...] leaf).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax.numpy as jnp
+import numpy as np
+
+from gridllm_tpu.models.configs import ModelConfig
+
+# get(hf_name) -> host array; place(pytree_path, host_array) -> device leaf
+Get = Callable[[str], np.ndarray]
+Place = Callable[[tuple[str, ...], np.ndarray], Any]
+
+
+def is_expert_leaf(tmpl: str) -> bool:
+    """Templates with two {} slots (layer, expert) stack an extra X axis."""
+    return tmpl.count("{}") == 2
+
+
+def to_pytree(
+    cfg: ModelConfig,
+    get: Get,
+    name_map: dict[str, tuple[str, bool]],
+    dtype=jnp.bfloat16,
+    place: Place | None = None,
+) -> dict[str, Any]:
+    """Assemble the params pytree: stack per-layer (and per-expert) HF
+    tensors onto leading axes, transposing matmul weights to [in, out]."""
+    if place is None:
+        place = lambda path, arr: jnp.asarray(arr, dtype)  # noqa: E731
+    L = cfg.num_layers
+
+    def stacked(tmpl: str, transpose: bool) -> np.ndarray:
+        if is_expert_leaf(tmpl):
+            def one(i):
+                es = [get(tmpl.format(i, x)) for x in range(cfg.num_experts)]
+                return np.stack([e.T if transpose else e for e in es])
+        else:
+            def one(i):
+                w = get(tmpl.format(i))
+                return w.T if transpose else w
+        return np.stack([np.asarray(one(i)) for i in range(L)])
+
+    params: dict[str, Any] = {
+        "embed": place(("embed",), np.asarray(get("model.embed_tokens.weight"))),
+        "layers": {
+            n: place(("layers", n), stacked(t, tr))
+            for n, (t, tr) in name_map.items()
+        },
+        "final_norm": place(("final_norm",), np.asarray(get("model.norm.weight"))),
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = place(
+            ("lm_head",), np.asarray(get("lm_head.weight")).T
+        )
+    return params
+
+
+def to_hf_tensors(
+    params: dict[str, Any],
+    cfg: ModelConfig,
+    name_map: dict[str, tuple[str, bool]],
+) -> dict[str, np.ndarray]:
+    """Inverse of to_pytree: flatten our pytree into HF-named fp32 tensors
+    (checkpoint save + round-trip tests)."""
+    out: dict[str, np.ndarray] = {
+        "model.embed_tokens.weight": np.asarray(params["embed"], np.float32),
+        "model.norm.weight": np.asarray(params["final_norm"], np.float32),
+    }
+    for name, (tmpl, transpose) in name_map.items():
+        stacked = np.asarray(params["layers"][name], np.float32)
+        for i in range(cfg.num_layers):
+            if is_expert_leaf(tmpl):
+                for x in range(cfg.num_experts):
+                    w = stacked[i, x]
+                    out[tmpl.format(i, x)] = w.T.copy() if transpose else w.copy()
+            else:
+                w = stacked[i]
+                out[tmpl.format(i)] = w.T.copy() if transpose else w.copy()
+    if not cfg.tie_embeddings:
+        out["lm_head.weight"] = np.asarray(params["lm_head"], np.float32).T.copy()
+    return out

@@ -278,33 +278,15 @@ def convert_state_dict(
     (llama's HF_MAP or mixtral's). Accepts numpy/torch tensors."""
     import numpy as np
 
+    from gridllm_tpu.models import hf_layout
+
     def get(name):
         t = sd[name]
         if hasattr(t, "detach"):
             t = t.detach().to("cpu").float().numpy()
         return np.asarray(t)
 
-    L = cfg.num_layers
-
-    def stacked(tmpl: str, transpose: bool):
-        if "experts" in tmpl:
-            def one(i):
-                es = [get(tmpl.format(i, x)) for x in range(cfg.num_experts)]
-                return np.stack([e.T if transpose else e for e in es])
-        else:
-            def one(i):
-                w = get(tmpl.format(i))
-                return w.T if transpose else w
-        return jnp.asarray(np.stack([one(i) for i in range(L)]), dtype)
-
-    params: Params = {
-        "embed": jnp.asarray(get("model.embed_tokens.weight"), dtype),
-        "layers": {n: stacked(t, tr) for n, (t, tr) in name_map.items()},
-        "final_norm": jnp.asarray(get("model.norm.weight"), dtype),
-    }
-    if not cfg.tie_embeddings:
-        params["lm_head"] = jnp.asarray(get("lm_head.weight").T, dtype)
-    return params
+    return hf_layout.to_pytree(cfg, get, name_map, dtype)
 
 
 def convert_hf_state_dict(cfg: ModelConfig, sd: dict[str, Any], dtype=jnp.bfloat16) -> Params:

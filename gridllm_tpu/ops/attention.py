@@ -1,9 +1,15 @@
 """Attention: causal prefill and paged decode.
 
-Pure-jnp reference implementations — correct on CPU and TPU, numerically
-the oracle for the Pallas kernels in `ops/pallas_kernels.py`. Softmax is
-computed in fp32 regardless of input dtype (bf16 accumulation loses real
-accuracy at long context).
+Public entry points (`attention_prefill`, `paged_attention_decode`)
+dispatch between the Pallas TPU kernels (ops/pallas_kernels.py) and the
+pure-jnp reference implementations (`*_ref` here) — the jnp versions are
+correct on CPU and TPU and are the numerical oracle for the kernels
+(tests/test_pallas.py). Softmax is computed in fp32 regardless of input
+dtype (bf16 accumulation loses real accuracy at long context).
+
+Kernel selection: env `GRIDLLM_PALLAS` = "auto" (default: kernels on TPU
+backends only), "1" (force on), "0" (force off), "interpret" (kernels in
+interpreter mode — CPU testing).
 
 GQA convention: q has H heads, k/v have KVH heads, H % KVH == 0; kv heads
 are logically repeated H//KVH times (implemented via reshape-grouping, no
@@ -11,6 +17,9 @@ materialized repeat).
 """
 
 from __future__ import annotations
+
+import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -20,7 +29,92 @@ from gridllm_tpu.ops.kvcache import gather_kv
 _NEG_INF = -1e30
 
 
+# Runtime override (beats the env var): the engine sets this to False when
+# it builds a device mesh — pallas_call has no GSPMD partitioning rule, so
+# inside a sharded jit the kernels would force replication (or fail to
+# partition) instead of riding the tp sharding. Sharded serving uses the
+# jnp path (XLA's fused attention shards fine); a shard_map kernel
+# integration is the planned follow-up.
+_runtime_override: bool | None = None
+
+# VMEM budget for flash_prefill's resident per-head K+V (the kernel pins
+# [T, D] of each); past this, Mosaic would reject the kernel at compile
+# time (~16 MB/core), so dispatch falls back to the jnp path. Chunked HBM
+# streaming for very long prefill buckets is future kernel work.
+_FLASH_KV_VMEM_CAP = 8 * 1024 * 1024
+
+
+def configure_pallas(enabled: bool | None) -> None:
+    """Force kernels on/off at runtime (None restores env/auto policy)."""
+    global _runtime_override
+    _runtime_override = enabled
+
+
+@functools.cache
+def _env_mode() -> tuple[bool, bool]:
+    """(use_kernels, interpret) from the environment, resolved once."""
+    raw = os.environ.get("GRIDLLM_PALLAS", "auto").lower()
+    if raw in ("0", "off", "false"):
+        return False, False
+    if raw in ("1", "on", "true"):
+        return True, False
+    if raw == "interpret":
+        return True, True
+    return jax.default_backend() == "tpu", False
+
+
+def _pallas_mode() -> tuple[bool, bool]:
+    use, interpret = _env_mode()
+    if _runtime_override is not None:
+        use = _runtime_override
+    return use, interpret
+
+
 def attention_prefill(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    seq_lens: jnp.ndarray,
+) -> jnp.ndarray:
+    """Causal GQA prefill attention (see attention_prefill_ref for the
+    contract). Routes to the flash kernel when enabled, the shape is
+    block-divisible (all engine prefill buckets are), and the per-head
+    K+V fit the VMEM budget."""
+    use, interpret = _pallas_mode()
+    t, d = q.shape[1], q.shape[3]
+    kv_bytes = 2 * t * d * q.dtype.itemsize
+    if use and t % min(128, t) == 0 and kv_bytes <= _FLASH_KV_VMEM_CAP:
+        from gridllm_tpu.ops import pallas_kernels
+
+        return pallas_kernels.flash_prefill(q, k, v, seq_lens,
+                                            interpret=interpret)
+    return attention_prefill_ref(q, k, v, seq_lens)
+
+
+def paged_attention_decode(
+    q: jnp.ndarray,
+    k_pages: jnp.ndarray,
+    v_pages: jnp.ndarray,
+    page_table: jnp.ndarray,
+    lengths: jnp.ndarray,
+    page_size: int,
+) -> jnp.ndarray:
+    """Paged decode attention (see paged_attention_decode_ref for the
+    contract). Routes to the page-streaming kernel when enabled."""
+    use, interpret = _pallas_mode()
+    if use:
+        from gridllm_tpu.ops import pallas_kernels
+
+        return pallas_kernels.paged_decode(
+            q, k_pages, v_pages, page_table, lengths, page_size,
+            interpret=interpret,
+        )
+    return paged_attention_decode_ref(
+        q, k_pages, v_pages, page_table, lengths, page_size
+    )
+
+
+def attention_prefill_ref(
     q: jnp.ndarray,
     k: jnp.ndarray,
     v: jnp.ndarray,
@@ -58,7 +152,7 @@ def attention_prefill(
     return out.reshape(b, t, h, d).astype(q.dtype)
 
 
-def paged_attention_decode(
+def paged_attention_decode_ref(
     q: jnp.ndarray,
     k_pages: jnp.ndarray,
     v_pages: jnp.ndarray,

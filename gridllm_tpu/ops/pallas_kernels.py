@@ -1,0 +1,283 @@
+"""Pallas TPU attention kernels (SURVEY.md §7 step 5: "paged KV cache +
+Pallas flash-attention kernel" is where the baseline metric is won).
+
+Two kernels, each with the pure-jnp implementation in ops/attention.py as
+its numerical oracle (tests/test_pallas.py runs both in interpret mode on
+CPU and asserts equality):
+
+- `flash_prefill`: causal GQA flash attention over one prompt chunk.
+  Grid (KVH, q-blocks); K/V for the grid's kv head stay VMEM-resident
+  across q blocks; online-softmax accumulation over BK-sized key blocks,
+  everything fp32 on the accumulator side, matmuls on the MXU via
+  dot_general(preferred_element_type=f32). Causal + length masking via
+  broadcasted_iota — no materialized [T, T] mask.
+
+- `paged_decode`: one-token-per-slot decode attention directly against
+  the HBM page pool. Grid (slots,); the slot's page table row and length
+  are scalar-prefetched (PrefetchScalarGridSpec) so the kernel can DMA
+  exactly the valid pages HBM→VMEM, double-buffered to overlap the next
+  page's fetch with the current page's math. This is the "stream only
+  valid pages" design the jnp oracle's gather materializes densely
+  (PAPERS.md "Ragged Paged Attention" — pattern reference only).
+
+The reference has no analogue (all compute was Ollama's,
+client/src/services/OllamaService.ts); kernel selection lives in
+ops/attention.py.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+# ---------------------------------------------------------------------------
+# flash prefill
+# ---------------------------------------------------------------------------
+
+def _flash_prefill_kernel(
+    seqlen_ref,  # SMEM (1, 1): valid tokens
+    q_ref,       # VMEM (BQ, 1, G, D) — this q block, this kv head
+    k_ref,       # VMEM (1, T, D)     — all keys for this kv head
+    v_ref,       # VMEM (1, T, D)
+    o_ref,       # VMEM (BQ, 1, G, D)
+    *, bq: int, bk: int, t: int,
+):
+    qi = pl.program_id(1)
+    seq_len = seqlen_ref[0, 0]
+    g, d = q_ref.shape[2], q_ref.shape[3]
+    scale = jax.lax.rsqrt(jnp.float32(d))
+
+    q = q_ref[:, 0].reshape(bq * g, d).astype(jnp.float32) * scale
+    rows = jax.lax.broadcasted_iota(jnp.int32, (bq * g, bk), 0)
+    q_pos = qi * bq + rows // g                       # query position per row
+    cols = jax.lax.broadcasted_iota(jnp.int32, (bq * g, bk), 1)
+
+    # key blocks that can contribute to this q block: causal upper bound,
+    # tightened by the actual sequence length
+    nk = jnp.minimum(
+        pl.cdiv((qi + 1) * bq, bk), pl.cdiv(jnp.maximum(seq_len, 1), bk)
+    )
+
+    def body(kb, carry):
+        m, l, acc = carry
+        k_blk = k_ref[0, pl.ds(kb * bk, bk)].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(kb * bk, bk)].astype(jnp.float32)
+        logits = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [BQ*G, BK]
+        k_pos = kb * bk + cols
+        mask = (q_pos >= k_pos) & (k_pos < seq_len)
+        logits = jnp.where(mask, logits, -1e30)
+
+        m_new = jnp.maximum(m, logits.max(axis=1, keepdims=True))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(logits - m_new)
+        l_new = l * alpha + p.sum(axis=1, keepdims=True)
+        acc_new = acc * alpha + jax.lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return m_new, l_new, acc_new
+
+    m0 = jnp.full((bq * g, 1), -1e30, jnp.float32)
+    l0 = jnp.zeros((bq * g, 1), jnp.float32)
+    acc0 = jnp.zeros((bq * g, d), jnp.float32)
+    _, l, acc = jax.lax.fori_loop(0, nk, body, (m0, l0, acc0))
+
+    out = acc / jnp.maximum(l, 1e-30)
+    o_ref[:, 0] = out.reshape(bq, g, d).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def flash_prefill(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    seq_lens: jnp.ndarray,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Causal GQA flash attention. Same contract as
+    ops.attention.attention_prefill: q [B, T, H, D], k/v [B, T, KVH, D],
+    seq_lens [B] → [B, T, H, D]. T must divide by the q block size
+    (min(128, T)); the dispatch layer guarantees this for prefill buckets.
+    """
+    b, t, h, d = q.shape
+    kvh = k.shape[2]
+    g = h // kvh
+    bq = min(128, t)
+    bk = min(128, t)
+    assert t % bq == 0 and t % bk == 0, (t, bq, bk)
+
+    kernel = functools.partial(_flash_prefill_kernel, bq=bq, bk=bk, t=t)
+
+    def one(qb, kb, vb, ln):
+        return pl.pallas_call(
+            kernel,
+            grid=(kvh, t // bq),
+            in_specs=[
+                pl.BlockSpec((1, 1), lambda kh, i: (0, 0),
+                             memory_space=pltpu.SMEM),
+                pl.BlockSpec((bq, 1, g, d), lambda kh, i: (i, kh, 0, 0),
+                             memory_space=pltpu.VMEM),
+                # kv-head-major layout so the block's last two dims are
+                # (T, D) — the TPU lowering requires last-two divisibility
+                pl.BlockSpec((1, t, d), lambda kh, i: (kh, 0, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, t, d), lambda kh, i: (kh, 0, 0),
+                             memory_space=pltpu.VMEM),
+            ],
+            out_specs=pl.BlockSpec((bq, 1, g, d), lambda kh, i: (i, kh, 0, 0),
+                                   memory_space=pltpu.VMEM),
+            out_shape=jax.ShapeDtypeStruct((t, kvh, g, d), q.dtype),
+            interpret=interpret,
+            cost_estimate=pl.CostEstimate(
+                flops=4 * t * t * h * d // 2,
+                bytes_accessed=(t * h * d + 2 * t * kvh * d) * q.dtype.itemsize,
+                transcendentals=t * t * h,
+            ),
+        )(ln.reshape(1, 1), qb.reshape(t, kvh, g, d),
+          kb.transpose(1, 0, 2), vb.transpose(1, 0, 2))
+
+    out = jax.vmap(one)(q, k, v, seq_lens.astype(jnp.int32))
+    return out.reshape(b, t, h, d)
+
+
+# ---------------------------------------------------------------------------
+# paged decode
+# ---------------------------------------------------------------------------
+
+def _paged_decode_kernel(
+    table_ref,   # SMEM prefetch: [S, maxp] page ids
+    len_ref,     # SMEM prefetch: [S] lengths (incl. current token)
+    q_ref,       # VMEM (1, H, D) — this slot's query
+    k_hbm,       # ANY  [P, ps, KVH, D] — one layer's page pool, stays in HBM
+    v_hbm,
+    o_ref,       # VMEM (1, H, D)
+    k_scr,       # VMEM (2, ps, KVH, D) double buffer
+    v_scr,
+    sems,        # DMA sems (2, 2): [buffer, k/v]
+    *, ps: int, kvh: int, g: int, d: int,
+):
+    s = pl.program_id(0)
+    length = len_ref[s]
+    n_pages = pl.cdiv(jnp.maximum(length, 1), ps)
+    scale = jax.lax.rsqrt(jnp.float32(d))
+    q = (q_ref[0].reshape(kvh, g, d).astype(jnp.float32) * scale)
+
+    def k_dma(slot, page_no):
+        page = jnp.maximum(table_ref[s, page_no], 0)
+        return pltpu.make_async_copy(k_hbm.at[page], k_scr.at[slot], sems.at[slot, 0])
+
+    def v_dma(slot, page_no):
+        page = jnp.maximum(table_ref[s, page_no], 0)
+        return pltpu.make_async_copy(v_hbm.at[page], v_scr.at[slot], sems.at[slot, 1])
+
+    k_dma(0, 0).start()
+    v_dma(0, 0).start()
+
+    def body(p, carry):
+        m, l, acc = carry
+        slot = jax.lax.rem(p, 2)
+
+        @pl.when(p + 1 < n_pages)
+        def _():
+            nxt = jax.lax.rem(p + 1, 2)
+            k_dma(nxt, p + 1).start()
+            v_dma(nxt, p + 1).start()
+
+        k_dma(slot, p).wait()
+        v_dma(slot, p).wait()
+        k_page = k_scr[slot]  # [ps, KVH, D]
+        v_page = v_scr[slot]
+
+        # per-kv-head 2D dots, unrolled over the (static, small) KVH —
+        # Mosaic's tpu.matmul requires lhs/rhs batch dims in the same
+        # position, which the [KVH,G,D]x[ps,KVH,D] batched form violates
+        logits = jnp.stack([
+            jax.lax.dot_general(
+                q[h], k_page[:, h, :].astype(jnp.float32),
+                (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            for h in range(kvh)
+        ])  # [KVH, G, ps]
+        pos = p * ps + jax.lax.broadcasted_iota(jnp.int32, (kvh, g, ps), 2)
+        logits = jnp.where(pos < length, logits, -1e30)
+
+        m_new = jnp.maximum(m, logits.max(axis=2, keepdims=True))
+        alpha = jnp.exp(m - m_new)
+        prob = jnp.exp(logits - m_new)
+        l_new = l * alpha + prob.sum(axis=2, keepdims=True)
+        acc_new = acc * alpha + jnp.stack([
+            jax.lax.dot_general(
+                prob[h], v_page[:, h, :].astype(jnp.float32),
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            for h in range(kvh)
+        ])
+        return m_new, l_new, acc_new
+
+    m0 = jnp.full((kvh, g, 1), -1e30, jnp.float32)
+    l0 = jnp.zeros((kvh, g, 1), jnp.float32)
+    acc0 = jnp.zeros((kvh, g, d), jnp.float32)
+    _, l, acc = jax.lax.fori_loop(0, n_pages, body, (m0, l0, acc0))
+
+    out = acc / jnp.maximum(l, 1e-30)
+    o_ref[0] = out.reshape(kvh * g, d).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("page_size", "interpret"))
+def paged_decode(
+    q: jnp.ndarray,
+    k_pages: jnp.ndarray,
+    v_pages: jnp.ndarray,
+    page_table: jnp.ndarray,
+    lengths: jnp.ndarray,
+    page_size: int,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Same contract as ops.attention.paged_attention_decode: q [S, H, D],
+    pools [P, ps, KVH, D], page_table [S, maxp], lengths [S] (incl. the
+    already-written current token) → [S, H, D]. Reads only valid pages.
+
+    Slots with length 0 (inactive) compute garbage rows cheaply (page 0,
+    one iteration) — callers mask on `active`, matching the oracle.
+    """
+    s, h, d = q.shape
+    kvh = k_pages.shape[2]
+    g = h // kvh
+
+    kernel = functools.partial(
+        _paged_decode_kernel, ps=page_size, kvh=kvh, g=g, d=d
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(s,),
+        in_specs=[
+            pl.BlockSpec((1, h, d), lambda i, *_: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=pl.BlockSpec((1, h, d), lambda i, *_: (i, 0, 0),
+                               memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.VMEM((2, page_size, kvh, d), k_pages.dtype),
+            pltpu.VMEM((2, page_size, kvh, d), v_pages.dtype),
+            pltpu.SemaphoreType.DMA((2, 2)),
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((s, h, d), q.dtype),
+        interpret=interpret,
+    )(page_table.astype(jnp.int32), lengths.astype(jnp.int32),
+      q, k_pages, v_pages)

@@ -71,17 +71,31 @@ def test_convert_hf_state_dict_roundtrip(params_fp32):
     np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-5)
 
 
-def test_routing_is_sparse(params_fp32):
-    """Exactly experts_per_token experts get nonzero gate weight per token
-    (the dense-compute formulation must still be mathematically sparse)."""
+def test_moe_mlp_matches_per_token_brute_force(params_fp32):
+    """_moe_mlp (the production dense-weighted einsum) == an independent
+    per-token loop that runs only the top-k selected experts — catches
+    gating bugs (dropped renormalization, wrong combine) without torch."""
     x = jax.random.normal(jax.random.PRNGKey(2), (5, CFG.hidden_size), jnp.float32)
     lp = jax.tree.map(lambda a: a[0], params_fp32["layers"])
-    probs = jax.nn.softmax(x @ lp["router"], axis=-1)
-    top_w, top_i = jax.lax.top_k(probs, CFG.experts_per_token)
-    one_hot = jax.nn.one_hot(top_i, CFG.num_experts)
-    gates = jnp.einsum("tk,tkx->tx", top_w / top_w.sum(-1, keepdims=True), one_hot)
-    assert np.all((np.asarray(gates) > 0).sum(-1) == CFG.experts_per_token)
-    np.testing.assert_allclose(np.asarray(gates.sum(-1)), 1.0, rtol=1e-6)
+    got = np.asarray(mixtral._moe_mlp(CFG, lp, x))
+
+    def silu(a):
+        return a / (1.0 + np.exp(-a))
+
+    xs = np.asarray(x)
+    router = np.asarray(lp["router"])
+    want = np.zeros_like(xs)
+    for t in range(xs.shape[0]):
+        logits = xs[t] @ router
+        p = np.exp(logits - logits.max())
+        p /= p.sum()
+        top = np.argsort(-p)[: CFG.experts_per_token]
+        w = p[top] / p[top].sum()
+        for wi, xp in zip(w, top):
+            g = xs[t] @ np.asarray(lp["we_gate"][xp])
+            u = xs[t] @ np.asarray(lp["we_up"][xp])
+            want[t] += wi * (silu(g) * u) @ np.asarray(lp["we_down"][xp])
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
 
 
 def test_prefill_decode_match_forward(params_fp32):

@@ -1,0 +1,161 @@
+"""Pallas kernels vs the jnp oracles, in interpret mode on CPU
+(SURVEY.md §4: engine numerics get golden coverage; the kernels must be
+bit-for-bit-close to the reference implementations they replace)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from gridllm_tpu.ops import attention
+from gridllm_tpu.ops.attention import (
+    attention_prefill_ref,
+    paged_attention_decode_ref,
+)
+from gridllm_tpu.ops.kvcache import PageAllocator, PagedKVCache, write_prefill
+from gridllm_tpu.ops.pallas_kernels import flash_prefill, paged_decode
+
+
+@pytest.mark.parametrize("t,h,kvh,d,lens", [
+    (64, 4, 2, 16, [64]),          # full block, GQA
+    (128, 4, 4, 32, [100]),        # ragged length, MHA
+    (256, 8, 2, 64, [256, 17]),    # batch of 2, very ragged
+    (64, 2, 1, 128, [1]),          # single valid token
+])
+def test_flash_prefill_matches_ref(t, h, kvh, d, lens):
+    b = len(lens)
+    kq, kk, kv = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(kq, (b, t, h, d), jnp.float32)
+    k = jax.random.normal(kk, (b, t, kvh, d), jnp.float32)
+    v = jax.random.normal(kv, (b, t, kvh, d), jnp.float32)
+    seq_lens = jnp.asarray(lens, jnp.int32)
+
+    want = attention_prefill_ref(q, k, v, seq_lens)
+    got = flash_prefill(q, k, v, seq_lens, interpret=True)
+    # padding rows (pos >= len) are unspecified; compare valid region only
+    for i, ln in enumerate(lens):
+        np.testing.assert_allclose(
+            np.asarray(got[i, :ln]), np.asarray(want[i, :ln]),
+            rtol=2e-5, atol=2e-5,
+        )
+
+
+def test_flash_prefill_bf16():
+    t, h, kvh, d = 128, 4, 2, 64
+    kq, kk, kv = jax.random.split(jax.random.PRNGKey(1), 3)
+    q = jax.random.normal(kq, (1, t, h, d), jnp.float32).astype(jnp.bfloat16)
+    k = jax.random.normal(kk, (1, t, kvh, d), jnp.float32).astype(jnp.bfloat16)
+    v = jax.random.normal(kv, (1, t, kvh, d), jnp.float32).astype(jnp.bfloat16)
+    seq_lens = jnp.asarray([90], jnp.int32)
+    want = attention_prefill_ref(q, k, v, seq_lens)
+    got = flash_prefill(q, k, v, seq_lens, interpret=True)
+    assert got.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(got[0, :90], np.float32), np.asarray(want[0, :90], np.float32),
+        rtol=3e-2, atol=3e-2,
+    )
+
+
+def _fill_pool(key, lens, page_size=8, kvh=2, d=16, maxp=8, num_pages=32):
+    """Build a pool with len(lens) slots holding random K/V of given lengths."""
+    s = len(lens)
+    cache = PagedKVCache.create(1, num_pages, page_size, kvh, d, s, maxp,
+                                dtype=jnp.float32)
+    alloc = PageAllocator(num_pages, page_size, maxp)
+    k_pool, v_pool = cache.k[0], cache.v[0]
+    table = np.full((s, maxp), -1, np.int32)
+    for i, ln in enumerate(lens):
+        if ln == 0:
+            continue
+        alloc.alloc(i, ln)
+        row = np.asarray(alloc.table_row(i), np.int32)
+        table[i] = row
+        key, ka, kb = jax.random.split(key, 3)
+        # bucket-pad to a multiple of page_size for write_prefill
+        t_pad = -(-ln // page_size) * page_size
+        k_new = jax.random.normal(ka, (t_pad, kvh, d), jnp.float32)
+        v_new = jax.random.normal(kb, (t_pad, kvh, d), jnp.float32)
+        k_pool, v_pool = write_prefill(
+            k_pool, v_pool, k_new, v_new, jnp.asarray(row), jnp.int32(0),
+            jnp.int32(ln), page_size,
+        )
+    return k_pool, v_pool, jnp.asarray(table), page_size
+
+
+@pytest.mark.parametrize("lens,h", [
+    ([5], 4),              # single slot, partial page
+    ([8, 17, 1, 30], 4),   # ragged multi-slot
+    ([0, 12], 2),          # inactive slot present
+])
+def test_paged_decode_matches_ref(lens, h):
+    kvh, d = 2, 16
+    k_pool, v_pool, table, ps = _fill_pool(jax.random.PRNGKey(2), lens)
+    s = len(lens)
+    q = jax.random.normal(jax.random.PRNGKey(3), (s, h, d), jnp.float32)
+    lengths = jnp.asarray(lens, jnp.int32)
+
+    want = paged_attention_decode_ref(q, k_pool, v_pool, table, lengths, ps)
+    got = paged_decode(q, k_pool, v_pool, table, lengths, ps, interpret=True)
+    for i, ln in enumerate(lens):
+        if ln == 0:
+            continue  # inactive slots are unspecified in both impls
+        np.testing.assert_allclose(
+            np.asarray(got[i]), np.asarray(want[i]), rtol=2e-5, atol=2e-5,
+        )
+
+
+def test_dispatch_env(monkeypatch):
+    """GRIDLLM_PALLAS resolves the documented modes."""
+    attention._env_mode.cache_clear()
+    monkeypatch.setenv("GRIDLLM_PALLAS", "interpret")
+    assert attention._pallas_mode() == (True, True)
+    attention._env_mode.cache_clear()
+    monkeypatch.setenv("GRIDLLM_PALLAS", "0")
+    assert attention._pallas_mode() == (False, False)
+    attention._env_mode.cache_clear()
+    monkeypatch.setenv("GRIDLLM_PALLAS", "auto")
+    use, interp = attention._pallas_mode()
+    assert use == (jax.default_backend() == "tpu") and interp is False
+    attention._env_mode.cache_clear()
+
+
+def test_model_end_to_end_with_kernels(monkeypatch):
+    """tiny-llama greedy decode via the public dispatch (interpret kernels)
+    reproduces the pure-jnp path token-for-token."""
+    from gridllm_tpu.models import llama
+    from gridllm_tpu.models.configs import get_config
+
+    cfg = get_config("tiny-llama")
+    params = llama.init_params(cfg, jax.random.PRNGKey(4), dtype=jnp.float32)
+    prompt = [5, 17, 99, 3, 42]
+
+    def greedy(n=4):
+        cache = PagedKVCache.create(
+            cfg.num_layers, 16, 8, cfg.num_kv_heads, cfg.head_dim_, 2, 8,
+            dtype=jnp.float32,
+        )
+        alloc = PageAllocator(16, 8, 8)
+        alloc.alloc(0, 16)
+        row = jnp.asarray(alloc.table_row(0), jnp.int32)
+        padded = jnp.asarray(prompt + [0] * 3, jnp.int32)
+        logits, cache = llama.prefill(
+            params, cfg, padded, jnp.int32(len(prompt)), cache, jnp.int32(0), row
+        )
+        out = [int(jnp.argmax(logits))]
+        tok = jnp.zeros((2,), jnp.int32).at[0].set(out[0])
+        active = jnp.zeros((2,), bool).at[0].set(True)
+        for _ in range(n - 1):
+            logits, cache = llama.decode_step(params, cfg, tok, cache, active)
+            nxt = int(jnp.argmax(logits[0]))
+            out.append(nxt)
+            tok = tok.at[0].set(nxt)
+        return out
+
+    attention._env_mode.cache_clear()
+    monkeypatch.setenv("GRIDLLM_PALLAS", "0")
+    want = greedy()
+    attention._env_mode.cache_clear()
+    monkeypatch.setenv("GRIDLLM_PALLAS", "interpret")
+    got = greedy()
+    attention._env_mode.cache_clear()
+    assert got == want
