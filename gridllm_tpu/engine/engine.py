@@ -54,7 +54,11 @@ def _model_module(cfg: ModelConfig):
         from gridllm_tpu.models import mixtral
 
         return mixtral
-    return llama
+    if cfg.family == "bert_embed":
+        from gridllm_tpu.models import bert_embed
+
+        return bert_embed
+    return llama  # llama, qwen2, qwen3 share the decoder skeleton
 
 
 @dataclasses.dataclass
@@ -97,6 +101,7 @@ class GenerationResult:
     eval_duration_ns: int = 0
     load_duration_ns: int = 0
     total_duration_ns: int = 0
+    retryable: bool = True  # meaningful when done_reason == "error"
 
 
 class _Slot:
@@ -143,16 +148,16 @@ class InferenceEngine:
         self.config = config
         self.cfg = get_config(config.model)
         self.mod = _model_module(self.cfg)
+        self.embedding_only = self.cfg.family == "bert_embed"
         self.tokenizer: Tokenizer = get_tokenizer(
             config.tokenizer, self.cfg.vocab_size
         )
         self.mesh = build_mesh(config.mesh) if config.mesh else None
         if self.mesh is not None:
             # pallas_call has no GSPMD partitioning rule; under a mesh the
-            # jnp attention path shards correctly — see ops.attention
-            from gridllm_tpu.ops.attention import configure_pallas
-
-            configure_pallas(False)
+            # jnp attention path shards correctly. Per-engine (on the cfg
+            # copy) so co-hosted single-device engines keep their kernels.
+            self.cfg = dataclasses.replace(self.cfg, use_pallas=False)
         self._rng = random.Random(config.seed)
         self._lock = threading.Lock()
         self._pending: deque[GenerationRequest] = deque()
@@ -182,6 +187,15 @@ class InferenceEngine:
             self.params = self.mod.init_params(mc, jax.random.PRNGKey(0), dtype)
             if self.mesh is not None:
                 self.params = shard_params(self.params, self.mesh)
+        if self.embedding_only:
+            # no generation state: encoder families have no KV cache,
+            # sampler, or decode loop — just the pooled-forward embed path
+            self.load_duration_ns = time.perf_counter_ns() - t0
+            self.max_context = mc.max_seq_len
+            self._buckets = sorted(
+                {min(b, self.max_context) for b in c.prefill_buckets}
+            )
+            return
         cache = PagedKVCache.create(
             mc.num_layers, c.num_pages, c.page_size, mc.num_kv_heads,
             mc.head_dim_, c.max_slots, c.max_pages_per_slot, dtype=dtype,
@@ -202,6 +216,13 @@ class InferenceEngine:
 
     def _build_fns(self) -> None:
         mc = self.cfg
+        if self.embedding_only:
+            self._embed_fn = jax.jit(
+                lambda params, tokens, lens: self.mod.hidden_states(
+                    params, mc, tokens, seq_lens=lens
+                )
+            )
+            return
 
         @partial(jax.jit, donate_argnums=(2, 3))
         def prefill_fn(params, tokens, cache, counts, length, slot, table_row, sp):
@@ -235,6 +256,10 @@ class InferenceEngine:
     # ------------------------------------------------------------ admission
 
     def submit(self, req: GenerationRequest) -> None:
+        if self.embedding_only:
+            self._fail(req, f"{self.cfg.name} is an embedding model; "
+                            "it does not support generation", retryable=False)
+            return
         with self._lock:
             if len(self._pending) >= self.config.max_queue:
                 raise RuntimeError("engine queue full")
@@ -251,9 +276,10 @@ class InferenceEngine:
                 return b
         return self._buckets[-1]
 
-    def _fail(self, req: GenerationRequest, msg: str) -> None:
+    def _fail(self, req: GenerationRequest, msg: str, retryable: bool = True) -> None:
         log.warning("request rejected", id=req.id, reason=msg)
-        res = GenerationResult(id=req.id, done_reason="error", text=msg)
+        res = GenerationResult(id=req.id, done_reason="error", text=msg,
+                               retryable=retryable)
         if req.on_chunk:
             req.on_chunk("", True, res)
 
@@ -440,19 +466,44 @@ class InferenceEngine:
         return box[0]
 
     def embed(self, texts: list[str]) -> list[list[float]]:
-        """Mean-pooled, L2-normalized final hidden states (the llama-family
-        embedding path; dedicated embed model families plug in via configs)."""
+        """Pooled, L2-normalized embeddings. bert_embed models run the
+        bidirectional encoder with their configured pooling (mean/cls);
+        decoder families mean-pool final hidden states (padding masked at
+        both attention and pooling via seq_lens)."""
+        from gridllm_tpu.models.bert_embed import pool
+
         out = []
         for text in texts:
-            ids = self.tokenizer.encode(text)[: self.max_context]
+            ids = self.tokenizer.encode_for_embedding(text)[: self.max_context]
             b = self._bucket_for(len(ids))
             padded = jnp.asarray([ids + [0] * (b - len(ids))], jnp.int32)
-            h = self.mod.hidden_states(self.params, self.cfg, padded)[0]
-            mask = (jnp.arange(b) < len(ids))[:, None]
-            pooled = (h * mask).sum(0) / jnp.maximum(mask.sum(), 1)
-            vec = pooled / jnp.maximum(jnp.linalg.norm(pooled), 1e-9)
+            lens = jnp.asarray([len(ids)], jnp.int32)
+            if self.embedding_only:
+                h = self._embed_fn(self.params, padded, lens)
+            else:
+                h = self.mod.hidden_states(
+                    self.params, self.cfg, padded, seq_lens=lens
+                )
+            vec = pool(h, lens, self.cfg.pooling)[0]
             out.append(np.asarray(vec, np.float32).tolist())
         return out
+
+    def abort_all(self, msg: str) -> int:
+        """Fail every pending and active request (driver recovery path:
+        the worker pump calls this when step() raises, so waiters get an
+        immediate error instead of hanging to the job timeout)."""
+        n = 0
+        with self._lock:
+            pending, self._pending = list(self._pending), deque()
+        for r in pending:
+            self._fail(r, msg)
+            n += 1
+        for slot, st in list(self._slots.items()):
+            st.text = msg
+            st.emitted_len = len(msg)
+            self._finish(slot, st, "error")
+            n += 1
+        return n
 
     def cancel(self, req_id: str) -> bool:
         """Cancel a pending or running request (reference analogue: job

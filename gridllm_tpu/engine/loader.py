@@ -57,7 +57,7 @@ def _name_map(cfg: ModelConfig) -> dict[str, tuple[str, bool]]:
         return mixtral.HF_MAP
     from gridllm_tpu.models import llama
 
-    return llama.HF_MAP
+    return llama.hf_map(cfg)
 
 
 def load_checkpoint(
@@ -86,9 +86,14 @@ def load_checkpoint(
         log.debug("loaded leaf", leaf="/".join(pathkeys), shape=list(out.shape))
         return out
 
-    return hf_layout.to_pytree(
-        cfg, lambda name: idx[name](), _name_map(cfg), dtype, place
-    )
+    def get(name: str) -> np.ndarray:
+        return idx[name]()
+
+    if cfg.family == "bert_embed":
+        from gridllm_tpu.models import bert_embed
+
+        return bert_embed.from_getter(cfg, get, dtype, place)
+    return hf_layout.to_pytree(cfg, get, _name_map(cfg), dtype, place)
 
 
 def save_checkpoint(params: Any, cfg: ModelConfig, path: str) -> None:
@@ -99,7 +104,12 @@ def save_checkpoint(params: Any, cfg: ModelConfig, path: str) -> None:
     from gridllm_tpu.models import hf_layout
 
     os.makedirs(path, exist_ok=True)
-    out = hf_layout.to_hf_tensors(params, cfg, _name_map(cfg))
+    if cfg.family == "bert_embed":
+        from gridllm_tpu.models import bert_embed
+
+        out = bert_embed.to_hf_tensors(params, cfg)
+    else:
+        out = hf_layout.to_hf_tensors(params, cfg, _name_map(cfg))
     save_file(out, os.path.join(path, "model.safetensors"))
     with open(os.path.join(path, "config.json"), "w") as f:
         json.dump({"model_name": cfg.name}, f)

@@ -26,6 +26,7 @@ class Tokenizer(Protocol):
     vocab_size: int
 
     def encode(self, text: str, add_bos: bool = True) -> list[int]: ...
+    def encode_for_embedding(self, text: str) -> list[int]: ...
     def decode(self, ids: Sequence[int]) -> str: ...
 
 
@@ -62,6 +63,9 @@ class ByteTokenizer:
         ids = list(text.encode("utf-8"))
         return ([self.bos_id] + ids) if add_bos and self.bos_id is not None else ids
 
+    def encode_for_embedding(self, text: str) -> list[int]:
+        return self.encode(text, add_bos=True)
+
     def decode(self, ids: Sequence[int]) -> str:
         data = bytes(i for i in ids if 0 <= i < 256)
         return data.decode("utf-8", errors="replace")
@@ -89,6 +93,12 @@ class HFTokenizer:
         if add_bos and self.bos_id is not None:
             ids = [self.bos_id] + ids
         return ids
+
+    def encode_for_embedding(self, text: str) -> list[int]:
+        """Full special-token template — BERT-family tokenizers wrap with
+        [CLS]...[SEP], which cls-pooling (models/bert_embed.pool) relies on
+        reading at position 0."""
+        return self._tok.encode(text, add_special_tokens=True)
 
     def decode(self, ids: Sequence[int]) -> str:
         return self._tok.decode(ids, skip_special_tokens=True)

@@ -1,0 +1,209 @@
+"""BERT-family encoder for the embeddings path (BASELINE.md config #5).
+
+Covers the standard Ollama embedding models that are vanilla BERT
+architecture (all-minilm, mxbai-embed-large). The reference served these
+by proxying `/api/embed` to Ollama (client/src/services/OllamaService.ts:601);
+here they are a first-class model family with an HF `BertModel` golden
+twin (tests/test_bert_embed.py).
+
+TPU-first notes: same stacked-[L]-axis + lax.scan scheme as the decoder
+families; attention is bidirectional with padding-key masking (seq_lens),
+one fused pass per batch — no KV cache, no incremental state. Embedding
+models are small; sharding is replicated by default (dp-scale via more
+workers, the reference's own model).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from gridllm_tpu.models.configs import ModelConfig
+from gridllm_tpu.models.llama import _precision
+from gridllm_tpu.ops.layers import layer_norm
+
+Params = dict[str, Any]
+
+_NEG_INF = -1e30
+
+
+def init_params(cfg: ModelConfig, key: jax.Array, dtype=jnp.bfloat16) -> Params:
+    e, f, v, L = cfg.hidden_size, cfg.intermediate_size, cfg.vocab_size, cfg.num_layers
+    ks = iter(jax.random.split(key, 12))
+
+    def w(k, *shape, scale=0.02):
+        return (jax.random.normal(k, shape, jnp.float32) * scale).astype(dtype)
+
+    return {
+        "word_embed": w(next(ks), v, e),
+        "pos_embed": w(next(ks), cfg.max_seq_len, e),
+        "type_embed": w(next(ks), 2, e),
+        "embed_ln_w": jnp.ones((e,), dtype),
+        "embed_ln_b": jnp.zeros((e,), dtype),
+        "layers": {
+            "wq": w(next(ks), L, e, e), "bq": jnp.zeros((L, e), dtype),
+            "wk": w(next(ks), L, e, e), "bk": jnp.zeros((L, e), dtype),
+            "wv": w(next(ks), L, e, e), "bv": jnp.zeros((L, e), dtype),
+            "wo": w(next(ks), L, e, e), "bo": jnp.zeros((L, e), dtype),
+            "attn_ln_w": jnp.ones((L, e), dtype),
+            "attn_ln_b": jnp.zeros((L, e), dtype),
+            "w_in": w(next(ks), L, e, f), "b_in": jnp.zeros((L, f), dtype),
+            "w_out": w(next(ks), L, f, e), "b_out": jnp.zeros((L, e), dtype),
+            "mlp_ln_w": jnp.ones((L, e), dtype),
+            "mlp_ln_b": jnp.zeros((L, e), dtype),
+        },
+    }
+
+
+def hidden_states(
+    params: Params,
+    cfg: ModelConfig,
+    tokens: jnp.ndarray,
+    seq_lens: jnp.ndarray | None = None,
+) -> jnp.ndarray:
+    """tokens [B, T] → final hidden states [B, T, E]. Bidirectional
+    attention; key positions >= seq_lens are masked (padding must not leak
+    into valid tokens' attention, unlike the causal families)."""
+    b, t = tokens.shape
+    h = cfg.num_heads
+    d = cfg.hidden_size // h
+    eps = cfg.rms_eps
+    if seq_lens is None:
+        seq_lens = jnp.full((b,), t, jnp.int32)
+
+    x = (
+        params["word_embed"][tokens]
+        + params["pos_embed"][jnp.arange(t)][None]
+        + params["type_embed"][0][None, None]
+    )
+    x = layer_norm(x, params["embed_ln_w"], params["embed_ln_b"], eps)
+    key_valid = jnp.arange(t)[None] < seq_lens[:, None]  # [B, T]
+    scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32))
+
+    def layer(x, lp):
+        p = _precision(x)
+
+        def proj(wn, bn):
+            return (jnp.dot(x, lp[wn], precision=p) + lp[bn]).reshape(b, t, h, d)
+
+        q, k, v = proj("wq", "bq"), proj("wk", "bk"), proj("wv", "bv")
+        logits = jnp.einsum(
+            "bthd,bshd->bhts", q.astype(jnp.float32), k.astype(jnp.float32),
+            precision=jax.lax.Precision.HIGHEST,
+        ) * scale
+        logits = jnp.where(key_valid[:, None, None, :], logits, _NEG_INF)
+        probs = jax.nn.softmax(logits, axis=-1)
+        attn = jnp.einsum(
+            "bhts,bshd->bthd", probs, v.astype(jnp.float32),
+            precision=jax.lax.Precision.HIGHEST,
+        ).astype(x.dtype).reshape(b, t, -1)
+        attn = jnp.dot(attn, lp["wo"], precision=p) + lp["bo"]
+        x = layer_norm(x + attn, lp["attn_ln_w"], lp["attn_ln_b"], eps)
+        ff = jax.nn.gelu(jnp.dot(x, lp["w_in"], precision=p) + lp["b_in"],
+                         approximate=False)
+        ff = jnp.dot(ff, lp["w_out"], precision=p) + lp["b_out"]
+        return layer_norm(x + ff, lp["mlp_ln_w"], lp["mlp_ln_b"], eps), None
+
+    x, _ = jax.lax.scan(layer, x, params["layers"])
+    return x
+
+
+def pool(
+    hidden: jnp.ndarray, seq_lens: jnp.ndarray, mode: str
+) -> jnp.ndarray:
+    """[B, T, E] → [B, E], L2-normalized. mode: "mean" (all-minilm /
+    sentence-transformers default) or "cls" (mxbai)."""
+    if mode == "cls":
+        pooled = hidden[:, 0]
+    else:
+        t = hidden.shape[1]
+        mask = (jnp.arange(t)[None] < seq_lens[:, None])[..., None]
+        pooled = (hidden * mask).sum(1) / jnp.maximum(mask.sum(1), 1)
+    return pooled / jnp.maximum(
+        jnp.linalg.norm(pooled, axis=-1, keepdims=True), 1e-9
+    )
+
+
+# ---------------------------------------------------------------------------
+# HF weight conversion (layout contract with transformers BertModel)
+# ---------------------------------------------------------------------------
+
+# our layer leaf → (BertModel tensor template, transpose?)
+HF_MAP: dict[str, tuple[str, bool]] = {
+    "wq": ("encoder.layer.{}.attention.self.query.weight", True),
+    "bq": ("encoder.layer.{}.attention.self.query.bias", False),
+    "wk": ("encoder.layer.{}.attention.self.key.weight", True),
+    "bk": ("encoder.layer.{}.attention.self.key.bias", False),
+    "wv": ("encoder.layer.{}.attention.self.value.weight", True),
+    "bv": ("encoder.layer.{}.attention.self.value.bias", False),
+    "wo": ("encoder.layer.{}.attention.output.dense.weight", True),
+    "bo": ("encoder.layer.{}.attention.output.dense.bias", False),
+    "attn_ln_w": ("encoder.layer.{}.attention.output.LayerNorm.weight", False),
+    "attn_ln_b": ("encoder.layer.{}.attention.output.LayerNorm.bias", False),
+    "w_in": ("encoder.layer.{}.intermediate.dense.weight", True),
+    "b_in": ("encoder.layer.{}.intermediate.dense.bias", False),
+    "w_out": ("encoder.layer.{}.output.dense.weight", True),
+    "b_out": ("encoder.layer.{}.output.dense.bias", False),
+    "mlp_ln_w": ("encoder.layer.{}.output.LayerNorm.weight", False),
+    "mlp_ln_b": ("encoder.layer.{}.output.LayerNorm.bias", False),
+}
+_TOP_MAP: dict[str, str] = {
+    "word_embed": "embeddings.word_embeddings.weight",
+    "pos_embed": "embeddings.position_embeddings.weight",
+    "type_embed": "embeddings.token_type_embeddings.weight",
+    "embed_ln_w": "embeddings.LayerNorm.weight",
+    "embed_ln_b": "embeddings.LayerNorm.bias",
+}
+
+
+def from_getter(
+    cfg: ModelConfig,
+    get: Callable[[str], np.ndarray],
+    dtype=jnp.bfloat16,
+    place=None,
+) -> Params:
+    """Assemble params from an HF-name tensor getter (state dict or
+    safetensors). BertModel checkpoints may prefix names with "bert." —
+    both spellings accepted; the pooler head is ignored. Stacking
+    mechanics come from hf_layout (the one owner of that logic)."""
+    from gridllm_tpu.models import hf_layout
+
+    if place is None:
+        place = hf_layout.default_place(dtype)
+
+    def get_any(name):
+        try:
+            return np.asarray(get(name))
+        except KeyError:
+            return np.asarray(get("bert." + name))
+
+    params: Params = {
+        k: place((k,), get_any(v)) for k, v in _TOP_MAP.items()
+    }
+    params["layers"] = hf_layout.stack_layer_leaves(cfg, get_any, HF_MAP, place)
+    return params
+
+
+def convert_hf_state_dict(cfg: ModelConfig, sd: dict[str, Any], dtype=jnp.bfloat16) -> Params:
+    """HF `BertModel.state_dict()` → our pytree."""
+    def get(name):
+        t = sd[name]  # KeyError propagates to from_getter's fallback
+        if hasattr(t, "detach"):
+            t = t.detach().to("cpu").float().numpy()
+        return np.asarray(t)
+
+    return from_getter(cfg, get, dtype)
+
+
+def to_hf_tensors(params: Params, cfg: ModelConfig) -> dict[str, np.ndarray]:
+    """Inverse of from_getter (checkpoint save + round-trip tests)."""
+    from gridllm_tpu.models import hf_layout
+
+    out: dict[str, np.ndarray] = {
+        v: np.asarray(params[k], np.float32) for k, v in _TOP_MAP.items()
+    }
+    out.update(hf_layout.flatten_layer_leaves(params["layers"], cfg, HF_MAP))
+    return out

@@ -37,6 +37,13 @@ class ModelConfig:
     # attention variants
     attn_logit_softcap: float = 0.0
     sliding_window: int = 0          # 0 → full attention
+    attn_bias: bool = False          # qwen2: bias on q/k/v projections
+    qk_norm: bool = False            # qwen3: per-head RMSNorm on q/k pre-rope
+    # embeddings (bert_embed family)
+    pooling: str = "mean"            # "mean" | "cls"
+    # kernel dispatch: None = env/auto policy (ops.attention); the engine
+    # sets False on its config copy when serving under a device mesh
+    use_pallas: bool | None = None
 
     @property
     def head_dim_(self) -> int:
@@ -66,6 +73,27 @@ class ModelConfig:
                 sliding_window=self.sliding_window or None,
                 **common,
             )
+        if self.family == "bert_embed":
+            from transformers import BertConfig
+
+            return BertConfig(
+                vocab_size=self.vocab_size,
+                hidden_size=self.hidden_size,
+                num_hidden_layers=self.num_layers,
+                num_attention_heads=self.num_heads,
+                intermediate_size=self.intermediate_size,
+                max_position_embeddings=self.max_seq_len,
+                layer_norm_eps=self.rms_eps,
+            )
+        if self.family == "qwen2":
+            from transformers import Qwen2Config
+
+            common.pop("attention_bias")  # qwen2 hardcodes qkv bias
+            return Qwen2Config(**common)
+        if self.family == "qwen3":
+            from transformers import Qwen3Config
+
+            return Qwen3Config(head_dim=self.head_dim_, **common)
         from transformers import LlamaConfig
 
         if self.rope_scaling is not None:
@@ -122,10 +150,45 @@ register(ModelConfig(
     rope_theta=500_000.0, max_seq_len=8192,
 ))
 register(ModelConfig(
+    name="qwen2.5:0.5b", family="qwen2", vocab_size=151_936, hidden_size=896,
+    intermediate_size=4864, num_layers=24, num_heads=14, num_kv_heads=2,
+    head_dim=64, rope_theta=1_000_000.0, rms_eps=1e-6, tie_embeddings=True,
+    max_seq_len=32_768, attn_bias=True,
+))
+register(ModelConfig(
+    name="qwen2.5:7b", family="qwen2", vocab_size=152_064, hidden_size=3584,
+    intermediate_size=18_944, num_layers=28, num_heads=28, num_kv_heads=4,
+    head_dim=128, rope_theta=1_000_000.0, rms_eps=1e-6,
+    max_seq_len=32_768, attn_bias=True,
+))
+register(ModelConfig(
+    name="qwen3:0.6b", family="qwen3", vocab_size=151_936, hidden_size=1024,
+    intermediate_size=3072, num_layers=28, num_heads=16, num_kv_heads=8,
+    head_dim=128, rope_theta=1_000_000.0, rms_eps=1e-6, tie_embeddings=True,
+    max_seq_len=40_960, qk_norm=True,
+))
+register(ModelConfig(
+    name="qwen3:8b", family="qwen3", vocab_size=151_936, hidden_size=4096,
+    intermediate_size=12_288, num_layers=36, num_heads=32, num_kv_heads=8,
+    head_dim=128, rope_theta=1_000_000.0, rms_eps=1e-6,
+    max_seq_len=40_960, qk_norm=True,
+))
+register(ModelConfig(
     name="mixtral:8x7b", family="mixtral", vocab_size=32_000,
     hidden_size=4096, intermediate_size=14_336, num_layers=32,
     num_heads=32, num_kv_heads=8, rope_theta=1_000_000.0,
     num_experts=8, experts_per_token=2, max_seq_len=32_768, rms_eps=1e-5,
+))
+
+register(ModelConfig(
+    name="all-minilm", family="bert_embed", vocab_size=30_522,
+    hidden_size=384, intermediate_size=1536, num_layers=6, num_heads=12,
+    num_kv_heads=12, rms_eps=1e-12, max_seq_len=512, pooling="mean",
+))
+register(ModelConfig(
+    name="mxbai-embed-large", family="bert_embed", vocab_size=30_522,
+    hidden_size=1024, intermediate_size=4096, num_layers=24, num_heads=16,
+    num_kv_heads=16, rms_eps=1e-12, max_seq_len=512, pooling="cls",
 ))
 
 # Tiny configs: architecture-faithful, test/bench-sized.
@@ -139,6 +202,23 @@ register(ModelConfig(
     intermediate_size=128, num_layers=2, num_heads=4, num_kv_heads=2,
     head_dim=16, rope_theta=10_000.0, max_seq_len=256,
     num_experts=4, experts_per_token=2,
+))
+register(ModelConfig(
+    name="tiny-qwen2", family="qwen2", vocab_size=256, hidden_size=64,
+    intermediate_size=128, num_layers=2, num_heads=4, num_kv_heads=2,
+    head_dim=16, rope_theta=10_000.0, rms_eps=1e-6, max_seq_len=256,
+    attn_bias=True,
+))
+register(ModelConfig(
+    name="tiny-qwen3", family="qwen3", vocab_size=256, hidden_size=64,
+    intermediate_size=128, num_layers=2, num_heads=4, num_kv_heads=2,
+    head_dim=16, rope_theta=10_000.0, rms_eps=1e-6, max_seq_len=256,
+    qk_norm=True,
+))
+register(ModelConfig(
+    name="tiny-bert", family="bert_embed", vocab_size=256, hidden_size=64,
+    intermediate_size=128, num_layers=2, num_heads=4, num_kv_heads=4,
+    rms_eps=1e-12, max_seq_len=128,
 ))
 
 

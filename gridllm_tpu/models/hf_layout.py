@@ -28,17 +28,19 @@ def is_expert_leaf(tmpl: str) -> bool:
     return tmpl.count("{}") == 2
 
 
-def to_pytree(
+def default_place(dtype) -> Place:
+    return lambda path, arr: jnp.asarray(arr, dtype)
+
+
+def stack_layer_leaves(
     cfg: ModelConfig,
     get: Get,
     name_map: dict[str, tuple[str, bool]],
-    dtype=jnp.bfloat16,
-    place: Place | None = None,
+    place: Place,
 ) -> dict[str, Any]:
-    """Assemble the params pytree: stack per-layer (and per-expert) HF
-    tensors onto leading axes, transposing matmul weights to [in, out]."""
-    if place is None:
-        place = lambda path, arr: jnp.asarray(arr, dtype)  # noqa: E731
+    """The shared stacking mechanics: per-layer (and per-expert) HF
+    tensors onto leading [L] (and [X]) axes, transposing matmul weights
+    to [in, out]. Used by every family's assembly path."""
     L = cfg.num_layers
 
     def stacked(tmpl: str, transpose: bool) -> np.ndarray:
@@ -52,12 +54,46 @@ def to_pytree(
                 return w.T if transpose else w
         return np.stack([np.asarray(one(i)) for i in range(L)])
 
+    return {
+        n: place(("layers", n), stacked(t, tr)) for n, (t, tr) in name_map.items()
+    }
+
+
+def flatten_layer_leaves(
+    layers: dict[str, Any],
+    cfg: ModelConfig,
+    name_map: dict[str, tuple[str, bool]],
+) -> dict[str, np.ndarray]:
+    """Inverse of stack_layer_leaves → HF-named fp32 tensors."""
+    out: dict[str, np.ndarray] = {}
+    for name, (tmpl, transpose) in name_map.items():
+        stacked = np.asarray(layers[name], np.float32)
+        for i in range(cfg.num_layers):
+            if is_expert_leaf(tmpl):
+                for x in range(cfg.num_experts):
+                    w = stacked[i, x]
+                    out[tmpl.format(i, x)] = w.T.copy() if transpose else w.copy()
+            else:
+                w = stacked[i]
+                out[tmpl.format(i)] = w.T.copy() if transpose else w.copy()
+    return out
+
+
+def to_pytree(
+    cfg: ModelConfig,
+    get: Get,
+    name_map: dict[str, tuple[str, bool]],
+    dtype=jnp.bfloat16,
+    place: Place | None = None,
+) -> dict[str, Any]:
+    """Assemble the DECODER-family params pytree (embed/layers/final_norm
+    [+ lm_head]); bert_embed composes its own top level over
+    stack_layer_leaves."""
+    if place is None:
+        place = default_place(dtype)
     params: dict[str, Any] = {
         "embed": place(("embed",), np.asarray(get("model.embed_tokens.weight"))),
-        "layers": {
-            n: place(("layers", n), stacked(t, tr))
-            for n, (t, tr) in name_map.items()
-        },
+        "layers": stack_layer_leaves(cfg, get, name_map, place),
         "final_norm": place(("final_norm",), np.asarray(get("model.norm.weight"))),
     }
     if not cfg.tie_embeddings:
@@ -78,16 +114,7 @@ def to_hf_tensors(
         "model.embed_tokens.weight": np.asarray(params["embed"], np.float32),
         "model.norm.weight": np.asarray(params["final_norm"], np.float32),
     }
-    for name, (tmpl, transpose) in name_map.items():
-        stacked = np.asarray(params["layers"][name], np.float32)
-        for i in range(cfg.num_layers):
-            if is_expert_leaf(tmpl):
-                for x in range(cfg.num_experts):
-                    w = stacked[i, x]
-                    out[tmpl.format(i, x)] = w.T.copy() if transpose else w.copy()
-            else:
-                w = stacked[i]
-                out[tmpl.format(i)] = w.T.copy() if transpose else w.copy()
+    out.update(flatten_layer_leaves(params["layers"], cfg, name_map))
     if not cfg.tie_embeddings:
         out["lm_head.weight"] = np.asarray(params["lm_head"], np.float32).T.copy()
     return out

@@ -83,6 +83,13 @@ def init_params(
         params["layers"]["w_gate"] = w(next(ks), L, e, f)
         params["layers"]["w_up"] = w(next(ks), L, e, f)
         params["layers"]["w_down"] = w(next(ks), L, f, e)
+    if cfg.attn_bias:
+        params["layers"]["bq"] = w(next(ks), L, h * d, scale=0.02)
+        params["layers"]["bk"] = w(next(ks), L, kvh * d, scale=0.02)
+        params["layers"]["bv"] = w(next(ks), L, kvh * d, scale=0.02)
+    if cfg.qk_norm:
+        params["layers"]["q_norm"] = jnp.ones((L, d), dtype)
+        params["layers"]["k_norm"] = jnp.ones((L, d), dtype)
     if not cfg.tie_embeddings:
         params["lm_head"] = w(next(ks), e, v, scale=0.02)
     return params
@@ -96,12 +103,27 @@ def _mlp(lp: Params, x: jnp.ndarray) -> jnp.ndarray:
 
 
 def _qkv(cfg: ModelConfig, lp: Params, x: jnp.ndarray):
-    """x: [..., T, E] → q [..., T, H, D], k/v [..., T, KVH, D]."""
+    """x: [..., T, E] → q [..., T, H, D], k/v [..., T, KVH, D].
+
+    Family knobs: qwen2 adds bias on the q/k/v projections (never on wo);
+    qwen3 RMS-normalizes q/k per head over head_dim before rope (HF
+    Qwen3Attention order: project → view heads → q_norm/k_norm → rope).
+    """
     p = _precision(x)
     d = cfg.head_dim_
-    q = jnp.dot(x, lp["wq"], precision=p).reshape(*x.shape[:-1], cfg.num_heads, d)
-    k = jnp.dot(x, lp["wk"], precision=p).reshape(*x.shape[:-1], cfg.num_kv_heads, d)
-    v = jnp.dot(x, lp["wv"], precision=p).reshape(*x.shape[:-1], cfg.num_kv_heads, d)
+    q = jnp.dot(x, lp["wq"], precision=p)
+    k = jnp.dot(x, lp["wk"], precision=p)
+    v = jnp.dot(x, lp["wv"], precision=p)
+    if cfg.attn_bias:
+        q = q + lp["bq"]
+        k = k + lp["bk"]
+        v = v + lp["bv"]
+    q = q.reshape(*x.shape[:-1], cfg.num_heads, d)
+    k = k.reshape(*x.shape[:-1], cfg.num_kv_heads, d)
+    v = v.reshape(*x.shape[:-1], cfg.num_kv_heads, d)
+    if cfg.qk_norm:
+        q = rms_norm(q, lp["q_norm"], cfg.rms_eps)
+        k = rms_norm(k, lp["k_norm"], cfg.rms_eps)
     return q, k, v
 
 
@@ -113,22 +135,30 @@ def _unembed(cfg: ModelConfig, params: Params, x: jnp.ndarray) -> jnp.ndarray:
 
 
 def hidden_states(
-    params: Params, cfg: ModelConfig, tokens: jnp.ndarray, mlp: MlpFn = _mlp
+    params: Params,
+    cfg: ModelConfig,
+    tokens: jnp.ndarray,
+    mlp: MlpFn = _mlp,
+    seq_lens: jnp.ndarray | None = None,
 ) -> jnp.ndarray:
-    """Final-norm hidden states [B, T, E] (embeddings path; no unembed)."""
+    """Final-norm hidden states [B, T, E] (embeddings path; no unembed).
+    seq_lens masks padding keys out of attention (None → all valid)."""
     _check_supported(cfg)
     b, t = tokens.shape
     inv_freq = precompute_rope(cfg.head_dim_, cfg.rope_theta, cfg.rope_scaling)
     x = params["embed"][tokens]
     pos = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32), (b, t))
-    seq_lens = jnp.full((b,), t, jnp.int32)
+    if seq_lens is None:
+        seq_lens = jnp.full((b,), t, jnp.int32)
 
     def layer(x, lp):
         hx = rms_norm(x, lp["attn_norm"], cfg.rms_eps)
         q, k, v = _qkv(cfg, lp, hx)
         q = apply_rope(q, pos, inv_freq)
         k = apply_rope(k, pos, inv_freq)
-        attn = attention_prefill(q, k, v, seq_lens).reshape(b, t, -1)
+        attn = attention_prefill(
+            q, k, v, seq_lens, use_pallas=cfg.use_pallas
+        ).reshape(b, t, -1)
         x = x + jnp.dot(attn, lp["wo"], precision=_precision(x))
         hx = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
         return x + mlp(lp, hx), None
@@ -179,7 +209,9 @@ def prefill(
             k_pages, v_pages, k[0], v[0], table_row,
             jnp.int32(0), length, cache.page_size,
         )
-        attn = attention_prefill(q, k, v, seq_lens).reshape(1, t, -1)
+        attn = attention_prefill(
+            q, k, v, seq_lens, use_pallas=cfg.use_pallas
+        ).reshape(1, t, -1)
         x = x + jnp.dot(attn, lp["wo"], precision=_precision(x))
         hx = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
         return x + mlp(lp, hx), (k_pages, v_pages)
@@ -229,7 +261,8 @@ def decode_step(
             cache.page_size,
         )
         attn = paged_attention_decode(
-            q, k_pages, v_pages, cache.page_table, new_lengths, cache.page_size
+            q, k_pages, v_pages, cache.page_table, new_lengths,
+            cache.page_size, use_pallas=cfg.use_pallas,
         ).reshape(s, -1)
         x = x + jnp.dot(attn, lp["wo"], precision=_precision(x))
         hx = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
@@ -268,6 +301,20 @@ HF_MAP: dict[str, tuple[str, bool]] = {
 }
 
 
+def hf_map(cfg: ModelConfig) -> dict[str, tuple[str, bool]]:
+    """HF_MAP extended with the config's family knobs (qwen2 qkv bias,
+    qwen3 qk norms) — the full layout contract for llama-skeleton models."""
+    m = dict(HF_MAP)
+    if cfg.attn_bias:
+        m["bq"] = ("model.layers.{}.self_attn.q_proj.bias", False)
+        m["bk"] = ("model.layers.{}.self_attn.k_proj.bias", False)
+        m["bv"] = ("model.layers.{}.self_attn.v_proj.bias", False)
+    if cfg.qk_norm:
+        m["q_norm"] = ("model.layers.{}.self_attn.q_norm.weight", False)
+        m["k_norm"] = ("model.layers.{}.self_attn.k_norm.weight", False)
+    return m
+
+
 def convert_state_dict(
     cfg: ModelConfig,
     sd: dict[str, Any],
@@ -290,5 +337,6 @@ def convert_state_dict(
 
 
 def convert_hf_state_dict(cfg: ModelConfig, sd: dict[str, Any], dtype=jnp.bfloat16) -> Params:
-    """HF `LlamaForCausalLM.state_dict()`-style mapping → our pytree."""
-    return convert_state_dict(cfg, sd, HF_MAP, dtype)
+    """HF `LlamaForCausalLM.state_dict()`-style mapping → our pytree
+    (also Qwen2/Qwen3ForCausalLM — same skeleton, knobs via hf_map)."""
+    return convert_state_dict(cfg, sd, hf_map(cfg), dtype)

@@ -80,8 +80,15 @@ def _mlp_for(cfg: ModelConfig):
     return partial(_moe_mlp, cfg)
 
 
-def hidden_states(params: Params, cfg: ModelConfig, tokens: jnp.ndarray) -> jnp.ndarray:
-    return llama.hidden_states(params, cfg, tokens, mlp=_mlp_for(cfg))
+def hidden_states(
+    params: Params,
+    cfg: ModelConfig,
+    tokens: jnp.ndarray,
+    seq_lens: jnp.ndarray | None = None,
+) -> jnp.ndarray:
+    return llama.hidden_states(
+        params, cfg, tokens, mlp=_mlp_for(cfg), seq_lens=seq_lens
+    )
 
 
 def forward(params: Params, cfg: ModelConfig, tokens: jnp.ndarray) -> jnp.ndarray:

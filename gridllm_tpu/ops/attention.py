@@ -29,25 +29,11 @@ from gridllm_tpu.ops.kvcache import gather_kv
 _NEG_INF = -1e30
 
 
-# Runtime override (beats the env var): the engine sets this to False when
-# it builds a device mesh — pallas_call has no GSPMD partitioning rule, so
-# inside a sharded jit the kernels would force replication (or fail to
-# partition) instead of riding the tp sharding. Sharded serving uses the
-# jnp path (XLA's fused attention shards fine); a shard_map kernel
-# integration is the planned follow-up.
-_runtime_override: bool | None = None
-
 # VMEM budget for flash_prefill's resident per-head K+V (the kernel pins
 # [T, D] of each); past this, Mosaic would reject the kernel at compile
 # time (~16 MB/core), so dispatch falls back to the jnp path. Chunked HBM
 # streaming for very long prefill buckets is future kernel work.
 _FLASH_KV_VMEM_CAP = 8 * 1024 * 1024
-
-
-def configure_pallas(enabled: bool | None) -> None:
-    """Force kernels on/off at runtime (None restores env/auto policy)."""
-    global _runtime_override
-    _runtime_override = enabled
 
 
 @functools.cache
@@ -63,10 +49,16 @@ def _env_mode() -> tuple[bool, bool]:
     return jax.default_backend() == "tpu", False
 
 
-def _pallas_mode() -> tuple[bool, bool]:
+def _pallas_mode(use_pallas: bool | None) -> tuple[bool, bool]:
+    """`use_pallas` is the per-call override (threaded from
+    ModelConfig.use_pallas by the model code, e.g. the engine disables
+    kernels for a mesh-sharded engine without affecting single-device
+    engines in the same process — pallas_call has no GSPMD partitioning
+    rule, so inside a sharded jit the kernels would force replication);
+    None defers to the env policy."""
     use, interpret = _env_mode()
-    if _runtime_override is not None:
-        use = _runtime_override
+    if use_pallas is not None:
+        use = use_pallas
     return use, interpret
 
 
@@ -75,12 +67,13 @@ def attention_prefill(
     k: jnp.ndarray,
     v: jnp.ndarray,
     seq_lens: jnp.ndarray,
+    use_pallas: bool | None = None,
 ) -> jnp.ndarray:
     """Causal GQA prefill attention (see attention_prefill_ref for the
     contract). Routes to the flash kernel when enabled, the shape is
     block-divisible (all engine prefill buckets are), and the per-head
     K+V fit the VMEM budget."""
-    use, interpret = _pallas_mode()
+    use, interpret = _pallas_mode(use_pallas)
     t, d = q.shape[1], q.shape[3]
     kv_bytes = 2 * t * d * q.dtype.itemsize
     if use and t % min(128, t) == 0 and kv_bytes <= _FLASH_KV_VMEM_CAP:
@@ -98,11 +91,15 @@ def paged_attention_decode(
     page_table: jnp.ndarray,
     lengths: jnp.ndarray,
     page_size: int,
+    use_pallas: bool | None = None,
 ) -> jnp.ndarray:
     """Paged decode attention (see paged_attention_decode_ref for the
-    contract). Routes to the page-streaming kernel when enabled."""
-    use, interpret = _pallas_mode()
-    if use:
+    contract). Routes to the page-streaming kernel when enabled. Mosaic
+    requires 128-lane-aligned page slices, so head_dim must be a multiple
+    of 128 on real TPU (d=64 models fall back to the jnp gather path;
+    packing two heads per lane tile is future kernel work)."""
+    use, interpret = _pallas_mode(use_pallas)
+    if use and (interpret or q.shape[-1] % 128 == 0):
         from gridllm_tpu.ops import pallas_kernels
 
         return pallas_kernels.paged_decode(

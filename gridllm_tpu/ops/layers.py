@@ -79,3 +79,16 @@ def apply_rope(
     x1, x2 = jnp.split(x, 2, axis=-1)
     out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
     return out.astype(dtype)
+
+
+def layer_norm(
+    x: jnp.ndarray, weight: jnp.ndarray, bias: jnp.ndarray, eps: float = 1e-12
+) -> jnp.ndarray:
+    """Classic LayerNorm (mean-centered, affine w/ bias) in fp32 — the
+    BERT-family norm; decoder families use rms_norm."""
+    dtype = x.dtype
+    x = x.astype(jnp.float32)
+    mu = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x - mu), axis=-1, keepdims=True)
+    x = (x - mu) * jnp.reciprocal(jnp.sqrt(var + eps))
+    return (x * weight.astype(jnp.float32) + bias.astype(jnp.float32)).astype(dtype)

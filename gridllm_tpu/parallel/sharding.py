@@ -52,6 +52,13 @@ _LAYER_SPECS: dict[str, tuple[str | None, ...]] = {
     "w_down": (None, "tp", None),
     "attn_norm": (None, None),
     "mlp_norm": (None, None),
+    # qwen2 qkv bias: [L, out] shards with its projection's out dim
+    "bq": (None, "tp"),
+    "bk": (None, "tp"),
+    "bv": (None, "tp"),
+    # qwen3 per-head qk norms: [L, D] replicated
+    "q_norm": (None, None),
+    "k_norm": (None, None),
     # MoE router + experts (mixtral): experts stacked on a [L, X, ...] axis
     "router": (None, None, None),
     "we_gate": (None, "ep", None, "tp"),

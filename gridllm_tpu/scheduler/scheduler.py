@@ -422,7 +422,7 @@ class JobScheduler(EventEmitter):
         await self._clear_active(result.jobId, free_worker=True)
         request = assignment.request
         retry_count = int(request.metadata.get("retryCount", 0))
-        if retry_count < self.config.retry_attempts:
+        if retry_count < self.config.retry_attempts and result.retryable:
             request.metadata["retryCount"] = retry_count + 1
             request.metadata["lastError"] = result.error
             delay_s = self.config.retry_delay_ms / 1000

@@ -231,5 +231,9 @@ class JobResult(_Model):
     success: bool
     response: InferenceResponse | None = None
     error: str | None = None
+    # False → the failure is permanent for the whole cluster (e.g.
+    # generation requested on an embedding-only model); the scheduler
+    # skips the retry ladder and fails the job immediately
+    retryable: bool = True
     completedAt: float = Field(default_factory=time.time)
     processingTimeMs: float = 0

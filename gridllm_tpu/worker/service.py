@@ -42,6 +42,13 @@ from gridllm_tpu.worker.chat import render_chat
 log = get_logger("worker")
 
 
+class NonRetryableJobError(RuntimeError):
+    """Failure that is permanent cluster-wide (e.g. generation on an
+    embedding-only model) — published with retryable=False so the
+    scheduler fails the job immediately instead of burning the retry
+    ladder on an outcome that cannot change."""
+
+
 class WorkerService(EventEmitter):
     """Events: registered, job_started, job_completed, job_failed, stopped."""
 
@@ -167,13 +174,24 @@ class WorkerService(EventEmitter):
             }))
 
     async def _pump(self) -> None:
-        """Drive all engines' step loops off the event loop thread."""
+        """Drive all engines' step loops off the event loop thread. A
+        step() exception (compile failure, OOM) must not kill the pump —
+        the engine's in-flight requests are aborted so their waiters get
+        an immediate error instead of hanging to the job timeout, and the
+        pump keeps serving the other engines."""
         while self._running:
             busy = False
             for eng in self.engines.values():
                 if eng.active_requests or eng.queued_requests:
                     busy = True
-                    await asyncio.to_thread(eng.step)
+                    try:
+                        await asyncio.to_thread(eng.step)
+                    except Exception as e:
+                        log.error("engine step failed; aborting its requests",
+                                  model=eng.config.model, error=str(e))
+                        n = eng.abort_all(f"engine failure: {e}")
+                        log.warning("aborted requests", model=eng.config.model,
+                                    count=n)
             if not busy:
                 self._pump_wake.clear()
                 try:
@@ -239,17 +257,21 @@ class WorkerService(EventEmitter):
             self.emit("job_completed", result)
         except Exception as e:
             log.warning("job failed", jobId=req.id, error=str(e))
-            await self._publish_failure(assignment, str(e))
+            await self._publish_failure(
+                assignment, str(e),
+                retryable=not isinstance(e, NonRetryableJobError),
+            )
         finally:
             self.current_jobs -= 1
             await self._publish_status_if_changed()
 
     async def _publish_failure(
-        self, assignment: JobAssignment, error: str, nack: bool = False
+        self, assignment: JobAssignment, error: str, nack: bool = False,
+        retryable: bool = True,
     ) -> None:
         result = JobResult(
             jobId=assignment.jobId, workerId=self.worker_id,
-            success=False, error=error,
+            success=False, error=error, retryable=retryable,
         )
         await self.bus.publish("job:failed", result.model_dump_json())
         if not nack:
@@ -320,6 +342,8 @@ class WorkerService(EventEmitter):
                 if res.done_reason == "cancel":
                     return None
                 if res.done_reason == "error":
+                    if not res.retryable:
+                        raise NonRetryableJobError(res.text or "generation failed")
                     raise RuntimeError(res.text or "generation failed")
                 return await self._finalize_generation(
                     req, res, buf, is_chat, streaming

@@ -42,7 +42,8 @@ class FakeWorker:
     def __init__(self, bus: MessageBus, worker_id: str, models: list[str],
                  max_concurrent: int = 1, heartbeat_interval_s: float = 0.2,
                  reply: str = "canned response", delay_s: float = 0.0,
-                 fail_times: int = 0, stream_tokens: list[str] | None = None):
+                 fail_times: int = 0, stream_tokens: list[str] | None = None,
+                 fail_retryable: bool = True):
         self.bus = bus
         self.worker_id = worker_id
         self.models = models
@@ -51,6 +52,7 @@ class FakeWorker:
         self.reply = reply
         self.delay_s = delay_s
         self.fail_times = fail_times
+        self.fail_retryable = fail_retryable
         self.stream_tokens = stream_tokens
         self.current_jobs = 0
         self.processed: list[str] = []
@@ -141,6 +143,7 @@ class FakeWorker:
                 self.fail_times -= 1
                 result = JobResult(jobId=job_id, workerId=self.worker_id,
                                    success=False, error="injected failure",
+                                   retryable=self.fail_retryable,
                                    processingTimeMs=(time.time() - start) * 1000)
                 await self.bus.publish("job:failed", result.model_dump_json())
                 return

@@ -105,16 +105,19 @@ def test_paged_decode_matches_ref(lens, h):
 
 
 def test_dispatch_env(monkeypatch):
-    """GRIDLLM_PALLAS resolves the documented modes."""
+    """GRIDLLM_PALLAS resolves the documented modes; the per-call
+    use_pallas override beats the env policy."""
     attention._env_mode.cache_clear()
     monkeypatch.setenv("GRIDLLM_PALLAS", "interpret")
-    assert attention._pallas_mode() == (True, True)
+    assert attention._pallas_mode(None) == (True, True)
+    assert attention._pallas_mode(False) == (False, True)
     attention._env_mode.cache_clear()
     monkeypatch.setenv("GRIDLLM_PALLAS", "0")
-    assert attention._pallas_mode() == (False, False)
+    assert attention._pallas_mode(None) == (False, False)
+    assert attention._pallas_mode(True) == (True, False)
     attention._env_mode.cache_clear()
     monkeypatch.setenv("GRIDLLM_PALLAS", "auto")
-    use, interp = attention._pallas_mode()
+    use, interp = attention._pallas_mode(None)
     assert use == (jax.default_backend() == "tpu") and interp is False
     attention._env_mode.cache_clear()
 

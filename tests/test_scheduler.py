@@ -345,3 +345,20 @@ async def test_heartbeat_does_not_erase_busy_accounting():
     assert registry.get_available_workers_by_model("m1") == []
     await fut
     await teardown(bus, registry, scheduler, w)
+
+
+async def test_non_retryable_failure_fails_fast():
+    """retryable=False on job:failed skips the retry ladder entirely —
+    the waiter gets the error after ONE attempt (permanent errors like
+    generation-on-embedding-model must not burn retry delays)."""
+    bus, registry, scheduler = await make_stack()
+    w = FakeWorker(bus, "w1", ["m1"], fail_times=99, fail_retryable=False)
+    await w.start()
+    await bus.flush()
+    t0 = asyncio.get_running_loop().time()
+    result = await scheduler.submit_and_wait(req(), timeout_ms=5000)
+    elapsed = asyncio.get_running_loop().time() - t0
+    assert not result.success and "injected failure" in result.error
+    assert w.fail_times == 98  # exactly one attempt
+    assert elapsed < 2.0       # no retry delays burned
+    await teardown(bus, registry, scheduler, w)
