@@ -224,10 +224,18 @@ class InferenceEngine:
             )
             return
 
+        # sp > 1 → sequence-parallel prefill: ring attention splits the
+        # prompt's T axis over the sp mesh axis (ops/ring_attention.py)
+        attn = None
+        if self.mesh is not None and self.mesh.shape["sp"] > 1:
+            from gridllm_tpu.ops.ring_attention import ring_attention
+
+            attn = partial(ring_attention, mesh=self.mesh)
+
         @partial(jax.jit, donate_argnums=(2, 3))
         def prefill_fn(params, tokens, cache, counts, length, slot, table_row, sp):
             logits, cache = self.mod.prefill(
-                params, mc, tokens, length, cache, slot, table_row
+                params, mc, tokens, length, cache, slot, table_row, attn=attn
             )
             # count prompt tokens for repeat_penalty (valid positions only)
             t = jnp.arange(tokens.shape[0])
@@ -474,7 +482,7 @@ class InferenceEngine:
 
         out = []
         for text in texts:
-            ids = self.tokenizer.encode_for_embedding(text)[: self.max_context]
+            ids = self.tokenizer.encode_for_embedding(text, self.max_context)
             b = self._bucket_for(len(ids))
             padded = jnp.asarray([ids + [0] * (b - len(ids))], jnp.int32)
             lens = jnp.asarray([len(ids)], jnp.int32)

@@ -26,7 +26,9 @@ class Tokenizer(Protocol):
     vocab_size: int
 
     def encode(self, text: str, add_bos: bool = True) -> list[int]: ...
-    def encode_for_embedding(self, text: str) -> list[int]: ...
+    def encode_for_embedding(
+        self, text: str, max_len: int | None = None
+    ) -> list[int]: ...
     def decode(self, ids: Sequence[int]) -> str: ...
 
 
@@ -63,8 +65,9 @@ class ByteTokenizer:
         ids = list(text.encode("utf-8"))
         return ([self.bos_id] + ids) if add_bos and self.bos_id is not None else ids
 
-    def encode_for_embedding(self, text: str) -> list[int]:
-        return self.encode(text, add_bos=True)
+    def encode_for_embedding(self, text: str, max_len: int | None = None) -> list[int]:
+        ids = self.encode(text, add_bos=True)
+        return ids[:max_len] if max_len is not None else ids
 
     def decode(self, ids: Sequence[int]) -> str:
         data = bytes(i for i in ids if 0 <= i < 256)
@@ -94,10 +97,17 @@ class HFTokenizer:
             ids = [self.bos_id] + ids
         return ids
 
-    def encode_for_embedding(self, text: str) -> list[int]:
+    def encode_for_embedding(self, text: str, max_len: int | None = None) -> list[int]:
         """Full special-token template — BERT-family tokenizers wrap with
         [CLS]...[SEP], which cls-pooling (models/bert_embed.pool) relies on
-        reading at position 0."""
+        reading at position 0. Truncation happens INSIDE the tokenizer so
+        the trailing [SEP] survives (slicing after the fact would cut it,
+        diverging from the HF/sentence-transformers pipeline)."""
+        if max_len is not None:
+            return self._tok.encode(
+                text, add_special_tokens=True, truncation=True,
+                max_length=max_len,
+            )
         return self._tok.encode(text, add_special_tokens=True)
 
     def decode(self, ids: Sequence[int]) -> str:

@@ -36,6 +36,20 @@ Params = dict[str, Any]
 # structure is identical across both families).
 MlpFn = Callable[["Params", jnp.ndarray], jnp.ndarray]
 
+# Prefill attention body: (q, k, v, seq_lens) -> attended values. Default
+# is the ops.attention dispatch (jnp ref / Pallas flash); the engine
+# passes ops.ring_attention for sp-sharded long-context prefill.
+AttnFn = Callable[
+    [jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray], jnp.ndarray
+]
+
+
+def _default_attn(cfg: ModelConfig) -> AttnFn:
+    def attn(q, k, v, seq_lens):
+        return attention_prefill(q, k, v, seq_lens, use_pallas=cfg.use_pallas)
+
+    return attn
+
 
 def _precision(x: jnp.ndarray):
     # fp32 runs (goldens) need exact matmuls; bf16 uses the MXU default.
@@ -140,10 +154,13 @@ def hidden_states(
     tokens: jnp.ndarray,
     mlp: MlpFn = _mlp,
     seq_lens: jnp.ndarray | None = None,
+    attn: AttnFn | None = None,
 ) -> jnp.ndarray:
     """Final-norm hidden states [B, T, E] (embeddings path; no unembed).
     seq_lens masks padding keys out of attention (None → all valid)."""
     _check_supported(cfg)
+    if attn is None:
+        attn = _default_attn(cfg)
     b, t = tokens.shape
     inv_freq = precompute_rope(cfg.head_dim_, cfg.rope_theta, cfg.rope_scaling)
     x = params["embed"][tokens]
@@ -156,10 +173,8 @@ def hidden_states(
         q, k, v = _qkv(cfg, lp, hx)
         q = apply_rope(q, pos, inv_freq)
         k = apply_rope(k, pos, inv_freq)
-        attn = attention_prefill(
-            q, k, v, seq_lens, use_pallas=cfg.use_pallas
-        ).reshape(b, t, -1)
-        x = x + jnp.dot(attn, lp["wo"], precision=_precision(x))
+        att = attn(q, k, v, seq_lens).reshape(b, t, -1)
+        x = x + jnp.dot(att, lp["wo"], precision=_precision(x))
         hx = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
         return x + mlp(lp, hx), None
 
@@ -187,12 +202,15 @@ def prefill(
     slot: jnp.ndarray,
     table_row: jnp.ndarray,
     mlp: MlpFn = _mlp,
+    attn: AttnFn | None = None,
 ) -> tuple[jnp.ndarray, PagedKVCache]:
     """Prefill ONE slot. tokens: [T] (padded bucket), length: scalar valid
     count, table_row: [max_pages] this slot's pages. Returns (last-token
     logits [V] fp32, updated cache). Sets cache.lengths[slot] = length.
     """
     _check_supported(cfg)
+    if attn is None:
+        attn = _default_attn(cfg)
     t = tokens.shape[0]
     inv_freq = precompute_rope(cfg.head_dim_, cfg.rope_theta, cfg.rope_scaling)
     x = params["embed"][tokens][None]  # [1, T, E]
@@ -209,10 +227,8 @@ def prefill(
             k_pages, v_pages, k[0], v[0], table_row,
             jnp.int32(0), length, cache.page_size,
         )
-        attn = attention_prefill(
-            q, k, v, seq_lens, use_pallas=cfg.use_pallas
-        ).reshape(1, t, -1)
-        x = x + jnp.dot(attn, lp["wo"], precision=_precision(x))
+        att = attn(q, k, v, seq_lens).reshape(1, t, -1)
+        x = x + jnp.dot(att, lp["wo"], precision=_precision(x))
         hx = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
         return x + mlp(lp, hx), (k_pages, v_pages)
 

@@ -103,9 +103,11 @@ def prefill(
     cache: PagedKVCache,
     slot: jnp.ndarray,
     table_row: jnp.ndarray,
+    attn: llama.AttnFn | None = None,
 ) -> tuple[jnp.ndarray, PagedKVCache]:
     return llama.prefill(
-        params, cfg, tokens, length, cache, slot, table_row, mlp=_mlp_for(cfg)
+        params, cfg, tokens, length, cache, slot, table_row,
+        mlp=_mlp_for(cfg), attn=attn,
     )
 
 
