@@ -73,9 +73,11 @@ async def run_bench(model: str, n_requests: int, n_tokens: int,
 
     prompt = "The quick brown fox jumps over the lazy dog. " * (prompt_len // 10)
 
-    # warmup: trigger prefill+decode compiles before timing
+    # warmup: trigger prefill+decode compiles before timing — MUST use the
+    # same prompt length as the measured run, or the real bucket's prefill
+    # compile (tens of seconds on first use) lands inside the timed window
     warm = await client.post("/ollama/api/generate", json={
-        "model": model, "prompt": "warmup", "stream": False,
+        "model": model, "prompt": prompt, "stream": False,
         "options": {"temperature": 0, "num_predict": 4},
     })
     assert warm.status == 200, await warm.text()

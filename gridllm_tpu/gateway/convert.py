@@ -103,9 +103,12 @@ def to_openai_chat(response: dict[str, Any], model: str, request_id: str) -> dic
         "model": model,
         "choices": [choice],
         "usage": _usage(response),
+        # Ollama's facade always stamps system_fingerprint ("fp_ollama");
+        # drop-in clients see the same key here (reference passes it
+        # through end-to-end, openai.ts:298-301)
+        "system_fingerprint": response.get("system_fingerprint")
+        or "fp_gridllm_tpu",
     }
-    if response.get("system_fingerprint"):
-        out["system_fingerprint"] = response["system_fingerprint"]
     return out
 
 
@@ -124,9 +127,9 @@ def to_openai_completion(response: dict[str, Any], model: str, request_id: str,
             "finish_reason": _finish_reason(response),
         }],
         "usage": _usage(response),
+        "system_fingerprint": response.get("system_fingerprint")
+        or "fp_gridllm_tpu",
     }
-    if response.get("system_fingerprint"):
-        out["system_fingerprint"] = response["system_fingerprint"]
     return out
 
 

@@ -160,6 +160,7 @@ def build_routes(registry: WorkerRegistry, scheduler: JobScheduler,
                 "object": "chat.completion.chunk",
                 "created": created,
                 "model": model,
+                "system_fingerprint": "fp_gridllm_tpu",
                 "choices": [{
                     "index": 0,
                     "delta": (
@@ -183,6 +184,7 @@ def build_routes(registry: WorkerRegistry, scheduler: JobScheduler,
                 "object": "chat.completion.chunk",
                 "created": created,
                 "model": model,
+                "system_fingerprint": "fp_gridllm_tpu",
                 "choices": [{"index": 0, "delta": {}, "logprobs": None,
                              "finish_reason": _chunk_finish_reason(d)}],
             }
@@ -246,6 +248,7 @@ def build_routes(registry: WorkerRegistry, scheduler: JobScheduler,
             await write_sse(resp, {
                 "id": f"cmpl-{req.id}", "object": "text_completion",
                 "created": created, "model": model,
+                "system_fingerprint": "fp_gridllm_tpu",
                 "choices": [{"text": text, "index": 0, "logprobs": None,
                              "finish_reason": None}],
             })
@@ -259,6 +262,7 @@ def build_routes(registry: WorkerRegistry, scheduler: JobScheduler,
             final: dict[str, Any] = {
                 "id": f"cmpl-{req.id}", "object": "text_completion",
                 "created": created, "model": model,
+                "system_fingerprint": "fp_gridllm_tpu",
                 "choices": [{"text": "", "index": 0, "logprobs": None,
                              "finish_reason": _chunk_finish_reason(d)}],
             }
@@ -283,14 +287,14 @@ def build_routes(registry: WorkerRegistry, scheduler: JobScheduler,
         for worker in registry.get_all_workers():
             for m in worker.capabilities.availableModels:
                 if m.name not in models_map:
+                    # exactly Ollama's facade field set {id, object,
+                    # created, owned_by} — extra legacy-OpenAI keys
+                    # (permission/root/parent) break shape parity
                     models_map[m.name] = {
                         "id": m.name,
                         "object": "model",
                         "created": int(time.time()),
                         "owned_by": "gridllm",
-                        "permission": [],
-                        "root": m.name,
-                        "parent": None,
                     }
         data = sorted(models_map.values(), key=lambda m: m["id"])
         return web.json_response({"object": "list", "data": data})

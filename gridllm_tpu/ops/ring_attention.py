@@ -75,6 +75,14 @@ def ring_attention(
     if n == 1 or t % n:
         # sp=1, or a bucket too ragged to split (trace-time check; every
         # standard prefill bucket divides by sp <= 64)
+        if n > 1:
+            import warnings
+
+            warnings.warn(
+                f"ring_attention: T={t} not divisible by sp={n}; falling "
+                "back to full (quadratic-memory) attention for this bucket "
+                "— fix the prefill bucket sizes", stacklevel=2,
+            )
         from gridllm_tpu.ops.attention import attention_prefill_ref
 
         return attention_prefill_ref(q, k, v, seq_lens)
@@ -84,13 +92,20 @@ def ring_attention(
     c = t // n
     scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32))
 
+    # also split kv heads over "tp" when divisible — without this a tp x sp
+    # mesh would all-gather heads at the shard_map boundary and compute all
+    # H heads on every tp device (tp-fold redundant attention FLOPs)
+    tp = mesh.shape["tp"]
+    head_ax = "tp" if (tp > 1 and kvh % tp == 0) else None
+
     def local(q_loc, k_loc, v_loc, lens):
-        # q_loc: [B, C, H, D]; k_loc/v_loc: [B, C, KVH, D]; lens: [B]
+        # q_loc: [B, C, H/tp, D]; k_loc/v_loc: [B, C, KVH/tp, D]; lens: [B]
         i = jax.lax.axis_index("sp")
-        qf = (q_loc.astype(jnp.float32) * scale).reshape(b, c, kvh, g, d)
-        m = jnp.full((b, c, kvh, g, 1), _NEG_INF, jnp.float32)
-        l = jnp.zeros((b, c, kvh, g, 1), jnp.float32)
-        acc = jnp.zeros((b, c, kvh, g, d), jnp.float32)
+        kvh_l = k_loc.shape[2]
+        qf = (q_loc.astype(jnp.float32) * scale).reshape(b, c, kvh_l, g, d)
+        m = jnp.full((b, c, kvh_l, g, 1), _NEG_INF, jnp.float32)
+        l = jnp.zeros((b, c, kvh_l, g, 1), jnp.float32)
+        acc = jnp.zeros((b, c, kvh_l, g, d), jnp.float32)
         kv = (k_loc.astype(jnp.float32), v_loc.astype(jnp.float32))
         perm = [(p, (p + 1) % n) for p in range(n)]
 
@@ -106,13 +121,18 @@ def ring_attention(
                 kv = jax.lax.ppermute(kv, "sp", perm)
         _, l, acc = carry
         out = acc / jnp.maximum(l, 1e-30)
-        return out.reshape(b, c, h, d).astype(q_loc.dtype)
+        return out.reshape(b, c, kvh_l * g, d).astype(q_loc.dtype)
 
     shard = partial(
         jax.shard_map,
         mesh=mesh,
-        in_specs=(P(None, "sp"), P(None, "sp"), P(None, "sp"), P()),
-        out_specs=P(None, "sp"),
+        in_specs=(
+            P(None, "sp", head_ax),
+            P(None, "sp", head_ax),
+            P(None, "sp", head_ax),
+            P(),
+        ),
+        out_specs=P(None, "sp", head_ax),
         check_vma=False,  # ppermute's value motion defeats the rep check
     )
     return shard(local)(q, k, v, seq_lens.astype(jnp.int32))

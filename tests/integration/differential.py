@@ -1,0 +1,202 @@
+#!/usr/bin/env python
+"""Differential API-shape e2e — the fidelity gate for drop-in parity.
+
+Port of the reference's tests/integration/integration.ts:1-224: issue the
+same request to an oracle and to GridLLM-TPU and compare response SHAPE —
+same key set and same `typeof` per key, values ignored
+(areObjectsSimilar, integration.ts:6-35). Covers /v1/models,
+/v1/completions, /v1/chat/completions incl. tool definitions
+(integration.ts:37-173), plus /ollama/api/generate.
+
+Oracle selection:
+- OLLAMA_ENDPOINT set → live differential against a real Ollama (exactly
+  the reference's CI harness).
+- otherwise → recorded golden shapes below, captured from real Ollama
+  0.6.x / OpenAI-compat responses (zero-egress CI can still gate shape).
+
+Usage: python tests/integration/differential.py \
+         --endpoint http://localhost:4000 --model tiny-llama
+Exit code 0 = all shape checks passed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import urllib.request
+
+# JS typeof buckets (integration.ts compares `typeof`): bool is its own
+# type in JS ("boolean"), int/float are both "number", None ~ "object".
+def _js_typeof(v) -> str:
+    if isinstance(v, bool):
+        return "boolean"
+    if isinstance(v, (int, float)):
+        return "number"
+    if isinstance(v, str):
+        return "string"
+    return "object"
+
+
+def are_objects_similar(a, b, path="$") -> bool:
+    """Same sorted key set + same typeof per key (values ignored)."""
+    ka, kb = sorted(a.keys()), sorted(b.keys())
+    if ka != kb:
+        print(f"Keys mismatch at {path}:", {"oracle": ka, "gridllm": kb})
+        return False
+    ok = True
+    for k in ka:
+        if _js_typeof(a[k]) != _js_typeof(b[k]):
+            print(f'Type mismatch for key "{path}.{k}":',
+                  {"oracle": _js_typeof(a[k]), "gridllm": _js_typeof(b[k])})
+            ok = False
+    return ok
+
+
+# ------------------------------------------------------------------ goldens
+# Shapes recorded from real Ollama (native + OpenAI facade) responses.
+
+GOLDEN = {
+    "v1_models": {
+        "object": "list",
+        "data": [
+            {"id": "m", "object": "model", "created": 0, "owned_by": "library"},
+        ],
+    },
+    "v1_completions": {
+        "id": "cmpl-x", "object": "text_completion", "created": 0,
+        "model": "m", "system_fingerprint": "fp_ollama",
+        "choices": [
+            {"index": 0, "text": "t", "logprobs": None,
+             "finish_reason": "stop"},
+        ],
+        "usage": {"prompt_tokens": 1, "completion_tokens": 1, "total_tokens": 2},
+    },
+    "v1_chat_completions": {
+        "id": "chatcmpl-x", "object": "chat.completion", "created": 0,
+        "model": "m", "system_fingerprint": "fp_ollama",
+        "choices": [
+            {"index": 0,
+             "message": {"role": "assistant", "content": "t"},
+             "logprobs": None,
+             "finish_reason": "stop"},
+        ],
+        "usage": {"prompt_tokens": 1, "completion_tokens": 1, "total_tokens": 2},
+    },
+    "ollama_generate": {
+        "model": "m", "created_at": "2024-01-01T00:00:00Z", "response": "t",
+        "done": True, "done_reason": "stop", "context": [1],
+        "total_duration": 1, "load_duration": 1, "prompt_eval_count": 1,
+        "prompt_eval_duration": 1, "eval_count": 1, "eval_duration": 1,
+    },
+}
+
+
+def _post(url: str, payload: dict) -> dict:
+    req = urllib.request.Request(
+        url, json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=120) as r:
+        return json.loads(r.read())
+
+
+def _get(url: str) -> dict:
+    with urllib.request.urlopen(url, timeout=30) as r:
+        return json.loads(r.read())
+
+
+def run(endpoint: str, model: str, oracle: str | None) -> bool:
+    results: dict[str, bool] = {}
+
+    def oracle_shape(name: str, fetch):
+        if oracle:
+            return fetch(oracle)
+        return GOLDEN[name]
+
+    # /v1/models (integration.ts:37-48)
+    got = _get(f"{endpoint}/v1/models")
+    want = oracle_shape("v1_models", lambda o: _get(f"{o}/v1/models"))
+    ok = are_objects_similar(want, got)
+    if ok and not oracle and got.get("data") and want.get("data"):
+        ok = are_objects_similar(want["data"][0], got["data"][0], "$.data[0]")
+    results["/v1/models"] = ok
+
+    # /v1/completions (integration.ts:50-81)
+    comp_req = {"model": model, "prompt": "Hello, world!", "max_tokens": 5,
+                "temperature": 0}
+    got = _post(f"{endpoint}/v1/completions", comp_req)
+    want = oracle_shape(
+        "v1_completions", lambda o: _post(f"{o}/v1/completions", comp_req)
+    )
+    ok = are_objects_similar(want, got)
+    if ok and not oracle:
+        ok = are_objects_similar(want["choices"][0], got["choices"][0],
+                                 "$.choices[0]")
+        ok = ok and are_objects_similar(want["usage"], got["usage"], "$.usage")
+    results["/v1/completions"] = ok
+
+    # /v1/chat/completions with tool definitions (integration.ts:83-173)
+    chat_req = {
+        "model": model, "max_tokens": 8, "temperature": 0,
+        "messages": [{"role": "user", "content": "What is 2+2?"}],
+        "tools": [{
+            "type": "function",
+            "function": {
+                "name": "calculator",
+                "description": "Evaluate arithmetic",
+                "parameters": {
+                    "type": "object",
+                    "properties": {"expression": {"type": "string"}},
+                    "required": ["expression"],
+                },
+            },
+        }],
+    }
+    got = _post(f"{endpoint}/v1/chat/completions", chat_req)
+    want = oracle_shape(
+        "v1_chat_completions",
+        lambda o: _post(f"{o}/v1/chat/completions", chat_req),
+    )
+    ok = are_objects_similar(want, got)
+    if ok and not oracle:
+        ok = are_objects_similar(want["choices"][0], got["choices"][0],
+                                 "$.choices[0]")
+        ok = ok and are_objects_similar(
+            want["choices"][0]["message"], got["choices"][0]["message"],
+            "$.choices[0].message",
+        )
+    results["/v1/chat/completions"] = ok
+
+    # /ollama/api/generate non-streaming (native API shape)
+    gen_req = {"model": model, "prompt": "Hi", "stream": False,
+               "options": {"num_predict": 4, "temperature": 0}}
+    got = _post(f"{endpoint}/ollama/api/generate", gen_req)
+    want = oracle_shape(
+        "ollama_generate", lambda o: _post(f"{o}/api/generate", gen_req)
+    )
+    results["/ollama/api/generate"] = are_objects_similar(want, got)
+
+    print()
+    for name, ok in results.items():
+        print(f"  {'PASS' if ok else 'FAIL'}  {name}")
+    return all(results.values())
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--endpoint",
+                    default=os.environ.get("GRIDLLM_ENDPOINT",
+                                           "http://localhost:4000"))
+    ap.add_argument("--model",
+                    default=os.environ.get("TEST_MODEL", "tiny-llama"))
+    ap.add_argument("--oracle", default=os.environ.get("OLLAMA_ENDPOINT"))
+    args = ap.parse_args()
+    ok = run(args.endpoint, args.model, args.oracle)
+    print("\nALL SHAPE CHECKS PASSED" if ok else "\nSHAPE CHECKS FAILED")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

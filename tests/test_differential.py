@@ -1,0 +1,50 @@
+"""Run the differential shape gate (tests/integration/differential.py, the
+port of the reference's integration.ts harness) against an in-process live
+cluster — same script CI runs against the docker bundle."""
+
+import asyncio
+import threading
+
+from aiohttp import web
+
+from gridllm_tpu.bus.memory import InMemoryBus
+from gridllm_tpu.engine import EngineConfig, InferenceEngine
+from gridllm_tpu.gateway.app import create_app
+from gridllm_tpu.scheduler import JobScheduler, WorkerRegistry
+from gridllm_tpu.utils.config import Config, WorkerConfig
+from gridllm_tpu.worker.service import WorkerService
+
+from .integration.differential import run as run_differential
+
+
+async def test_differential_shape_gate():
+    engine = InferenceEngine(EngineConfig(
+        model="tiny-llama", max_slots=2, page_size=8, num_pages=64,
+        max_pages_per_slot=16, prefill_buckets=(64,), seed=0,
+    ))
+    bus = InMemoryBus()
+    await bus.connect()
+    config = Config()
+    registry = WorkerRegistry(bus, config.scheduler)
+    scheduler = JobScheduler(bus, registry, config.scheduler)
+    await registry.initialize()
+    await scheduler.initialize()
+    app = create_app(bus, registry, scheduler, config)
+    worker = WorkerService(bus, {"tiny-llama": engine}, WorkerConfig(),
+                           stream_flush_ms=5)
+    await worker.start()
+    await asyncio.sleep(0.2)
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    port = site._server.sockets[0].getsockname()[1]
+
+    # differential.py uses blocking urllib — run it off the event loop
+    ok = await asyncio.to_thread(
+        run_differential, f"http://127.0.0.1:{port}", "tiny-llama", None
+    )
+    await runner.cleanup()
+    await worker.stop()
+    await scheduler.shutdown()
+    assert ok, "API shape diverged from the recorded Ollama/OpenAI goldens"
