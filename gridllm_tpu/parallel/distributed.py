@@ -1,0 +1,91 @@
+"""Multi-host worker-group runtime: jax.distributed lifecycle.
+
+SURVEY.md §5.8(b): a multi-host TPU slice registers as ONE logical worker.
+ICI/DCN-level array communication is jax's own coordination service
+(`jax.distributed.initialize` → XLA collectives over the global mesh);
+the bus protocol (§2.6) only ever sees the single logical worker, spoken
+for by the liaison host (process 0). The reference's analogue is
+process-level multi-node deployment (docs/deployment/DEPLOYMENT.md:7-33)
+— it never splits a model, so this lifecycle is new capability.
+
+Env contract (all optional; absent → single-host, no-op):
+  GRIDLLM_COORD_ADDR   host:port of process 0 (jax coordinator)
+  GRIDLLM_NUM_PROCS    total processes in the slice
+  GRIDLLM_PROC_ID      this process's id (0 = liaison)
+  GRIDLLM_LOCAL_DEVICES  optional device count override (CPU testing)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+
+from gridllm_tpu.utils.logging import get_logger
+
+log = get_logger("parallel.distributed")
+
+
+@dataclasses.dataclass(frozen=True)
+class GroupConfig:
+    """Shape of one logical worker's process group."""
+
+    coordinator: str | None = None   # host:port of process 0
+    num_processes: int = 1
+    process_id: int = 0
+
+    @staticmethod
+    def from_env() -> "GroupConfig":
+        return GroupConfig(
+            coordinator=os.environ.get("GRIDLLM_COORD_ADDR") or None,
+            num_processes=int(os.environ.get("GRIDLLM_NUM_PROCS", "1")),
+            process_id=int(os.environ.get("GRIDLLM_PROC_ID", "0")),
+        )
+
+    @property
+    def is_group(self) -> bool:
+        return self.num_processes > 1
+
+    @property
+    def is_liaison(self) -> bool:
+        """Process 0 speaks the bus protocol for the whole slice."""
+        return self.process_id == 0
+
+
+def initialize_group(cfg: GroupConfig | None = None) -> GroupConfig:
+    """Join the slice's jax process group (no-op for single-host).
+
+    Must run before any jax backend use in this process. After this,
+    jax.devices() is the GLOBAL device list across all slice hosts and
+    meshes built from it emit cross-host collectives.
+    """
+    cfg = cfg or GroupConfig.from_env()
+    if not cfg.is_group:
+        return cfg
+    if not cfg.coordinator:
+        raise ValueError(
+            "GRIDLLM_NUM_PROCS > 1 requires GRIDLLM_COORD_ADDR (host:port "
+            "of process 0) — a slice cannot form without a coordinator"
+        )
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=cfg.coordinator,
+        num_processes=cfg.num_processes,
+        process_id=cfg.process_id,
+    )
+    log.info("joined worker group", coordinator=cfg.coordinator,
+             process=f"{cfg.process_id}/{cfg.num_processes}",
+             global_devices=jax.device_count(),
+             local_devices=jax.local_device_count())
+    return cfg
+
+
+def shutdown_group(cfg: GroupConfig) -> None:
+    if not cfg.is_group:
+        return
+    import jax
+
+    try:
+        jax.distributed.shutdown()
+    except Exception as e:  # already torn down / coordinator gone
+        log.warning("distributed shutdown failed", error=str(e))

@@ -336,19 +336,41 @@ class JobScheduler(EventEmitter):
                                   if qj.request.id not in assigned_ids]
 
     def _select_worker(self, request: InferenceRequest) -> WorkerInfo | None:
-        """Least-loaded, then performance tier (reference:
-        JobScheduler.ts:317-360). TPU extension: prefer a worker advertising
-        a shard layout for the model (topology-aware placement)."""
+        """Topology-aware selection (reference baseline: least-loaded then
+        tier, JobScheduler.ts:317-360; TPU extension per SURVEY.md §2.6).
+
+        Order of discrimination:
+        1. context fit — a worker whose layout for this model cannot hold
+           the request's `num_ctx` loses to one that can;
+        2. proportional load — currentJobs / maxConcurrentTasks (absolute
+           job counts are unfair between differently-sized workers);
+        3. layout headroom — more batch slots on the serving layout wins
+           (a v5e-8 TP worker with 16 slots beats a single-chip 4-slot
+           worker at equal relative load);
+        4. performance tier.
+        """
         candidates = self.registry.get_available_workers_by_model(request.model)
         if not candidates:
             return None
+        opts = request.options or {}
+        try:  # options is unvalidated client input — never let a bad
+            # num_ctx abort the dispatch pass (head-of-line blocking)
+            num_ctx = int(opts.get("num_ctx") or 0)
+        except (TypeError, ValueError):
+            num_ctx = 0
 
-        def score(w: WorkerInfo) -> tuple[int, int, int]:
-            has_layout = any(l.name == request.model for l in w.capabilities.shardLayouts)
+        def score(w: WorkerInfo) -> tuple[int, float, int, int]:
+            caps = w.capabilities
+            layout = next(
+                (l for l in caps.shardLayouts if l.name == request.model), None
+            )
+            ctx_ok = layout is None or num_ctx <= 0 or num_ctx <= layout.maxSeqLen
+            slots = layout.maxBatchSlots if layout is not None else 1
             return (
-                w.currentJobs,
-                0 if has_layout else 1,
-                _TIER_RANK.get(w.capabilities.performanceTier, 1),
+                0 if ctx_ok else 1,
+                w.currentJobs / max(caps.maxConcurrentTasks, 1),
+                -slots,
+                _TIER_RANK.get(caps.performanceTier, 1),
             )
 
         return min(candidates, key=score)
@@ -421,6 +443,23 @@ class JobScheduler(EventEmitter):
             return
         await self._clear_active(result.jobId, free_worker=True)
         request = assignment.request
+        if result.nack:
+            # capacity NACK: the job never ran — requeue at the front
+            # WITHOUT touching the retry ladder. Bounded by nackCount so a
+            # pathological nack-storm still terminates via the real ladder.
+            nacks = int(request.metadata.get("nackCount", 0)) + 1
+            request.metadata["nackCount"] = nacks
+            if nacks <= self.config.max_nacks:
+                self._front_seq -= 1
+                qj = _QueuedJob(request, self._front_seq)
+                self.job_queue.insert(0, qj)
+                await self._persist_queued(qj)
+                log.job("assignment NACKed; requeued (no retry consumed)",
+                        result.jobId, worker_id=result.workerId, nacks=nacks)
+                self.request_dispatch()
+                return
+            log.warning("nack storm; entering retry ladder",
+                        job_id=result.jobId, nacks=nacks)
         retry_count = int(request.metadata.get("retryCount", 0))
         if retry_count < self.config.retry_attempts and result.retryable:
             request.metadata["retryCount"] = retry_count + 1

@@ -59,6 +59,9 @@ class SchedulerConfig(BaseModel):
     job_timeout_ms: int = Field(600_000, gt=0)
     retry_attempts: int = Field(3, ge=0)
     retry_delay_ms: int = Field(5_000, ge=0)
+    # capacity NACKs requeue without consuming the retry ladder, but only
+    # this many times — a nack storm then falls through to the real ladder
+    max_nacks: int = Field(25, ge=0)
     max_concurrent_jobs_per_worker: int = Field(1, ge=1)
     # TPU change: the reference polled a 1 s tick (JobScheduler.ts:128-135);
     # we dispatch event-driven, with this tick only as a fallback sweep.

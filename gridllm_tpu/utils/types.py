@@ -235,5 +235,10 @@ class JobResult(_Model):
     # generation requested on an embedding-only model); the scheduler
     # skips the retry ladder and fails the job immediately
     retryable: bool = True
+    # True → not a real attempt: the worker refused the assignment
+    # (capacity race). The scheduler requeues WITHOUT consuming the retry
+    # ladder — three racy over-assignments must not permanently fail a job
+    # that never ran (round-1 VERDICT #8)
+    nack: bool = False
     completedAt: float = Field(default_factory=time.time)
     processingTimeMs: float = 0

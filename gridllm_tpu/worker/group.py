@@ -1,0 +1,147 @@
+"""Slice membership + failure propagation for multi-host logical workers.
+
+The hard part SURVEY.md §7 step 6 names: reconciling a multi-host worker
+group with the single-worker heartbeat/orphan protocol (§2.6). Rules:
+
+- ONE logical worker: only the liaison (process 0) registers on the bus,
+  heartbeats `heartbeat:{workerId}`, and executes the job protocol.
+- EVERY process (liaison included) additionally maintains a member TTL key
+  `heartbeat:group:{workerId}:{processId}` on the bus.
+- Any member key expiring ⇒ the slice is broken ⇒ the WHOLE logical worker
+  must fail fast: the liaison announces `worker:disconnected` and stops
+  heartbeating, so the scheduler's orphan machinery requeues in-flight jobs
+  (scheduler.py orphan path; reference analogue JobScheduler.ts:553-630).
+  Followers exit so the operator's supervisor restarts the slice together.
+
+A slice member that dies WITHOUT expiring its TTL first (clean exit) deletes
+its key, which the monitors see immediately — same fast-eviction idea as the
+reference's socket-close `worker:disconnected` publish
+(RedisConnectionManager.ts:158-179).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+from typing import Awaitable, Callable
+
+from gridllm_tpu.bus.base import MessageBus
+from gridllm_tpu.parallel.distributed import GroupConfig
+from gridllm_tpu.utils.logging import get_logger
+
+log = get_logger("worker.group")
+
+
+def member_key(worker_id: str, process_id: int) -> str:
+    return f"heartbeat:group:{worker_id}:{process_id}"
+
+
+class GroupMembership:
+    """Per-process membership beacon + slice-health monitor.
+
+    `on_slice_failure` fires (once) when any member of the slice goes
+    silent. The liaison passes a callback that fails the logical worker;
+    followers pass one that exits the process.
+    """
+
+    def __init__(
+        self,
+        bus: MessageBus,
+        worker_id: str,
+        group: GroupConfig,
+        heartbeat_interval_s: float = 5.0,
+        on_slice_failure: Callable[[str], Awaitable[None]] | None = None,
+    ):
+        self.bus = bus
+        self.worker_id = worker_id
+        self.group = group
+        self.interval_s = heartbeat_interval_s
+        self.on_slice_failure = on_slice_failure
+        self._tasks: list[asyncio.Task] = []
+        self._running = False
+        self._failed = False
+        # a member is only monitored after it has been seen once, so slice
+        # startup (processes join over several seconds) is not a "failure"
+        self._seen: set[int] = set()
+
+    async def start(self) -> None:
+        if not self.group.is_group:
+            return
+        self._running = True
+        await self._beat_once()
+        self._tasks.append(asyncio.create_task(self._beacon_loop()))
+        self._tasks.append(asyncio.create_task(self._monitor_loop()))
+        log.info("group membership active", worker=self.worker_id,
+                 process=f"{self.group.process_id}/{self.group.num_processes}")
+
+    async def stop(self) -> None:
+        self._running = False
+        for t in self._tasks:
+            t.cancel()
+        self._tasks.clear()
+        if self.group.is_group:
+            try:
+                await self.bus.delete(
+                    member_key(self.worker_id, self.group.process_id)
+                )
+            except Exception:
+                pass
+
+    async def _beat_once(self) -> None:
+        await self.bus.set_with_expiry(
+            member_key(self.worker_id, self.group.process_id),
+            str(time.time()), ttl_s=self.interval_s * 2,
+        )
+
+    async def _beacon_loop(self) -> None:
+        while self._running:
+            try:
+                await self._beat_once()
+            except Exception as e:
+                log.warning("group beacon failed", error=str(e))
+            await asyncio.sleep(self.interval_s)
+
+    async def _monitor_loop(self) -> None:
+        check_s = max(self.interval_s / 2, 0.05)
+        while self._running:
+            await asyncio.sleep(check_s)
+            try:
+                missing = await self._missing_members()
+            except Exception as e:
+                log.warning("group monitor bus error", error=str(e))
+                continue
+            if missing and not self._failed:
+                self._failed = True
+                reason = f"slice members lost: {sorted(missing)}"
+                log.error("worker group broken", worker=self.worker_id,
+                          reason=reason)
+                if self.on_slice_failure is not None:
+                    await self.on_slice_failure(reason)
+                return
+
+    async def _missing_members(self) -> set[int]:
+        missing: set[int] = set()
+        for pid in range(self.group.num_processes):
+            if pid == self.group.process_id:
+                continue
+            val = await self.bus.get(member_key(self.worker_id, pid))
+            if val is None:
+                if pid in self._seen:
+                    missing.add(pid)
+            else:
+                self._seen.add(pid)
+        return missing
+
+
+async def fail_logical_worker(bus: MessageBus, worker_id: str, reason: str) -> None:
+    """Liaison-side slice failure: announce disconnection so the scheduler
+    evicts the worker and orphans its jobs immediately (fast path — the
+    heartbeat TTL would get there ~10 s later anyway)."""
+    try:
+        await bus.publish("worker:disconnected", json.dumps({
+            "workerId": worker_id, "reason": reason,
+        }))
+        await bus.hdel("workers", worker_id)
+    except Exception as e:
+        log.warning("failure announce failed", error=str(e))

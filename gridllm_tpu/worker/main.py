@@ -104,31 +104,103 @@ def build_health_app(service: WorkerService) -> web.Application:
 
 
 async def run(config: Config | None = None) -> None:
+    """Worker process entry. Single-host: bus + engines + WorkerService.
+
+    Multi-host slice (GRIDLLM_NUM_PROCS > 1, SURVEY.md §5.8b): every
+    process joins the jax group FIRST (so jax.devices() is the global
+    slice and engine meshes emit cross-host collectives), then:
+      - process 0 (liaison) runs the full bus worker — ONE logical worker;
+      - followers hold the jax runtime open and watch slice health.
+    Any member death fails the WHOLE logical worker: the liaison announces
+    `worker:disconnected` (scheduler orphans its jobs, scheduler.py orphan
+    path) and every process exits so the supervisor restarts the slice
+    together.
+    """
+    from gridllm_tpu.parallel.distributed import initialize_group, shutdown_group
+    from gridllm_tpu.worker.group import GroupMembership, fail_logical_worker
+
     config = config or load_config()
+    group = initialize_group()
+    if group.is_group and not os.environ.get("WORKER_ID"):
+        # ALL slice processes must agree on the logical worker id or the
+        # member heartbeat keys never match and slice-failure detection is
+        # a silent no-op. Without an explicit WORKER_ID, derive a shared,
+        # slice-unique id from the coordinator address.
+        import hashlib
+
+        wid = "worker-slice-" + hashlib.sha1(
+            (group.coordinator or "").encode()
+        ).hexdigest()[:12]
+        config.worker = config.worker.model_copy(update={"worker_id": wid})
     bus = create_bus(config.bus.url, key_prefix=config.bus.key_prefix,
                      password=config.bus.password, db=config.bus.db)
     await bus.connect()
-    engines = build_engines(config)
-    if not engines:
-        raise SystemExit("no models configured: set GRIDLLM_MODELS")
-    service = WorkerService(
-        bus, engines, config.worker,
-        stream_flush_ms=config.engine.stream_flush_ms,
-    )
-    await service.start()
-    app = build_health_app(service)
-    runner = web.AppRunner(app)
-    await runner.setup()
-    site = web.TCPSite(runner, config.worker.host, config.worker.port)
-    await site.start()
-    log.info("worker http listening", port=config.worker.port)
+
     stop = asyncio.Event()
-    try:
-        await stop.wait()
-    finally:
-        await service.stop()
-        await runner.cleanup()
-        await bus.disconnect()
+    slice_broken: list[str] = []
+    if group.is_liaison:
+        engines = build_engines(config)
+        if not engines:
+            raise SystemExit("no models configured: set GRIDLLM_MODELS")
+        service = WorkerService(
+            bus, engines, config.worker,
+            stream_flush_ms=config.engine.stream_flush_ms,
+        )
+
+        async def on_slice_failure(reason: str) -> None:
+            await fail_logical_worker(bus, service.worker_id, reason)
+            await service.stop(announce=False)
+            slice_broken.append(reason)
+            stop.set()
+
+        membership = GroupMembership(
+            bus, service.worker_id, group,
+            heartbeat_interval_s=config.worker.heartbeat_interval_ms / 1000.0,
+            on_slice_failure=on_slice_failure,
+        )
+        await membership.start()
+        await service.start()
+        app = build_health_app(service)
+        runner = web.AppRunner(app)
+        await runner.setup()
+        site = web.TCPSite(runner, config.worker.host, config.worker.port)
+        await site.start()
+        log.info("worker http listening", port=config.worker.port)
+        try:
+            await stop.wait()
+        finally:
+            await membership.stop()
+            await service.stop()
+            await runner.cleanup()
+            await bus.disconnect()
+            if slice_broken:
+                # jax.distributed teardown blocks on dead slice members —
+                # fail fast so the supervisor restarts the slice together
+                log.error("slice broken; exiting", reason=slice_broken[0])
+                os._exit(1)
+            shutdown_group(group)
+    else:
+        # follower: participate in the jax group; exit when the slice breaks
+        async def on_slice_failure(reason: str) -> None:
+            slice_broken.append(reason)
+            stop.set()
+
+        membership = GroupMembership(
+            bus, config.worker.worker_id, group,
+            heartbeat_interval_s=config.worker.heartbeat_interval_ms / 1000.0,
+            on_slice_failure=on_slice_failure,
+        )
+        await membership.start()
+        try:
+            await stop.wait()
+        finally:
+            await membership.stop()
+            await bus.disconnect()
+            if slice_broken:
+                log.error("slice broken; follower exiting",
+                          reason=slice_broken[0])
+                os._exit(1)
+            shutdown_group(group)
 
 
 def main() -> None:  # pragma: no cover

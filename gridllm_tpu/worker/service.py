@@ -181,7 +181,7 @@ class WorkerService(EventEmitter):
         pump keeps serving the other engines."""
         while self._running:
             busy = False
-            for eng in self.engines.values():
+            for eng in list(self.engines.values()):
                 if eng.active_requests or eng.queued_requests:
                     busy = True
                     try:
@@ -192,6 +192,7 @@ class WorkerService(EventEmitter):
                         n = eng.abort_all(f"engine failure: {e}")
                         log.warning("aborted requests", model=eng.config.model,
                                     count=n)
+                        await self._recover_engine(eng)
             if not busy:
                 self._pump_wake.clear()
                 try:
@@ -200,6 +201,31 @@ class WorkerService(EventEmitter):
                     return
             else:
                 await asyncio.sleep(0)
+
+    async def _recover_engine(self, eng: InferenceEngine) -> None:
+        """After a step() failure the engine's donated device buffers may be
+        gone (a jit call that raises mid-flight consumes cache/counts);
+        without recovery every later request on this engine fails in an
+        accept-then-abort loop while the worker still advertises the model.
+        Rebuild the device state; if even that fails, stop serving the model
+        (drop the engine + re-register) so the scheduler routes elsewhere."""
+        try:
+            await asyncio.to_thread(eng.reset_device_state)
+            log.info("engine device state rebuilt", model=eng.config.model)
+        except Exception as e:
+            log.error("engine unrecoverable; dropping model",
+                      model=eng.config.model, error=str(e))
+            self.engines = {
+                m: e for m, e in self.engines.items() if e is not eng
+            }
+            self.max_concurrent = max(
+                sum(e.config.max_slots for e in self.engines.values()), 1
+            )
+            try:
+                await self.register()  # advertise the reduced model set
+            except Exception as reg_err:
+                log.warning("re-register after engine drop failed",
+                            error=str(reg_err))
 
     # ---------------------------------------------------------------- jobs
 
@@ -224,10 +250,17 @@ class WorkerService(EventEmitter):
         asyncio.ensure_future(self._execute(assignment))
 
     def _resolve_engine(self, model: str) -> InferenceEngine | None:
+        """Exact match, plus the one alias Ollama itself applies: a bare
+        model name means the ':latest' tag and vice versa. (The round-1
+        dash heuristic — model.split('-')[0] — could only ever produce
+        wrong or missed lookups, e.g. 'all-minilm' → 'all'.)"""
         if model in self.engines:
             return self.engines[model]
-        base = model.split("-")[0]
-        return self.engines.get(base)
+        if model.endswith(":latest"):
+            return self.engines.get(model[: -len(":latest")])
+        if ":" not in model:
+            return self.engines.get(f"{model}:latest")
+        return None
 
     async def _execute(self, assignment: JobAssignment) -> None:
         req = assignment.request
@@ -271,7 +304,7 @@ class WorkerService(EventEmitter):
     ) -> None:
         result = JobResult(
             jobId=assignment.jobId, workerId=self.worker_id,
-            success=False, error=error, retryable=retryable,
+            success=False, error=error, retryable=retryable, nack=nack,
         )
         await self.bus.publish("job:failed", result.model_dump_json())
         if not nack:
@@ -342,9 +375,10 @@ class WorkerService(EventEmitter):
                 if res.done_reason == "cancel":
                     return None
                 if res.done_reason == "error":
+                    msg = res.error or res.text or "generation failed"
                     if not res.retryable:
-                        raise NonRetryableJobError(res.text or "generation failed")
-                    raise RuntimeError(res.text or "generation failed")
+                        raise NonRetryableJobError(msg)
+                    raise RuntimeError(msg)
                 return await self._finalize_generation(
                     req, res, buf, is_chat, streaming
                 )

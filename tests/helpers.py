@@ -43,7 +43,8 @@ class FakeWorker:
                  max_concurrent: int = 1, heartbeat_interval_s: float = 0.2,
                  reply: str = "canned response", delay_s: float = 0.0,
                  fail_times: int = 0, stream_tokens: list[str] | None = None,
-                 fail_retryable: bool = True):
+                 fail_retryable: bool = True, nack_times: int = 0,
+                 layouts: list | None = None):
         self.bus = bus
         self.worker_id = worker_id
         self.models = models
@@ -53,6 +54,8 @@ class FakeWorker:
         self.delay_s = delay_s
         self.fail_times = fail_times
         self.fail_retryable = fail_retryable
+        self.nack_times = nack_times
+        self.layouts = layouts or []
         self.stream_tokens = stream_tokens
         self.current_jobs = 0
         self.processed: list[str] = []
@@ -68,6 +71,7 @@ class FakeWorker:
                 workerId=self.worker_id,
                 availableModels=[ModelInfo(name=m) for m in self.models],
                 maxConcurrentTasks=self.max_concurrent,
+                shardLayouts=self.layouts,
             ),
             status="online",
             currentJobs=self.current_jobs,
@@ -128,6 +132,14 @@ class FakeWorker:
         if msg.get("type") != "job_assignment":
             return
         assignment = JobAssignment.model_validate(msg["job"])
+        if self.nack_times > 0:
+            self.nack_times -= 1
+            result = JobResult(jobId=assignment.jobId, workerId=self.worker_id,
+                               success=False, error="worker at capacity",
+                               nack=True)
+            asyncio.ensure_future(
+                self.bus.publish("job:failed", result.model_dump_json()))
+            return
         asyncio.ensure_future(self._execute(assignment))
 
     async def _execute(self, assignment: JobAssignment) -> None:

@@ -148,3 +148,75 @@ def test_checkpoint_roundtrip(tmp_path):
     a = eng.generate(GenerationRequest(id="a", prompt="hi", options=opts))
     b = eng2.generate(GenerationRequest(id="b", prompt="hi", options=opts))
     assert a.token_ids == b.token_ids
+
+
+def test_chunked_prefill_matches_single_shot():
+    """VERDICT.md #4: prompts longer than prefill_chunk run as repeated
+    fixed-shape chunk programs against the cached prefix. Greedy output must
+    match the single-shot bucket path, and admitting a second long prompt of
+    a DIFFERENT length must compile nothing new."""
+    chunked = InferenceEngine(EngineConfig(**TINY, prefill_chunk=16))
+    single = InferenceEngine(EngineConfig(**TINY, prefill_chunk=64))
+    opts = {"temperature": 0.0, "num_predict": 6}
+
+    prompt = "abcdefgh" * 4  # 33 ids with BOS > chunk 16 → 3 chunks
+    r_c = chunked.generate(GenerationRequest(id="c", prompt=prompt, options=opts))
+    r_s = single.generate(GenerationRequest(id="s", prompt=prompt, options=opts))
+    assert r_c.token_ids == r_s.token_ids
+    assert chunked._prefill_chunk_fn._cache_size() == 1
+
+    # different long length → same compiled program, no new trace
+    prompt2 = "zyxwvuts" * 5  # 41 ids
+    r2_c = chunked.generate(GenerationRequest(id="c2", prompt=prompt2, options=opts))
+    r2_s = single.generate(GenerationRequest(id="s2", prompt=prompt2, options=opts))
+    assert r2_c.token_ids == r2_s.token_ids
+    assert chunked._prefill_chunk_fn._cache_size() == 1
+
+
+def test_embed_batched_matches_single():
+    """Batched embeddings (BASELINE config #5) must equal one-at-a-time
+    results for every text, across length buckets within one call."""
+    eng = InferenceEngine(EngineConfig(**TINY))
+    texts = ["a", "hello world", "x" * 30, "medium length text", "b" * 12]
+    batched = eng.embed(texts)
+    singles = [eng.embed([t])[0] for t in texts]
+    for got, want in zip(batched, singles):
+        np.testing.assert_allclose(got, want, rtol=2e-2, atol=2e-2)
+    # unit-norm (bf16 forward → loose tolerance)
+    for v in batched:
+        assert abs(float(np.linalg.norm(v)) - 1.0) < 5e-2
+
+
+def test_abort_all_preserves_streamed_text():
+    """A failing engine must not rewrite already-streamed text: the final
+    result's text stays the concatenation of emitted deltas, and the
+    failure message rides res.error (round-1 advisor finding)."""
+    eng = InferenceEngine(EngineConfig(**TINY))
+    seen: list[tuple[str, bool, object]] = []
+    req = GenerationRequest(
+        id="x", prompt="hello", options={"temperature": 0.0, "num_predict": 8},
+        on_chunk=lambda d, done, res: seen.append((d, done, res)),
+    )
+    eng.submit(req)
+    for _ in range(3):  # admit + a couple of decode steps
+        eng.step()
+    n = eng.abort_all("boom")
+    assert n == 1
+    final = seen[-1][2]
+    assert final.done_reason == "error"
+    assert final.error == "boom"
+    streamed = "".join(d for d, _, _ in seen)
+    assert streamed == final.text
+
+
+def test_reset_device_state_recovers():
+    """reset_device_state rebuilds donated/poisoned device buffers; the
+    engine serves correctly afterwards."""
+    eng = InferenceEngine(EngineConfig(**TINY))
+    opts = {"temperature": 0.0, "num_predict": 4}
+    before = eng.generate(GenerationRequest(id="a", prompt="hi", options=opts))
+    # simulate a poisoned cache (what a mid-jit failure leaves behind)
+    eng.cache.k.delete()
+    eng.reset_device_state()
+    after = eng.generate(GenerationRequest(id="b", prompt="hi", options=opts))
+    assert before.token_ids == after.token_ids

@@ -362,3 +362,47 @@ async def test_non_retryable_failure_fails_fast():
     assert w.fail_times == 98  # exactly one attempt
     assert elapsed < 2.0       # no retry delays burned
     await teardown(bus, registry, scheduler, w)
+
+
+async def test_nack_does_not_consume_retry_ladder():
+    """VERDICT #8: a capacity NACK requeues without retryCount++ — more
+    NACKs than retry_attempts must still end in success once capacity
+    frees (the reference burned a retry per NACK; 3 races = permafail)."""
+    bus, registry, scheduler = await make_stack()
+    # 5 NACKs > retry_attempts=2, then the worker accepts
+    w = FakeWorker(bus, "w1", ["m1"], nack_times=5)
+    await w.start()
+    await bus.flush()
+
+    result = await scheduler.submit_and_wait(req(), timeout_ms=5000)
+    assert result.success
+    assert scheduler.total_failed == 0
+    await teardown(bus, registry, scheduler, w)
+
+
+async def test_layout_tiebreak_discriminates():
+    """VERDICT #8: the shard-layout tiebreak must distinguish workers.
+    (a) context fit: a request with num_ctx beyond one worker's layout
+    routes to the worker whose layout can hold it; (b) slot headroom:
+    at equal load, the layout with more batch slots wins."""
+    from gridllm_tpu.utils.types import ModelShardLayout
+
+    bus, registry, scheduler = await make_stack()
+    small = FakeWorker(bus, "small", ["m1"], layouts=[
+        ModelShardLayout(name="m1", maxSeqLen=512, maxBatchSlots=4)])
+    big = FakeWorker(bus, "big", ["m1"], layouts=[
+        ModelShardLayout(name="m1", strategy="tensor",
+                         meshAxes={"tp": 8}, maxSeqLen=8192,
+                         maxBatchSlots=16)])
+    await small.start()
+    await big.start()
+    await bus.flush()
+
+    # (a) long-context request → only `big`'s layout fits
+    r = await scheduler.submit_and_wait(
+        req(options={"num_ctx": 4096}), timeout_ms=3000)
+    assert r.success and r.workerId == "big"
+    # (b) no ctx hint, equal load → more slot headroom wins
+    r = await scheduler.submit_and_wait(req(), timeout_ms=3000)
+    assert r.success and r.workerId == "big"
+    await teardown(bus, registry, scheduler, small, big)
