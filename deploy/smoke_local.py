@@ -1,0 +1,137 @@
+#!/usr/bin/env python
+"""Process-level deployment smoke test (VERDICT.md #10).
+
+Boots the SAME service topology as deploy/docker-compose.yml — broker
+(gridllm-bus), server (gridllm-server), worker (gridllm-worker) — as three
+real OS processes wired over the RESP bus, waits for health, then runs the
+differential API-shape gate (tests/integration/differential.py) against
+the live stack. This is the compose bundle's service graph executed
+without a container runtime (none exists in the build environment; the
+Dockerfiles' ENTRYPOINTs invoke exactly these modules).
+
+Usage: python deploy/smoke_local.py   (exit 0 = stack healthy + shapes pass)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def wait_http(url: str, timeout_s: float, proc: subprocess.Popen, name: str):
+    t0 = time.time()
+    while time.time() - t0 < timeout_s:
+        if proc.poll() is not None:
+            raise SystemExit(f"{name} died (rc={proc.returncode})")
+        try:
+            with urllib.request.urlopen(url, timeout=2) as r:
+                if r.status == 200:
+                    return
+        except Exception:
+            time.sleep(0.5)
+    raise SystemExit(f"{name} not healthy after {timeout_s}s ({url})")
+
+
+def main() -> int:
+    broker_port = free_port()
+    server_port = free_port()
+    worker_port = free_port()
+    env = {
+        **os.environ,
+        "PYTHONPATH": REPO,
+        "JAX_PLATFORMS": "cpu",            # worker engine on CPU (smoke)
+        "GRIDLLM_BUS_URL": f"resp://127.0.0.1:{broker_port}",
+        "GRIDLLM_MODELS": "tiny-llama",
+        "GRIDLLM_PREFILL_BUCKETS": "16,64",
+        "PORT": str(server_port),
+        "WORKER_PORT": str(worker_port),
+        "WORKER_ID": "smoke-worker",
+        "LOG_LEVEL": "warning",
+    }
+    procs: list[tuple[str, subprocess.Popen]] = []
+
+    def spawn(name: str, *argv: str) -> subprocess.Popen:
+        p = subprocess.Popen(
+            [sys.executable, *argv], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        )
+        procs.append((name, p))
+        return p
+
+    try:
+        spawn("broker", "-m", "gridllm_tpu.bus.broker",
+              "--host", "127.0.0.1", "--port", str(broker_port))
+        time.sleep(0.5)
+        server = spawn("server", "-m", "gridllm_tpu.gateway.main")
+        worker = spawn("worker", "-m", "gridllm_tpu.worker.main")
+
+        wait_http(f"http://127.0.0.1:{server_port}/health", 60, server, "server")
+        wait_http(f"http://127.0.0.1:{worker_port}/health", 120, worker, "worker")
+        print("all services healthy", flush=True)
+
+        # worker registered and the model visible through the API
+        t0 = time.time()
+        while time.time() - t0 < 60:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{server_port}/ollama/api/tags", timeout=5
+            ) as r:
+                tags = json.load(r)
+            if any(m["name"] == "tiny-llama" for m in tags.get("models", [])):
+                break
+            time.sleep(0.5)
+        else:
+            raise SystemExit(f"model never appeared in /api/tags: {tags}")
+        print("worker registered; model visible in /api/tags", flush=True)
+
+        # one real generation through the whole stack (engine compile incl.)
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server_port}/ollama/api/generate",
+            data=json.dumps({
+                "model": "tiny-llama", "prompt": "smoke", "stream": False,
+                "options": {"num_predict": 4, "temperature": 0},
+            }).encode(), headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=180) as r:
+            body = json.load(r)
+        assert body.get("done") and body.get("eval_count") == 4, body
+        print(f"generate OK: eval_count={body['eval_count']} "
+              f"eval_duration={body['eval_duration']}ns", flush=True)
+
+        # differential shape gate against the live stack
+        rc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tests/integration/differential.py"),
+             "--endpoint", f"http://127.0.0.1:{server_port}",
+             "--model", "tiny-llama"],
+            env=env,
+        ).returncode
+        if rc != 0:
+            raise SystemExit(f"differential shape gate failed (rc={rc})")
+        print("differential shape gate: PASS", flush=True)
+        return 0
+    finally:
+        for name, p in procs:
+            if p.poll() is None:
+                p.terminate()
+        deadline = time.time() + 10
+        for name, p in procs:
+            try:
+                p.wait(timeout=max(0.1, deadline - time.time()))
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -89,6 +89,7 @@ class GenerationRequest:
     prompt_ids: list[int] | None = None  # pre-tokenized (Ollama `context` path)
     options: dict[str, Any] = dataclasses.field(default_factory=dict)
     raw: bool = False                    # skip BOS when prompt_ids is None
+    images: list[str] | None = None      # base64 images (vision models only)
     # called from the engine loop: (text_delta, done, result|None)
     on_chunk: Callable[[str, bool, "GenerationResult | None"], None] | None = None
 
@@ -156,7 +157,16 @@ class InferenceEngine:
 
     def __init__(self, config: EngineConfig):
         self.config = config
-        self.cfg = get_config(config.model)
+        try:
+            self.cfg = get_config(config.model)
+        except KeyError:
+            if not config.checkpoint_path:
+                raise
+            # unregistered name + checkpoint dir → read the HF config.json
+            # (serve any local HF-layout checkpoint, no registry edit needed)
+            from gridllm_tpu.models.configs import config_from_hf_dir
+
+            self.cfg = config_from_hf_dir(config.model, config.checkpoint_path)
         self.mod = _model_module(self.cfg)
         self.embedding_only = self.cfg.family == "bert_embed"
         self.tokenizer: Tokenizer = get_tokenizer(
@@ -272,7 +282,8 @@ class InferenceEngine:
         @partial(jax.jit, donate_argnums=(2, 3))
         def prefill_fn(params, tokens, cache, counts, length, slot, table_row, sp):
             logits, cache = self.mod.prefill(
-                params, mc, tokens, length, cache, slot, table_row, attn=attn
+                params, mc, tokens, length, cache, slot, table_row, attn=attn,
+                mesh=self.mesh,
             )
             # count prompt tokens for repeat_penalty (valid positions only)
             t = jnp.arange(tokens.shape[0])
@@ -328,6 +339,13 @@ class InferenceEngine:
         if self.embedding_only:
             self._fail(req, f"{self.cfg.name} is an embedding model; "
                             "it does not support generation", retryable=False)
+            return
+        if req.images and not self.cfg.vision:
+            # images travel the full protocol (API-surface parity with the
+            # reference's Ollama passthrough); capability is per-model.
+            # Loud reject > silently ignoring pixels the client sent.
+            self._fail(req, f"{self.cfg.name} does not support image inputs",
+                       retryable=False)
             return
         with self._lock:
             if len(self._pending) >= self.config.max_queue:

@@ -92,12 +92,12 @@ def build_routes(registry: WorkerRegistry, scheduler: JobScheduler,
         req = InferenceRequest(
             id=str(uuid.uuid4()), model=model, prompt=prompt, stream=stream,
             options=body.get("options") or {},
+            images=body.get("images"),
             timeout=DEFAULT_TIMEOUT_MS,
             metadata={
                 "ollamaEndpoint": "/api/generate",
                 "requestType": "inference",
                 "suffix": body.get("suffix"),
-                "images": body.get("images"),
                 "think": body.get("think"),
                 "format": body.get("format"),
                 "system": body.get("system"),

@@ -41,6 +41,9 @@ class ModelConfig:
     qk_norm: bool = False            # qwen3: per-head RMSNorm on q/k pre-rope
     # embeddings (bert_embed family)
     pooling: str = "mean"            # "mean" | "cls"
+    # multimodal: accepts image inputs (no vision family yet — the flag is
+    # the per-model capability gate the engine rejects on)
+    vision: bool = False
     # kernel dispatch: None = env/auto policy (ops.attention); the engine
     # sets False on its config copy when serving under a device mesh
     use_pallas: bool | None = None
@@ -230,3 +233,73 @@ def get_config(name: str) -> ModelConfig:
     if base in REGISTRY:
         return REGISTRY[base]
     raise KeyError(f"unknown model: {name!r} (known: {sorted(REGISTRY)})")
+
+
+_HF_FAMILY = {
+    "llama": "llama",
+    "qwen2": "qwen2",
+    "qwen3": "qwen3",
+    "mixtral": "mixtral",
+    "bert": "bert_embed",
+}
+
+
+def config_from_hf_dir(name: str, path: str) -> ModelConfig:
+    """Build a ModelConfig from a local HF checkpoint's config.json, so any
+    HF-layout directory can be served without a registry entry (the engine
+    falls back to this when `model` is not a registered name but a
+    checkpoint_path is set). Inverse of `hf_config()` for the supported
+    families."""
+    import json
+    import os
+
+    with open(os.path.join(path, "config.json")) as f:
+        hf = json.load(f)
+    mt = hf.get("model_type", "llama")
+    if mt not in _HF_FAMILY:
+        raise ValueError(
+            f"unsupported HF model_type {mt!r} in {path} "
+            f"(supported: {sorted(_HF_FAMILY)})"
+        )
+    family = _HF_FAMILY[mt]
+    if family == "bert_embed":
+        return ModelConfig(
+            name=name, family=family,
+            vocab_size=hf["vocab_size"],
+            hidden_size=hf["hidden_size"],
+            intermediate_size=hf["intermediate_size"],
+            num_layers=hf["num_hidden_layers"],
+            num_heads=hf["num_attention_heads"],
+            num_kv_heads=hf["num_attention_heads"],
+            rms_eps=hf.get("layer_norm_eps", 1e-12),
+            max_seq_len=hf.get("max_position_embeddings", 512),
+        )
+    scaling = None
+    rs = hf.get("rope_scaling") or None
+    if rs and rs.get("rope_type", rs.get("type")) == "llama3":
+        scaling = RopeScaling(
+            factor=rs["factor"],
+            low_freq_factor=rs["low_freq_factor"],
+            high_freq_factor=rs["high_freq_factor"],
+            original_max_position_embeddings=rs["original_max_position_embeddings"],
+        )
+    return ModelConfig(
+        name=name, family=family,
+        vocab_size=hf["vocab_size"],
+        hidden_size=hf["hidden_size"],
+        intermediate_size=hf["intermediate_size"],
+        num_layers=hf["num_hidden_layers"],
+        num_heads=hf["num_attention_heads"],
+        num_kv_heads=hf.get("num_key_value_heads", hf["num_attention_heads"]),
+        head_dim=hf.get("head_dim"),
+        rope_theta=hf.get("rope_theta", 10_000.0),
+        rope_scaling=scaling,
+        rms_eps=hf.get("rms_norm_eps", 1e-5),
+        tie_embeddings=hf.get("tie_word_embeddings", False),
+        max_seq_len=hf.get("max_position_embeddings", 8192),
+        num_experts=hf.get("num_local_experts", 0),
+        experts_per_token=hf.get("num_experts_per_tok", 2),
+        sliding_window=hf.get("sliding_window") or 0,
+        attn_bias=family == "qwen2" or bool(hf.get("attention_bias")),
+        qk_norm=family == "qwen3",
+    )

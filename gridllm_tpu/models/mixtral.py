@@ -36,18 +36,32 @@ from gridllm_tpu.ops.kvcache import PagedKVCache
 Params = dict[str, Any]
 
 
-def _moe_mlp(cfg: ModelConfig, lp: Params, x: jnp.ndarray) -> jnp.ndarray:
-    """Sparse-MoE FFN: x [..., E] → [..., E].
+# tokens-at-or-above this (per MoE call) take the sorted ragged-dispatch
+# path during single-device prefill; below it (decode steps, tiny batches)
+# the dense all-experts form wins on dispatch overhead
+_RAGGED_MIN_TOKENS = 16
 
-    lp carries router [E, X] and stacked experts we_gate/we_up [X, E, F],
-    we_down [X, F, E] (the per-layer slice of the [L, X, ...] leaves).
-    """
-    p = llama._precision(x)
+
+def _route(cfg: ModelConfig, lp: Params, x: jnp.ndarray):
+    """Router math (HF MixtralSparseMoeBlock order): softmax over ALL
+    expert logits in fp32 → top-k → renormalize. Returns (top_w, top_i)."""
     probs = jax.nn.softmax(
         jnp.dot(x.astype(jnp.float32), lp["router"].astype(jnp.float32)), axis=-1
     )  # [..., X] fp32 — router math stays fp32 (tiny; routing flips are costly)
     top_w, top_i = jax.lax.top_k(probs, cfg.experts_per_token)
     top_w = top_w / top_w.sum(axis=-1, keepdims=True)
+    return top_w, top_i
+
+
+def _moe_mlp_dense(cfg: ModelConfig, lp: Params, x: jnp.ndarray) -> jnp.ndarray:
+    """Dense form: every expert computes every token, non-selected pairs
+    zero-weighted. One big batched einsum over the stacked expert axis —
+    MXU-friendly, EP-shardable (each "ep" shard computes its X/ep experts
+    for all tokens; the combine is the all-reduce XLA inserts). The right
+    trade at decode batch sizes, where expert matmuls are bandwidth-bound
+    on the weights either way."""
+    p = llama._precision(x)
+    top_w, top_i = _route(cfg, lp, x)
     one_hot = jax.nn.one_hot(top_i, cfg.num_experts, dtype=jnp.float32)
     gates = jnp.einsum("...k,...kx->...x", top_w, one_hot).astype(x.dtype)
 
@@ -55,6 +69,69 @@ def _moe_mlp(cfg: ModelConfig, lp: Params, x: jnp.ndarray) -> jnp.ndarray:
     u = jnp.einsum("...e,xef->...xf", x, lp["we_up"], precision=p)
     y = jax.nn.silu(g) * u * gates[..., None]
     return jnp.einsum("...xf,xfe->...e", y, lp["we_down"], precision=p)
+
+
+def _moe_mlp_ragged(cfg: ModelConfig, lp: Params, x: jnp.ndarray) -> jnp.ndarray:
+    """Sorted ragged dispatch (VERDICT #7): tokens sorted by expert, then
+    ONE grouped matmul per projection via jax.lax.ragged_dot — T·top_k row
+    FLOPs instead of the dense form's T·X (4× for 8×7b prefill), exact
+    (no capacity factor, no token dropping), static shapes throughout
+    (argsort/bincount are fixed-size; raggedness lives in group_sizes
+    values, not array shapes)."""
+    k, X = cfg.experts_per_token, cfg.num_experts
+    lead = x.shape[:-1]
+    e = x.shape[-1]
+    xf = x.reshape(-1, e)                       # [T, E]
+    t = xf.shape[0]
+    top_w, top_i = _route(cfg, lp, xf)          # [T, k]
+
+    flat_expert = top_i.reshape(-1)             # [T*k]
+    token_idx = jnp.repeat(jnp.arange(t), k)    # [T*k]
+    order = jnp.argsort(flat_expert)            # stable → token order kept
+    rows = token_idx[order]                     # [T*k] source token per row
+    xs = xf[rows]                               # [T*k, E] sorted operand
+    group_sizes = jnp.bincount(flat_expert, length=X).astype(jnp.int32)
+
+    g = jax.lax.ragged_dot(xs, lp["we_gate"], group_sizes)
+    u = jax.lax.ragged_dot(xs, lp["we_up"], group_sizes)
+    y = (jax.nn.silu(g) * u).astype(x.dtype)
+    down = jax.lax.ragged_dot(y, lp["we_down"], group_sizes)  # [T*k, E]
+
+    w = top_w.reshape(-1)[order].astype(x.dtype)              # [T*k]
+    out = jnp.zeros((t, e), x.dtype).at[rows].add(down * w[:, None])
+    return out.reshape(*lead, e)
+
+
+def _moe_mlp(cfg: ModelConfig, lp: Params, x: jnp.ndarray) -> jnp.ndarray:
+    """Sparse-MoE FFN: x [..., E] → [..., E].
+
+    lp carries router [E, X] and stacked experts we_gate/we_up [X, E, F],
+    we_down [X, F, E] (the per-layer slice of the [L, X, ...] leaves).
+
+    Form selection (trace-time, static): ragged dispatch for prefill-sized
+    token counts on a single TPU device; dense all-experts everywhere else —
+    decode-sized batches (dispatch overhead dominates), meshed engines
+    (ragged_dot has no GSPMD partitioning rule; under "ep" the dense einsum
+    shards cleanly), and CPU (XLA's CPU ragged_dot lowering is a serial
+    group loop, measured ~25% SLOWER than dense even at X=8 — the grouped
+    matmul win is a TPU/Mosaic property). Env GRIDLLM_MOE_RAGGED=1/0
+    overrides the backend gate (tests force the ragged path on CPU).
+    """
+    import os
+
+    n_tokens = 1
+    for s in x.shape[:-1]:
+        n_tokens *= s
+    if cfg.use_pallas is False or n_tokens < _RAGGED_MIN_TOKENS:
+        # cfg.use_pallas False ⇔ engine runs under a mesh (engine.py sets
+        # it on its cfg copy) — keep the EP-shardable dense form there
+        return _moe_mlp_dense(cfg, lp, x)
+    raw = os.environ.get("GRIDLLM_MOE_RAGGED", "auto").lower()
+    use_ragged = (
+        jax.default_backend() == "tpu" if raw == "auto"
+        else raw in ("1", "on", "true")
+    )
+    return _moe_mlp_ragged(cfg, lp, x) if use_ragged else _moe_mlp_dense(cfg, lp, x)
 
 
 def init_params(cfg: ModelConfig, key: jax.Array, dtype=jnp.bfloat16) -> Params:
@@ -104,10 +181,11 @@ def prefill(
     slot: jnp.ndarray,
     table_row: jnp.ndarray,
     attn: llama.AttnFn | None = None,
+    mesh=None,
 ) -> tuple[jnp.ndarray, PagedKVCache]:
     return llama.prefill(
         params, cfg, tokens, length, cache, slot, table_row,
-        mlp=_mlp_for(cfg), attn=attn,
+        mlp=_mlp_for(cfg), attn=attn, mesh=mesh,
     )
 
 

@@ -152,6 +152,10 @@ class InferenceRequest(_Model):
     messages: list[dict[str, Any]] | None = None
     tools: list[dict[str, Any]] | None = None
     format: str | dict[str, Any] | None = None
+    # multimodal: base64 images carried to the worker (reference:
+    # OllamaService.ts:197-226 / openai.ts:205-243 passthrough). Served
+    # models without vision reject per-request at the engine, loudly.
+    images: list[str] | None = None
     # embedding path
     input: str | list[str] | None = None
     truncate: bool | None = None

@@ -10,8 +10,10 @@ to `role: content` lines AND routed them down the generate path
 - Otherwise (byte tokenizer / templateless): a llama3-style plain-text
   header framing that keeps roles distinguishable.
 
-Multimodal `images` are not yet supported and raise (loud > silently
-dropped — the reference dropped them on the Ollama chat path too).
+Multimodal `images` are collected by collect_images() and travel to the
+engine on GenerationRequest.images — per-model capability is the ENGINE's
+call (a non-vision model rejects loudly; the reference just forwarded them
+to Ollama, OllamaService.ts:197-226).
 """
 
 from __future__ import annotations
@@ -21,10 +23,16 @@ from typing import Any
 from gridllm_tpu.engine.tokenizer import Tokenizer
 
 
+def collect_images(req) -> list[str]:
+    """All base64 images on a request: top-level (generate path) plus
+    per-message (chat path, incl. OpenAI content-array conversions)."""
+    images = list(getattr(req, "images", None) or [])
+    for m in getattr(req, "messages", None) or []:
+        images.extend(m.get("images") or [])
+    return images
+
+
 def render_chat(messages: list[dict[str, Any]], tokenizer: Tokenizer) -> str:
-    for m in messages:
-        if m.get("images"):
-            raise ValueError("multimodal chat (images) not supported yet")
     inner = getattr(tokenizer, "_tok", None)
     if inner is not None and getattr(inner, "chat_template", None):
         return inner.apply_chat_template(

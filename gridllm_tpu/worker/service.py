@@ -37,7 +37,7 @@ from gridllm_tpu.utils.types import (
     iso_now,
 )
 from gridllm_tpu.worker.capabilities import gather_capabilities
-from gridllm_tpu.worker.chat import render_chat
+from gridllm_tpu.worker.chat import collect_images, render_chat
 
 log = get_logger("worker")
 
@@ -350,6 +350,7 @@ class WorkerService(EventEmitter):
         gen = GenerationRequest(
             id=req.id, prompt=prompt, options=opts,
             raw=bool(opts.get("raw")), on_chunk=on_chunk,
+            images=collect_images(req) or None,
         )
         if context:
             gen.prompt_ids = list(context) + engine.tokenizer.encode(

@@ -178,3 +178,32 @@ async def test_job_cancellation_mid_stream(tiny_engine):
         assert scheduler.get_active_jobs() == []
     finally:
         await _teardown(registry, scheduler, worker, client, bus)
+
+
+async def test_images_travel_to_engine_and_reject_loudly(tiny_engine):
+    """VERDICT missing #5: images must travel the full protocol (gateway →
+    scheduler → worker → engine). No vision family exists yet, so a text
+    model must reject with a structured per-model error — not drop the
+    pixels silently, not crash the worker — on both generate and chat."""
+    bus, registry, scheduler, worker, client = await _stack(tiny_engine)
+    try:
+        resp = await client.post("/ollama/api/generate", json={
+            "model": MODEL, "prompt": "what is in this picture?",
+            "stream": False, "images": ["aGVsbG8="]})
+        text = json.dumps(await resp.json())
+        assert "does not support image inputs" in text, text
+
+        resp = await client.post("/ollama/api/chat", json={
+            "model": MODEL, "stream": False, "messages": [
+                {"role": "user", "content": "describe",
+                 "images": ["aGVsbG8="]}]})
+        text = json.dumps(await resp.json())
+        assert "does not support image inputs" in text, text
+
+        # worker survives: a plain request still serves
+        resp = await client.post("/ollama/api/generate", json={
+            "model": MODEL, "prompt": "hello", "stream": False,
+            "options": {"num_predict": 4}})
+        assert resp.status == 200 and (await resp.json())["done"]
+    finally:
+        await _teardown(registry, scheduler, worker, client, bus)

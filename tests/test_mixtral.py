@@ -147,3 +147,44 @@ def test_engine_generates_with_mixtral():
     ))
     assert res.done_reason in ("length", "stop")
     assert res.eval_count > 0
+
+
+def test_ragged_dispatch_matches_dense():
+    """VERDICT #7: the sorted ragged-dispatch MoE form (prefill) must be
+    numerically equivalent to the dense all-experts form — exact routing,
+    no capacity drops — across token counts around the dispatch threshold."""
+    import numpy as np
+
+    from gridllm_tpu.models.mixtral import (
+        _moe_mlp_dense,
+        _moe_mlp_ragged,
+        init_params,
+    )
+
+    cfg = get_config("tiny-mixtral")
+    params = init_params(cfg, jax.random.PRNGKey(3), dtype=jnp.float32)
+    lp = jax.tree.map(lambda a: a[0], params["layers"])  # layer 0 slice
+    for t in (16, 33, 128):
+        x = jax.random.normal(jax.random.PRNGKey(t), (1, t, cfg.hidden_size))
+        dense = _moe_mlp_dense(cfg, lp, x)
+        ragged = _moe_mlp_ragged(cfg, lp, x)
+        np.testing.assert_allclose(
+            np.asarray(ragged), np.asarray(dense), rtol=2e-5, atol=2e-5,
+        )
+
+
+def test_ragged_dispatch_through_full_model(monkeypatch):
+    """Force the ragged MoE form on CPU and check the full prefill+decode
+    engine path matches the dense form token-for-token (greedy)."""
+    from gridllm_tpu.engine import EngineConfig, GenerationRequest, InferenceEngine
+
+    opts = {"temperature": 0.0, "num_predict": 6}
+    kw = dict(model="tiny-mixtral", max_slots=2, page_size=8, num_pages=32,
+              max_pages_per_slot=8, prefill_buckets=(32,), seed=0)
+    monkeypatch.setenv("GRIDLLM_MOE_RAGGED", "1")
+    ragged = InferenceEngine(EngineConfig(**kw)).generate(
+        GenerationRequest(id="r", prompt="hello world test", options=opts))
+    monkeypatch.setenv("GRIDLLM_MOE_RAGGED", "0")
+    dense = InferenceEngine(EngineConfig(**kw)).generate(
+        GenerationRequest(id="d", prompt="hello world test", options=opts))
+    assert ragged.token_ids == dense.token_ids

@@ -207,3 +207,58 @@ def test_sp_sharded_prefill_decode_match_single_device():
     got = run(shard_params(params, mesh), shard_cache(fresh_cache(), mesh),
               attn=fpartial(ring_attention, mesh=mesh))
     assert got == want
+
+
+def test_sp_prefill_pins_residual_stream_to_sp():
+    """VERDICT #9: the sp memory claim must be a checked property, not a
+    comment. Structurally assert the prefill graph carries T-axis sharding
+    constraints P(None, 'sp', None) on the residual stream (embed + per
+    layer), so prefill activations are O(T/sp) by annotation, not GSPMD
+    propagation luck. Also check numerics are unchanged vs the jnp oracle."""
+    from functools import partial
+
+    from gridllm_tpu.ops.ring_attention import ring_attention
+
+    mesh = build_mesh(MeshConfig(dp=1, tp=1, sp=8))
+    params = llama.init_params(CFG, jax.random.PRNGKey(0), dtype=jnp.float32)
+    cache = PagedKVCache.create(CFG.num_layers, 16, 8, CFG.num_kv_heads,
+                                CFG.head_dim_, 2, 8, dtype=jnp.float32)
+    alloc = PageAllocator(16, 8, 8)
+    alloc.alloc(0, 64)
+    row = jnp.asarray(alloc.table_row(0), jnp.int32)
+    tokens = jnp.asarray(np.arange(64) % CFG.vocab_size, jnp.int32)
+    attn = partial(ring_attention, mesh=mesh)
+
+    def run(p, tok, c):
+        return llama.prefill(p, CFG, tok, jnp.int32(64), c, jnp.int32(0),
+                             row, attn=attn, mesh=mesh)
+
+    def count_sp_constraints(jaxpr) -> int:
+        n = 0
+        for eqn in jaxpr.eqns:
+            if eqn.primitive.name == "sharding_constraint":
+                sh = eqn.params.get("sharding")
+                spec = getattr(sh, "spec", None)
+                if spec is not None and len(spec) == 3 and spec[1] == "sp":
+                    n += 1
+            for sub in jax.core.jaxprs_in_params(eqn.params):
+                n += count_sp_constraints(sub)
+        return n
+
+    jaxpr = jax.make_jaxpr(run)(params, tokens, cache)
+    n = count_sp_constraints(jaxpr.jaxpr)
+    # embed constraint + 2 per scanned layer body (post-attn, post-mlp)
+    assert n >= 3, f"expected >=3 sp sharding constraints, found {n}"
+
+    # numerics: sharded prefill == unsharded oracle
+    sharded = shard_params(params, mesh)
+    scache = shard_cache(cache, mesh)
+    logits_sp, cache_sp = jax.jit(run)(sharded, tokens, scache)
+    logits_ref, cache_ref = jax.jit(
+        lambda p, tok, c: llama.prefill(p, CFG, tok, jnp.int32(64), c,
+                                        jnp.int32(0), row)
+    )(params, tokens, cache)
+    np.testing.assert_allclose(np.asarray(logits_sp), np.asarray(logits_ref),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(cache_sp.k), np.asarray(cache_ref.k),
+                               rtol=1e-4, atol=1e-4)

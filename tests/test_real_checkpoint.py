@@ -1,0 +1,161 @@
+"""Real-checkpoint end-to-end (VERDICT.md #3).
+
+Builds a GENUINE on-disk HF checkpoint locally (zero egress): a trained
+BPE tokenizer (tokenizer.json via the `tokenizers` library) and a
+`LlamaForCausalLM` saved with safe_serialization — the same file layout a
+downloaded HF Llama has. Then serves it through the FULL stack (engine
+loader + HF tokenizer + gateway /ollama/api/generate) and compares greedy
+output token-for-token against `transformers` `model.generate`.
+
+This replaces what the reference delegated to Ollama
+(client/src/services/OllamaService.ts:97-184) with a checked contract:
+same weights on disk → same tokens out.
+"""
+
+import asyncio
+import json
+
+import numpy as np
+import pytest
+
+CORPUS = (
+    "the quick brown fox jumps over the lazy dog. "
+    "pack my box with five dozen liquor jugs. "
+    "how vexingly quick daft zebras jump! "
+    "sphinx of black quartz judge my vow. "
+) * 8
+
+
+@pytest.fixture(scope="module")
+def hf_checkpoint(tmp_path_factory):
+    """Tiny but REAL HF checkpoint dir: config.json + model.safetensors +
+    tokenizer.json/tokenizer_config.json."""
+    import torch
+    from tokenizers import Tokenizer, models, pre_tokenizers, trainers
+    from transformers import LlamaConfig, LlamaForCausalLM, PreTrainedTokenizerFast
+
+    path = tmp_path_factory.mktemp("hf-tiny-llama")
+
+    tok = Tokenizer(models.BPE(unk_token=None))
+    tok.pre_tokenizer = pre_tokenizers.ByteLevel(add_prefix_space=False)
+    tok.train_from_iterator(
+        [CORPUS],
+        trainers.BpeTrainer(vocab_size=384, special_tokens=["<s>", "</s>"]),
+    )
+    hf_tok = PreTrainedTokenizerFast(
+        tokenizer_object=tok, bos_token="<s>", eos_token="</s>"
+    )
+    hf_tok.save_pretrained(path)
+
+    torch.manual_seed(0)
+    config = LlamaConfig(
+        vocab_size=len(hf_tok),
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        rope_theta=10_000.0,
+        max_position_embeddings=256,
+        tie_word_embeddings=False,
+        torch_dtype="float32",
+    )
+    model = LlamaForCausalLM(config)
+    model.save_pretrained(path, safe_serialization=True)
+    return path, model, hf_tok
+
+
+def _torch_greedy(model, hf_tok, prompt: str, n: int) -> list[int]:
+    import torch
+
+    ids = [hf_tok.bos_token_id] + hf_tok.encode(prompt, add_special_tokens=False)
+    with torch.no_grad():
+        out = model.generate(
+            input_ids=torch.tensor([ids]),
+            max_new_tokens=n, do_sample=False,
+            eos_token_id=None,  # run the full n tokens
+            pad_token_id=hf_tok.eos_token_id,
+        )
+    return out[0][len(ids):].tolist()
+
+
+def test_engine_matches_transformers_generate(hf_checkpoint):
+    """Loader + HF tokenizer + engine greedy == transformers greedy."""
+    from gridllm_tpu.engine import EngineConfig, GenerationRequest, InferenceEngine
+
+    path, model, hf_tok = hf_checkpoint
+    eng = InferenceEngine(EngineConfig(
+        model="local-tiny-llama",          # NOT in the registry → config.json
+        checkpoint_path=str(path),
+        tokenizer=str(path),
+        dtype="float32",
+        max_slots=2, page_size=8, num_pages=64, max_pages_per_slot=16,
+        prefill_buckets=(16, 32),
+    ))
+    prompt = "the quick brown fox"
+    res = eng.generate(GenerationRequest(
+        id="g", prompt=prompt,
+        options={"temperature": 0.0, "num_predict": 12},
+    ))
+    want = _torch_greedy(model, hf_tok, prompt, 12)
+    assert res.token_ids == want[: len(res.token_ids)]
+    assert len(res.token_ids) == 12  # random-init should not emit EOS here
+    # detokenized text round-trips through the same tokenizer files
+    assert res.text == hf_tok.decode(want, skip_special_tokens=True)
+
+
+async def _serve_and_generate(path, prompt: str, n: int) -> dict:
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from gridllm_tpu.bus.memory import InMemoryBus
+    from gridllm_tpu.engine import EngineConfig, InferenceEngine
+    from gridllm_tpu.gateway.app import create_app
+    from gridllm_tpu.scheduler import JobScheduler, WorkerRegistry
+    from gridllm_tpu.utils.config import Config, WorkerConfig
+    from gridllm_tpu.worker.service import WorkerService
+
+    engine = InferenceEngine(EngineConfig(
+        model="local-tiny-llama", checkpoint_path=str(path),
+        tokenizer=str(path), dtype="float32",
+        max_slots=2, page_size=8, num_pages=64, max_pages_per_slot=16,
+        prefill_buckets=(16, 32),
+    ))
+    bus = InMemoryBus()
+    await bus.connect()
+    cfg = Config()
+    registry = WorkerRegistry(bus, cfg.scheduler)
+    scheduler = JobScheduler(bus, registry, cfg.scheduler)
+    await registry.initialize()
+    await scheduler.initialize()
+    app = create_app(bus, registry, scheduler, cfg)
+    worker = WorkerService(bus, {"local-tiny-llama": engine}, WorkerConfig())
+    await worker.start()
+    await asyncio.sleep(0.1)
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    resp = await client.post("/ollama/api/generate", json={
+        "model": "local-tiny-llama", "prompt": prompt, "stream": False,
+        "options": {"temperature": 0.0, "num_predict": n},
+    })
+    assert resp.status == 200, await resp.text()
+    body = await resp.json()
+    await client.close()
+    await worker.stop()
+    await scheduler.shutdown()
+    await registry.shutdown()
+    await bus.disconnect()
+    return body
+
+
+def test_api_generate_serves_real_checkpoint(hf_checkpoint):
+    """BASELINE configs #1-#2 shape: /ollama/api/generate on real weights,
+    response text equal to the transformers continuation."""
+    path, model, hf_tok = hf_checkpoint
+    prompt = "pack my box"
+    body = asyncio.run(_serve_and_generate(path, prompt, 10))
+    want = _torch_greedy(model, hf_tok, prompt, 10)
+    assert body["done"] and body["done_reason"] == "length"
+    assert body["response"] == hf_tok.decode(want, skip_special_tokens=True)
+    assert body["eval_count"] == 10
+    assert body["prompt_eval_count"] == len(
+        hf_tok.encode(prompt, add_special_tokens=False)) + 1
