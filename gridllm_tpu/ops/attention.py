@@ -18,13 +18,16 @@ materialized repeat).
 
 from __future__ import annotations
 
-import functools
-import os
-
 import jax
 import jax.numpy as jnp
 
-from gridllm_tpu.ops.kvcache import gather_kv
+from gridllm_tpu.ops.kvcache import _env_mode, _pallas_mode, gather_kv
+
+__all__ = [
+    "attention_prefill", "paged_attention_decode", "attention_prefix_chunk",
+    "attention_prefill_ref", "paged_attention_decode_ref",
+    "_env_mode", "_pallas_mode",  # re-export: policy lives in ops/kvcache.py
+]
 
 _NEG_INF = -1e30
 
@@ -34,32 +37,6 @@ _NEG_INF = -1e30
 # time (~16 MB/core), so dispatch falls back to the jnp path. Chunked HBM
 # streaming for very long prefill buckets is future kernel work.
 _FLASH_KV_VMEM_CAP = 8 * 1024 * 1024
-
-
-@functools.cache
-def _env_mode() -> tuple[bool, bool]:
-    """(use_kernels, interpret) from the environment, resolved once."""
-    raw = os.environ.get("GRIDLLM_PALLAS", "auto").lower()
-    if raw in ("0", "off", "false"):
-        return False, False
-    if raw in ("1", "on", "true"):
-        return True, False
-    if raw == "interpret":
-        return True, True
-    return jax.default_backend() == "tpu", False
-
-
-def _pallas_mode(use_pallas: bool | None) -> tuple[bool, bool]:
-    """`use_pallas` is the per-call override (threaded from
-    ModelConfig.use_pallas by the model code, e.g. the engine disables
-    kernels for a mesh-sharded engine without affecting single-device
-    engines in the same process — pallas_call has no GSPMD partitioning
-    rule, so inside a sharded jit the kernels would force replication);
-    None defers to the env policy."""
-    use, interpret = _env_mode()
-    if use_pallas is not None:
-        use = use_pallas
-    return use, interpret
 
 
 def attention_prefill(
@@ -96,23 +73,38 @@ def paged_attention_decode(
     page_table: jnp.ndarray,
     lengths: jnp.ndarray,
     page_size: int,
+    k_cur: jnp.ndarray | None = None,
+    v_cur: jnp.ndarray | None = None,
+    layer: jnp.ndarray | None = None,
     use_pallas: bool | None = None,
 ) -> jnp.ndarray:
     """Paged decode attention (see paged_attention_decode_ref for the
-    contract). Routes to the page-streaming kernel when enabled. Mosaic
-    requires 128-lane-aligned page slices, so head_dim must be a multiple
-    of 128 on real TPU (d=64 models fall back to the jnp gather path;
-    packing two heads per lane tile is future kernel work)."""
+    contract). With k_cur/v_cur ([S, KVH, D]), `lengths` counts the
+    cached PREFIX only and the current token's K/V are merged in-register
+    (one extra online-softmax step) — the engine defers all pool writes to
+    one all-layer kernel after the layer scan, so the pool lags one token
+    during decode. Pools may be the FULL [L, P, ps, KVH, D] stack with
+    `layer` selecting the layer to read (pass from inside a layer scan so
+    no per-layer pool slice is materialized). Routes to the page-streaming
+    kernel when enabled. Mosaic requires 128-lane-aligned page slices, so
+    head_dim must be a multiple of 128 on real TPU (d=64 models fall back
+    to the jnp gather path; packing two heads per lane tile is future
+    kernel work)."""
     use, interpret = _pallas_mode(use_pallas)
     if use and (interpret or q.shape[-1] % 128 == 0):
         from gridllm_tpu.ops import pallas_kernels
 
         return pallas_kernels.paged_decode(
             q, k_pages, v_pages, page_table, lengths, page_size,
-            interpret=interpret,
+            k_cur=k_cur, v_cur=v_cur, layer=layer, interpret=interpret,
         )
+    if k_pages.ndim == 5:  # fallback: materialize the layer slice
+        li = jnp.int32(0) if layer is None else layer
+        k_pages = jax.lax.dynamic_index_in_dim(k_pages, li, keepdims=False)
+        v_pages = jax.lax.dynamic_index_in_dim(v_pages, li, keepdims=False)
     return paged_attention_decode_ref(
-        q, k_pages, v_pages, page_table, lengths, page_size
+        q, k_pages, v_pages, page_table, lengths, page_size,
+        k_cur=k_cur, v_cur=v_cur,
     )
 
 
@@ -124,16 +116,22 @@ def attention_prefix_chunk(
     start: jnp.ndarray,
     total_len: jnp.ndarray,
     page_size: int,
+    k_cur: jnp.ndarray | None = None,
+    v_cur: jnp.ndarray | None = None,
+    layer: jnp.ndarray | None = None,
     use_pallas: bool | None = None,
 ) -> jnp.ndarray:
     """Chunked-prefill attention: one chunk of queries against the slot's
     FULL cached context (prefix + this chunk), read from the page pool.
 
     q: [1, T, H, D] — chunk queries at absolute positions start + arange(T);
-    k_pages/v_pages: [P, page_size, KVH, D] one layer's pool, with this
-    chunk's K/V already written; table_row: [max_pages] the slot's pages;
-    start: scalar absolute position of q[0]; total_len: scalar = start +
-    valid tokens in this chunk. Returns [1, T, H, D].
+    k_pages/v_pages: [P, page_size, KVH, D] one layer's pool; table_row:
+    [max_pages] the slot's pages; start: scalar absolute position of q[0];
+    total_len: scalar = start + valid tokens in this chunk. Without
+    k_cur/v_cur the chunk's K/V must already be in the pool; with them
+    ([T, KVH, D], pool writes deferred to after the layer scan) the chunk
+    rows are overlaid onto the gathered context at positions start+i.
+    Returns [1, T, H, D].
 
     This is what `attention_prefill_ref`'s docstring named as missing in
     round 1 ("chunked prefill against an existing cached prefix") — the
@@ -144,11 +142,33 @@ def attention_prefix_chunk(
     """
     del use_pallas  # no kernel variant yet — jnp path is mesh/GSPMD-safe
     _, t, h, d = q.shape
-    kvh = k_pages.shape[2]
+    kvh = k_pages.shape[-2]
     g = h // kvh
     scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32))
 
-    ks, vs = gather_kv(k_pages, v_pages, table_row, page_size)  # [N, KVH, D]
+    if k_pages.ndim == 5:
+        # full [L, P, ps, KVH, D] pool + layer index: gather exactly the
+        # slot's pages from the selected layer (a combined advanced index —
+        # never a whole-layer pool slice)
+        li = jnp.int32(0) if layer is None else layer
+        rows = jnp.maximum(table_row, 0)
+        n = table_row.shape[0] * page_size
+        ks = k_pages[li, rows].reshape(n, kvh, d)
+        vs = v_pages[li, rows].reshape(n, kvh, d)
+    else:
+        ks, vs = gather_kv(k_pages, v_pages, table_row, page_size)  # [N, KVH, D]
+    if k_cur is not None:
+        # overlay the fresh chunk at absolute positions [start, start+T):
+        # pad by T rows so the dynamic_update_slice stays in bounds at the
+        # capacity edge (start ≤ N; padded rows are sliced off again)
+        pad = jnp.zeros((t, kvh, d), ks.dtype)
+        n = ks.shape[0]
+        ks = jax.lax.dynamic_update_slice(
+            jnp.concatenate([ks, pad]), k_cur.astype(ks.dtype), (start, 0, 0)
+        )[:n]
+        vs = jax.lax.dynamic_update_slice(
+            jnp.concatenate([vs, pad]), v_cur.astype(vs.dtype), (start, 0, 0)
+        )[:n]
     qf = q.astype(jnp.float32).reshape(t, kvh, g, d)
     q_pos = start + jnp.arange(t)              # [T] absolute
     k_pos = jnp.arange(ks.shape[0])            # [N] absolute
@@ -216,13 +236,18 @@ def paged_attention_decode_ref(
     page_table: jnp.ndarray,
     lengths: jnp.ndarray,
     page_size: int,
+    k_cur: jnp.ndarray | None = None,
+    v_cur: jnp.ndarray | None = None,
 ) -> jnp.ndarray:
     """One-token-per-slot decode attention against the paged cache.
 
     q: [S, H, D] (the single new token per slot, post-rope);
     k_pages/v_pages: [P, page_size, KVH, D] (one layer's pool);
-    page_table: [S, max_pages]; lengths: [S] valid tokens per slot
-    *including* the current token (already written to the cache).
+    page_table: [S, max_pages]. Without k_cur/v_cur, lengths: [S] valid
+    tokens per slot *including* the current token (already written to the
+    cache). With k_cur/v_cur ([S, KVH, D]), lengths counts the cached
+    prefix only and the current token is overlaid at position lengths[s]
+    before attending (pool writes deferred — see paged_attention_decode).
     Returns [S, H, D].
 
     Reference implementation: materializes each slot's max context via
@@ -233,16 +258,28 @@ def paged_attention_decode_ref(
     kvh = k_pages.shape[2]
     g = h // kvh
     scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32))
+    merge_cur = k_cur is not None
+    if not merge_cur:
+        k_cur = jnp.zeros((s, kvh, d), k_pages.dtype)
+        v_cur = jnp.zeros((s, kvh, d), v_pages.dtype)
 
-    def one_slot(qi, row, ln):
+    def one_slot(qi, row, ln, kc, vc):
         ks, vs = gather_kv(k_pages, v_pages, row, page_size)  # [N, KVH, D]
+        total = ln
+        if merge_cur:
+            # current token overlaid at index ln (clamped within capacity;
+            # mode="drop" guards the full-capacity edge, where the caller
+            # has already finished the slot)
+            ks = ks.at[ln].set(kc, mode="drop")
+            vs = vs.at[ln].set(vc, mode="drop")
+            total = ln + 1
         qf = qi.astype(jnp.float32).reshape(kvh, g, d)
         logits = jnp.einsum("kgd,nkd->kgn", qf, ks.astype(jnp.float32), precision=jax.lax.Precision.HIGHEST) * scale
-        valid = jnp.arange(ks.shape[0]) < ln
+        valid = jnp.arange(ks.shape[0]) < total
         logits = jnp.where(valid[None, None, :], logits, _NEG_INF)
         probs = jnp.exp(logits - logits.max(axis=-1, keepdims=True))
         probs = probs / probs.sum(axis=-1, keepdims=True)
         return jnp.einsum("kgn,nkd->kgd", probs, vs.astype(jnp.float32), precision=jax.lax.Precision.HIGHEST).reshape(h, d)
 
-    out = jax.vmap(one_slot)(q, page_table, lengths)
+    out = jax.vmap(one_slot)(q, page_table, lengths, k_cur, v_cur)
     return out.astype(q.dtype)

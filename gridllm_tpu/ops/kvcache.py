@@ -21,10 +21,42 @@ jax negative indexing applies in scatter too).
 from __future__ import annotations
 
 import dataclasses
+import functools
+import os
 from functools import partial
 
 import jax
 import jax.numpy as jnp
+
+
+@functools.cache
+def _env_mode() -> tuple[bool, bool]:
+    """(use_kernels, interpret) from the environment, resolved once.
+    Shared policy for the attention kernels (ops/attention.py imports it)
+    and the KV-write kernels below: env `GRIDLLM_PALLAS` = "auto"
+    (default: kernels on TPU backends only), "1" (force on), "0" (force
+    off), "interpret" (kernels in interpreter mode — CPU testing)."""
+    raw = os.environ.get("GRIDLLM_PALLAS", "auto").lower()
+    if raw in ("0", "off", "false"):
+        return False, False
+    if raw in ("1", "on", "true"):
+        return True, False
+    if raw == "interpret":
+        return True, True
+    return jax.default_backend() == "tpu", False
+
+
+def _pallas_mode(use_pallas: bool | None) -> tuple[bool, bool]:
+    """`use_pallas` is the per-call override (threaded from
+    ModelConfig.use_pallas by the model code, e.g. the engine disables
+    kernels for a mesh-sharded engine without affecting single-device
+    engines in the same process — pallas_call has no GSPMD partitioning
+    rule, so inside a sharded jit the kernels would force replication);
+    None defers to the env policy."""
+    use, interpret = _env_mode()
+    if use_pallas is not None:
+        use = use_pallas
+    return use, interpret
 
 
 @partial(
@@ -105,6 +137,7 @@ def write_prefill(
     start: jnp.ndarray,
     length: jnp.ndarray,
     page_size: int,
+    use_pallas: bool | None = None,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Scatter a prefill chunk for ONE slot into the (single-layer) page pool.
 
@@ -114,7 +147,12 @@ def write_prefill(
     start: scalar — absolute position of k_new[0] (0 for fresh prompts,
     cached length for chunked prefill). length: scalar — valid tokens in
     k_new; positions >= length are dropped.
+
+    Single-layer scatter form (CPU/mesh fallback and tests); the hot path
+    writes all layers at once AFTER the layer scan via write_prefill_all —
+    per-layer writes inside a scan defeat XLA's in-place buffer aliasing.
     """
+    del use_pallas  # single-layer form is always scatter; see _all variant
     t = jnp.arange(k_new.shape[0], dtype=jnp.int32)
     pos = start + t
     page_idx = _safe_page_idx(
@@ -136,12 +174,17 @@ def write_decode(
     positions: jnp.ndarray,
     active: jnp.ndarray,
     page_size: int,
+    use_pallas: bool | None = None,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Scatter one new token per slot into the (single-layer) page pool.
 
     k_new/v_new: [S, KVH, D]; positions: [S] absolute write position per
     slot; active: [S] bool — inactive slots are dropped.
+
+    Single-layer scatter form (CPU/mesh fallback and tests); the hot path
+    is write_decode_all (all layers, once per step, after the layer scan).
     """
+    del use_pallas
     s = jnp.arange(page_table.shape[0], dtype=jnp.int32)
     page_idx = _safe_page_idx(
         lambda p: page_table[s, p], positions, active, page_size,
@@ -150,6 +193,87 @@ def write_decode(
     offset = positions % page_size
     k_pages = k_pages.at[page_idx, offset].set(k_new, mode="drop")
     v_pages = v_pages.at[page_idx, offset].set(v_new, mode="drop")
+    return k_pages, v_pages
+
+
+def write_decode_all(
+    k_pages: jnp.ndarray,
+    v_pages: jnp.ndarray,
+    k_new: jnp.ndarray,
+    v_new: jnp.ndarray,
+    page_table: jnp.ndarray,
+    positions: jnp.ndarray,
+    active: jnp.ndarray,
+    page_size: int,
+    use_pallas: bool | None = None,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Write one token per slot across ALL layers at once.
+
+    k_pages/v_pages: [L, P, ps, KVH, D] (the full pool); k_new/v_new:
+    [L, S, KVH, D]. Runs once per decode step at jit top level, where
+    donation makes the update truly in place (TPU: DMA kernel; otherwise
+    one batched scatter).
+    """
+    s = jnp.arange(page_table.shape[0], dtype=jnp.int32)
+    page_idx = _safe_page_idx(
+        lambda p: page_table[s, p], positions, active, page_size,
+        page_table.shape[1], k_pages.shape[1],
+    )
+    offset = positions % page_size
+    use, interpret = _pallas_mode(use_pallas)
+    # same Mosaic constraint as the attention kernels: page slices need a
+    # 128-lane-aligned minor dim on real TPU; d=64 models take the scatter
+    if use and (interpret or k_pages.shape[-1] % 128 == 0):
+        from gridllm_tpu.ops.pallas_kernels import paged_write_decode
+
+        return paged_write_decode(
+            k_pages, v_pages, k_new, v_new, page_idx, offset,
+            interpret=interpret,
+        )
+    # one scatter over (page, row) applied to every layer: index arrays are
+    # adjacent advanced indices after the leading ':' so the result keeps
+    # [L, S, KVH, D] — matching k_new's layout
+    k_pages = k_pages.at[:, page_idx, offset].set(k_new, mode="drop")
+    v_pages = v_pages.at[:, page_idx, offset].set(v_new, mode="drop")
+    return k_pages, v_pages
+
+
+def write_prefill_all(
+    k_pages: jnp.ndarray,
+    v_pages: jnp.ndarray,
+    k_new: jnp.ndarray,
+    v_new: jnp.ndarray,
+    table_row: jnp.ndarray,
+    start: jnp.ndarray,
+    length: jnp.ndarray,
+    page_size: int,
+    use_pallas: bool | None = None,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Write a prefill chunk for ONE slot across ALL layers at once.
+
+    k_pages/v_pages: [L, P, ps, KVH, D]; k_new/v_new: [L, T, KVH, D].
+    Kernel path (TPU) requires T % page_size == 0 (static check) and
+    page-aligned `start` (engine-guaranteed; see paged_write_chunk).
+    """
+    use, interpret = _pallas_mode(use_pallas)
+    if use and k_new.shape[1] % page_size == 0 and (
+        interpret or k_pages.shape[-1] % 128 == 0
+    ):
+        from gridllm_tpu.ops.pallas_kernels import paged_write_chunk
+
+        return paged_write_chunk(
+            k_pages, v_pages, k_new, v_new, table_row, start, length,
+            page_size, interpret=interpret,
+        )
+    t = jnp.arange(k_new.shape[1], dtype=jnp.int32)
+    pos = start + t
+    page_idx = _safe_page_idx(
+        lambda p: table_row[p], pos, t < length, page_size,
+        table_row.shape[0], k_pages.shape[1],
+    )
+    offset = pos % page_size
+    k_pages = k_pages.at[:, page_idx, offset].set(k_new, mode="drop")
+    v_pages = v_pages.at[:, page_idx, offset].set(v_new, mode="drop")
     return k_pages, v_pages
 
 

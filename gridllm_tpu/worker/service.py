@@ -73,7 +73,6 @@ class WorkerService(EventEmitter):
         self._running = False
         self._subs: list[Subscription] = []
         self._tasks: list[asyncio.Task] = []
-        self._pump_wake = asyncio.Event()
         self._cancelled: set[str] = set()
         self._last_status: str | None = None
 
@@ -88,16 +87,24 @@ class WorkerService(EventEmitter):
         await self.register()
         self._tasks.append(asyncio.create_task(self._heartbeat_loop()))
         self._tasks.append(asyncio.create_task(self._resource_loop()))
-        self._tasks.append(asyncio.create_task(self._pump()))
+        # each generation engine owns a dedicated dispatch thread with
+        # pipelined decode blocks (engine.start(); VERDICT r03 #2 replaced
+        # the per-step asyncio.to_thread pump)
+        for eng in self.engines.values():
+            if not eng.embedding_only:
+                eng.start()
+        self._tasks.append(asyncio.create_task(self._engine_watchdog()))
         log.info("worker started", workerId=self.worker_id,
                  models=list(self.engines))
 
     async def stop(self, announce: bool = True) -> None:
         self._running = False
-        self._pump_wake.set()
         for t in self._tasks:
             t.cancel()
         self._tasks.clear()
+        for eng in self.engines.values():
+            if not eng.embedding_only:
+                await asyncio.to_thread(eng.stop)
         for s in self._subs:
             await s.unsubscribe()
         self._subs.clear()
@@ -173,50 +180,25 @@ class WorkerService(EventEmitter):
                 "currentJobs": self.current_jobs,
             }))
 
-    async def _pump(self) -> None:
-        """Drive all engines' step loops off the event loop thread. A
-        step() exception (compile failure, OOM) must not kill the pump —
-        the engine's in-flight requests are aborted so their waiters get
-        an immediate error instead of hanging to the job timeout, and the
-        pump keeps serving the other engines."""
+    async def _engine_watchdog(self) -> None:
+        """The engine runner recovers from step failures itself (abort +
+        device-state rebuild, engine/engine.py _run); if a runner dies for
+        good (3 consecutive failures) the worker must stop advertising the
+        model so the scheduler routes elsewhere (reference: worker drops
+        from the registry via missed heartbeats — here the model list
+        shrinks while the worker stays)."""
         while self._running:
-            busy = False
-            for eng in list(self.engines.values()):
-                if eng.active_requests or eng.queued_requests:
-                    busy = True
-                    try:
-                        await asyncio.to_thread(eng.step)
-                    except Exception as e:
-                        log.error("engine step failed; aborting its requests",
-                                  model=eng.config.model, error=str(e))
-                        n = eng.abort_all(f"engine failure: {e}")
-                        log.warning("aborted requests", model=eng.config.model,
-                                    count=n)
-                        await self._recover_engine(eng)
-            if not busy:
-                self._pump_wake.clear()
-                try:
-                    await self._pump_wake.wait()
-                except asyncio.CancelledError:
-                    return
-            else:
-                await asyncio.sleep(0)
-
-    async def _recover_engine(self, eng: InferenceEngine) -> None:
-        """After a step() failure the engine's donated device buffers may be
-        gone (a jit call that raises mid-flight consumes cache/counts);
-        without recovery every later request on this engine fails in an
-        accept-then-abort loop while the worker still advertises the model.
-        Rebuild the device state; if even that fails, stop serving the model
-        (drop the engine + re-register) so the scheduler routes elsewhere."""
-        try:
-            await asyncio.to_thread(eng.reset_device_state)
-            log.info("engine device state rebuilt", model=eng.config.model)
-        except Exception as e:
-            log.error("engine unrecoverable; dropping model",
-                      model=eng.config.model, error=str(e))
+            await asyncio.sleep(2.0)
+            dead = [
+                m for m, e in self.engines.items()
+                if not e.embedding_only and not e.running
+            ]
+            if not dead:
+                continue
+            for m in dead:
+                log.error("engine runner dead; dropping model", model=m)
             self.engines = {
-                m: e for m, e in self.engines.items() if e is not eng
+                m: e for m, e in self.engines.items() if m not in dead
             }
             self.max_concurrent = max(
                 sum(e.config.max_slots for e in self.engines.values()), 1
@@ -357,7 +339,6 @@ class WorkerService(EventEmitter):
                 prompt, add_bos=False
             )
         engine.submit(gen)
-        self._pump_wake.set()
 
         buf = ""
         eval_count = 0

@@ -162,3 +162,183 @@ def test_model_end_to_end_with_kernels(monkeypatch):
     got = greedy()
     attention._env_mode.cache_clear()
     assert got == want
+
+
+# ---------------------------------------------------------------------------
+# paged KV write kernels vs the scatter oracle (interpret mode)
+# ---------------------------------------------------------------------------
+
+def test_paged_write_decode_matches_scatter():
+    from gridllm_tpu.ops.pallas_kernels import paged_write_decode
+    from gridllm_tpu.ops.kvcache import _safe_page_idx, write_decode_all
+
+    L, s, maxp, ps, kvh, d, num_pages = 3, 4, 4, 8, 2, 16, 16
+    key = jax.random.PRNGKey(7)
+    kp = jax.random.normal(key, (L, num_pages, ps, kvh, d), jnp.float32)
+    vp = kp * 2.0
+    kn = jax.random.normal(jax.random.PRNGKey(8), (L, s, kvh, d), jnp.float32)
+    vn = kn + 1.0
+    table = jnp.asarray([
+        [3, 1, -1, -1],   # slot 0: 2 pages mapped
+        [5, -1, -1, -1],  # slot 1: 1 page
+        [7, 8, 9, 10],    # slot 2: full
+        [-1, -1, -1, -1], # slot 3: unmapped
+    ], jnp.int32)
+    pos = jnp.asarray([9, 3, 31, 0], jnp.int32)
+    act = jnp.asarray([True, True, True, False])
+
+    want_k, want_v = write_decode_all(
+        kp, vp, kn, vn, table, pos, act, ps, use_pallas=False
+    )
+
+    srange = jnp.arange(s, dtype=jnp.int32)
+    page_idx = _safe_page_idx(
+        lambda p: table[srange, p], pos, act, ps, maxp, num_pages
+    )
+    got_k, got_v = paged_write_decode(
+        kp, vp, kn, vn, page_idx, pos % ps, interpret=True
+    )
+    np.testing.assert_array_equal(np.asarray(got_k), np.asarray(want_k))
+    np.testing.assert_array_equal(np.asarray(got_v), np.asarray(want_v))
+
+
+@pytest.mark.parametrize("start,length", [
+    (0, 32),    # fresh prefill, full pages
+    (0, 19),    # fresh prefill, ragged tail (padding rows land in owned page)
+    (16, 32),   # chunk continuation, page-aligned start
+    (16, 5),    # continuation, ragged
+])
+def test_paged_write_chunk_matches_scatter_valid_region(start, length):
+    """The kernel writes whole pages (incl. padding tails the scatter path
+    drops), so compare only positions < start+length — the contract is that
+    padded positions are never read (attention masks by length)."""
+    from gridllm_tpu.ops.pallas_kernels import paged_write_chunk
+    from gridllm_tpu.ops.kvcache import write_prefill_all
+
+    L, t, ps, kvh, d, num_pages, maxp = 2, 32, 8, 2, 16, 16, 8
+    kn = jax.random.normal(jax.random.PRNGKey(3), (L, t, kvh, d), jnp.float32)
+    vn = kn * 3.0
+    kp = jnp.zeros((L, num_pages, ps, kvh, d), jnp.float32)
+    vp = jnp.zeros_like(kp)
+    row = jnp.asarray([4, 9, 2, 11, 6, 1, 13, 3], jnp.int32)[:maxp]
+
+    want_k, want_v = write_prefill_all(
+        kp, vp, kn, vn, row, jnp.int32(start), jnp.int32(length), ps,
+        use_pallas=False,
+    )
+    got_k, got_v = paged_write_chunk(
+        kp, vp, kn, vn, row, jnp.int32(start), jnp.int32(length), ps,
+        interpret=True,
+    )
+
+    # compare per valid absolute position through the table, every layer
+    for i in range(length):
+        p_abs = start + i
+        page = int(row[p_abs // ps])
+        off = p_abs % ps
+        np.testing.assert_array_equal(
+            np.asarray(got_k[:, page, off]), np.asarray(want_k[:, page, off]),
+            err_msg=f"k mismatch at abs pos {p_abs}",
+        )
+        np.testing.assert_array_equal(
+            np.asarray(got_v[:, page, off]), np.asarray(want_v[:, page, off]),
+        )
+    # pages not in this chunk's span must be untouched
+    touched = {int(row[(start + i) // ps]) for i in range(max(length, 1))}
+    for page in range(num_pages):
+        if page not in touched:
+            np.testing.assert_array_equal(
+                np.asarray(got_k[:, page]), np.asarray(want_k[:, page]),
+                err_msg=f"page {page} modified unexpectedly",
+            )
+
+
+def test_paged_decode_current_token_merge_matches_overlay():
+    """Kernel merge_cur mode == ref overlay mode == old written-pool mode."""
+    from gridllm_tpu.ops.pallas_kernels import paged_decode
+    from gridllm_tpu.ops.kvcache import write_decode_all
+
+    s, maxp, ps, kvh, d, num_pages, h = 3, 4, 8, 2, 16, 16, 4
+    kq = jax.random.PRNGKey(11)
+    q = jax.random.normal(kq, (s, h, d), jnp.float32)
+    kp = jax.random.normal(jax.random.PRNGKey(12), (num_pages, ps, kvh, d), jnp.float32)
+    vp = kp * 0.5
+    kc = jax.random.normal(jax.random.PRNGKey(13), (s, kvh, d), jnp.float32)
+    vc = kc - 0.25
+    table = jnp.asarray([[3, 1, -1, -1], [5, 6, -1, -1], [7, -1, -1, -1]], jnp.int32)
+    prefix = jnp.asarray([9, 13, 0], jnp.int32)  # slot 2: fresh (empty prefix)
+    act = jnp.asarray([True, True, True])
+
+    # oracle: write the current token, then attend with lengths incl. it
+    kp_w, vp_w = write_decode_all(
+        kp[None], vp[None], kc[None], vc[None], table, prefix, act, ps,
+        use_pallas=False,
+    )
+    want = paged_attention_decode_ref(
+        q, kp_w[0], vp_w[0], table, prefix + 1, ps
+    )
+
+    got_ref = paged_attention_decode_ref(
+        q, kp, vp, table, prefix, ps, k_cur=kc, v_cur=vc
+    )
+    got_kernel = paged_decode(
+        q, kp, vp, table, prefix, ps, k_cur=kc, v_cur=vc, interpret=True
+    )
+    np.testing.assert_allclose(np.asarray(got_ref), np.asarray(want), rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(got_kernel), np.asarray(want), rtol=2e-5, atol=2e-5)
+
+
+def test_paged_decode_layer_indexed_pool():
+    """5D pool + layer index reads the right layer (kernel and ref)."""
+    from gridllm_tpu.ops.pallas_kernels import paged_decode
+    from gridllm_tpu.ops import attention
+
+    L, s, maxp, ps, kvh, d, num_pages, h = 3, 2, 2, 8, 2, 16, 8, 4
+    q = jax.random.normal(jax.random.PRNGKey(1), (s, h, d), jnp.float32)
+    kp = jax.random.normal(jax.random.PRNGKey(2), (L, num_pages, ps, kvh, d), jnp.float32)
+    vp = kp + 1.0
+    table = jnp.asarray([[1, 2], [4, -1]], jnp.int32)
+    lens = jnp.asarray([12, 6], jnp.int32)
+    for li in range(L):
+        want = paged_attention_decode_ref(q, kp[li], vp[li], table, lens, ps)
+        got = paged_decode(q, kp, vp, table, lens, ps,
+                           layer=jnp.int32(li), interpret=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5)
+        got2 = attention.paged_attention_decode(
+            q, kp, vp, table, lens, ps, layer=jnp.int32(li), use_pallas=False
+        )
+        np.testing.assert_allclose(np.asarray(got2), np.asarray(want), rtol=2e-5, atol=2e-5)
+
+
+def test_prefix_chunk_overlay_matches_written_pool():
+    """attention_prefix_chunk with k_cur overlay == chunk already written."""
+    from gridllm_tpu.ops.kvcache import write_prefill_all
+    from gridllm_tpu.ops.attention import attention_prefix_chunk
+
+    t, ps, kvh, d, num_pages, maxp, h = 16, 8, 2, 16, 16, 8, 4
+    start, chunk_len = 8, 10
+    q = jax.random.normal(jax.random.PRNGKey(5), (1, t, h, d), jnp.float32)
+    kc = jax.random.normal(jax.random.PRNGKey(6), (t, kvh, d), jnp.float32)
+    vc = kc * 2.0
+    row = jnp.asarray([4, 9, 2, 11, 6, 1, 13, 3], jnp.int32)
+    # prefix: positions 0..start-1 already in the pool
+    kp = jax.random.normal(jax.random.PRNGKey(9), (num_pages, ps, kvh, d), jnp.float32)
+    vp = kp - 0.5
+    total = jnp.int32(start + chunk_len)
+
+    kp_w, vp_w = write_prefill_all(
+        kp[None], vp[None], kc[None], vc[None], row,
+        jnp.int32(start), jnp.int32(chunk_len), ps, use_pallas=False,
+    )
+    want = attention_prefix_chunk(
+        q, kp_w[0], vp_w[0], row, jnp.int32(start), total, ps,
+        use_pallas=False,
+    )
+    got = attention_prefix_chunk(
+        q, kp, vp, row, jnp.int32(start), total, ps,
+        k_cur=kc, v_cur=vc, use_pallas=False,
+    )
+    np.testing.assert_allclose(
+        np.asarray(got[:, :chunk_len]), np.asarray(want[:, :chunk_len]),
+        rtol=2e-5, atol=2e-5,
+    )
