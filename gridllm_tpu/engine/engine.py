@@ -30,8 +30,10 @@ compute path was `client/src/services/OllamaService.ts` HTTP calls). Design
   fields in nanoseconds (the reference zeroed them, SURVEY.md §2.8),
   `stop` sequences, `num_predict`, EOS from the tokenizer.
 
-Known divergence from Ollama: repeat_penalty counts the whole context
-(prompt + generated), not a sliding `repeat_last_n` window.
+repeat_penalty follows llama.cpp's penalty_last_n semantics: it applies
+over the last `repeat_last_n` context tokens (prompt + generated; -1 →
+the request's context size, 0 → disabled), maintained as a device-side
+window buffer (ops/sampling.py) capped at EngineConfig.repeat_window.
 """
 
 from __future__ import annotations
@@ -52,7 +54,12 @@ from gridllm_tpu.engine.tokenizer import DetokState, Tokenizer, get_tokenizer
 from gridllm_tpu.models import llama
 from gridllm_tpu.models.configs import ModelConfig, get_config
 from gridllm_tpu.ops.kvcache import PagedKVCache, PageAllocator
-from gridllm_tpu.ops.sampling import SamplingParams, sample_tokens
+from gridllm_tpu.ops.sampling import (
+    SamplingParams,
+    sample_tokens,
+    window_push,
+    window_set_slot,
+)
 from gridllm_tpu.parallel.mesh import MeshConfig, build_mesh
 from gridllm_tpu.parallel.sharding import shard_cache, shard_params
 from gridllm_tpu.utils.logging import get_logger
@@ -102,6 +109,9 @@ class EngineConfig:
     # (idle engines admit everything; bounding protects running streams'
     # inter-token latency from admission bursts — VERDICT r03 #3)
     admit_per_block: int = 2
+    # static width of the per-slot repeat-penalty window buffer;
+    # repeat_last_n (and its -1 → num_ctx resolution) clamps to this
+    repeat_window: int = 256
 
 
 @dataclasses.dataclass
@@ -277,6 +287,10 @@ class InferenceEngine:
         self.alloc = PageAllocator(c.num_pages, c.page_size, c.max_pages_per_slot)
         self.sampling = SamplingParams.defaults(c.max_slots)
         self.counts = jnp.zeros((c.max_slots, mc.vocab_size), jnp.int32)
+        # repeat-penalty window: last ≤ repeat_last_n context tokens per
+        # slot (ops/sampling.py window_* helpers maintain it + counts)
+        self.window = jnp.zeros((c.max_slots, c.repeat_window), jnp.int32)
+        self.wlen = jnp.zeros((c.max_slots,), jnp.int32)
         self.tokens = jnp.zeros((c.max_slots,), jnp.int32)
         self.active = jnp.zeros((c.max_slots,), bool)
 
@@ -321,82 +335,93 @@ class InferenceEngine:
         # token lands in `tokens[slot]` and the host never synchronizes on
         # it (it arrives with the next decode block's row 0). sp.step for
         # the slot advances to 1: the prefill sample consumed draw 0.
-        @partial(jax.jit, donate_argnums=(2, 3, 4, 5, 6))
-        def prefill_fn(params, prompt, cache, counts, tokens, active, sp,
-                       length, slot, table_row):
+        # The repeat-penalty window resets to the prompt's last
+        # repeat_last_n tokens (llama.cpp penalty_last_n semantics).
+        @partial(jax.jit, donate_argnums=(2, 3, 4, 5, 6, 7, 8))
+        def prefill_fn(params, prompt, cache, counts, window, wlen, tokens,
+                       active, sp, length, slot, table_row):
             logits, cache = self.mod.prefill(
                 params, mc, prompt, length, cache, slot, table_row, attn=attn,
                 mesh=self.mesh,
             )
-            counts = counts.at[slot].set(0)  # slot reuse: clear old counts
-            # count prompt tokens for repeat_penalty (valid positions only)
-            t = jnp.arange(prompt.shape[0])
-            ids = jnp.where(t < length, prompt, mc.vocab_size)  # OOB drops
-            counts = counts.at[slot, ids].add(1, mode="drop")
+            rl = sp.repeat_last_n[slot]
+            window, wlen, counts = window_set_slot(
+                window, wlen, counts, slot, prompt, jnp.int32(0), length,
+                rl, mc.vocab_size,
+            )
             tok = sample_tokens(logits[None], _gather_sp(sp, slot), counts[slot][None])[0]
-            counts = counts.at[slot, tok].add(1, mode="drop")
             tokens = tokens.at[slot].set(tok)
+            one = jnp.zeros_like(active).at[slot].set(True)
+            window, wlen, counts = window_push(
+                window, wlen, counts, tokens, one, sp.repeat_last_n,
+                mc.vocab_size,
+            )
             active = active.at[slot].set(True)
             sp = dataclasses.replace(sp, step=sp.step.at[slot].set(1))
-            return cache, counts, tokens, active, sp
+            return cache, counts, window, wlen, tokens, active, sp
 
-        @partial(jax.jit, donate_argnums=(2, 3, 4, 5, 6))
-        def prefill_chunk_fn(params, prompt, cache, counts, tokens, active,
-                             sp, start, length, slot, table_row, is_final):
+        @partial(jax.jit, donate_argnums=(2, 3, 4, 5, 6, 7, 8))
+        def prefill_chunk_fn(params, prompt, cache, counts, window, wlen,
+                             tokens, active, sp, start, length, slot,
+                             table_row, is_final):
             logits, cache = self.mod.prefill_chunk(
                 params, mc, prompt, start, length, cache, slot, table_row
             )
-            counts = counts.at[slot].set(
-                jnp.where(start == 0, 0, counts[slot])
+            rl = sp.repeat_last_n[slot]
+            window, wlen, counts = window_set_slot(
+                window, wlen, counts, slot, prompt, start, length,
+                rl, mc.vocab_size,
             )
-            t = jnp.arange(prompt.shape[0])
-            ids = jnp.where(t < length, prompt, mc.vocab_size)  # OOB drops
-            counts = counts.at[slot, ids].add(1, mode="drop")
             tok = sample_tokens(
                 logits[None], _gather_sp(sp, slot), counts[slot][None]
             )[0]
             # intermediate chunks sample garbage (discarded on device);
             # only the final chunk activates the slot and counts its token
-            counts = counts.at[
-                slot, jnp.where(is_final, tok, mc.vocab_size)
-            ].add(1, mode="drop")
             tokens = tokens.at[slot].set(jnp.where(is_final, tok, tokens[slot]))
+            one = jnp.zeros_like(active).at[slot].set(is_final)
+            window, wlen, counts = window_push(
+                window, wlen, counts, tokens, one, sp.repeat_last_n,
+                mc.vocab_size,
+            )
             active = active.at[slot].set(is_final | active[slot])
             sp = dataclasses.replace(
                 sp, step=sp.step.at[slot].set(
                     jnp.where(is_final, 1, sp.step[slot])
                 )
             )
-            return cache, counts, tokens, active, sp
+            return cache, counts, window, wlen, tokens, active, sp
 
         # One decode block: k fused (model step + sample + bookkeeping)
         # iterations under lax.scan. Returns [k+1, S] tokens — row 0 is the
         # block's INPUT tokens (a newly admitted slot's prefill sample),
         # rows 1..k the block's samples.
-        @partial(jax.jit, static_argnames=("k",), donate_argnums=(1, 2, 4, 5))
-        def decode_block_fn(params, cache, tokens, active, counts, sp, *, k):
+        @partial(jax.jit, static_argnames=("k",),
+                 donate_argnums=(1, 2, 4, 5, 6, 7))
+        def decode_block_fn(params, cache, tokens, active, counts, window,
+                            wlen, sp, *, k):
             first = tokens
 
             def body(carry, _):
-                tokens, cache, counts, sp = carry
+                tokens, cache, counts, window, wlen, sp = carry
                 logits, cache = self.mod.decode_step(
                     params, mc, tokens, cache, active
                 )
                 sampled = sample_tokens(logits, sp, counts)
-                s = jnp.arange(tokens.shape[0])
-                ids = jnp.where(active, sampled, mc.vocab_size)
-                counts = counts.at[s, ids].add(1, mode="drop")
+                tokens = jnp.where(active, sampled, tokens)
+                window, wlen, counts = window_push(
+                    window, wlen, counts, tokens, active, sp.repeat_last_n,
+                    mc.vocab_size,
+                )
                 sp = dataclasses.replace(
                     sp, step=sp.step + active.astype(jnp.int32)
                 )
-                tokens = jnp.where(active, sampled, tokens)
-                return (tokens, cache, counts, sp), tokens
+                return (tokens, cache, counts, window, wlen, sp), tokens
 
-            (tokens, cache, counts, sp), toks = jax.lax.scan(
-                body, (tokens, cache, counts, sp), None, length=k
+            (tokens, cache, counts, window, wlen, sp), toks = jax.lax.scan(
+                body, (tokens, cache, counts, window, wlen, sp), None, length=k
             )
             out = jnp.concatenate([first[None], toks])  # [k+1, S]
-            return out, tokens, cache, counts, sp
+            return out, tokens, cache, counts, window, wlen, sp
 
         self._prefill_fn = prefill_fn
         self._prefill_chunk_fn = prefill_chunk_fn
@@ -459,15 +484,24 @@ class InferenceEngine:
             req = self._pending.popleft()
         ids = self._tokenize(req)
         opts = req.options or {}
-        if len(ids) >= self.max_context:
-            ids = ids[-(self.max_context - 1):]  # Ollama truncates from the left
+        # num_ctx caps THIS request's context (Ollama option; engine-wide
+        # max_context still bounds it) — VERDICT r03 weak #7
+        num_ctx = int(opts.get("num_ctx") or 0)
+        eff_ctx = (
+            min(num_ctx, self.max_context) if num_ctx > 0 else self.max_context
+        )
+        # floor of 2: one prompt token + one generated; num_ctx=1 would
+        # also make the truncation slice ids[-0:] a no-op
+        eff_ctx = max(eff_ctx, 2)
+        if len(ids) >= eff_ctx:
+            ids = ids[-(eff_ctx - 1):]  # Ollama truncates from the left
         num_predict = int(opts.get("num_predict", -1))
         want = (
             len(ids) + num_predict
             if num_predict >= 0
-            else self.max_context
+            else eff_ctx
         )
-        want = min(max(want, len(ids) + 1), self.max_context)
+        want = min(max(want, len(ids) + 1), eff_ctx)
         if not self.alloc.fits_slot_cap(want):
             self._fail(req, f"context {want} exceeds slot capacity")
             return True
@@ -488,12 +522,19 @@ class InferenceEngine:
         seed = opts.get("seed")
         if seed is None:
             seed = self._rng.getrandbits(31)
+        # repeat_last_n (llama.cpp penalty_last_n): -1 → the request's
+        # context size, 0 → disabled; clamped to the window buffer width
+        rl = int(opts.get("repeat_last_n", 64))
+        if rl < 0:
+            rl = want
+        rl = min(rl, self.config.repeat_window)
         upd = {
             "temperature": float(opts.get("temperature", 0.8)),
             "top_k": int(opts.get("top_k", 40)),
             "top_p": float(opts.get("top_p", 0.9)),
             "min_p": float(opts.get("min_p", 0.0)),
             "repeat_penalty": float(opts.get("repeat_penalty", 1.1)),
+            "repeat_last_n": rl,
             "seed": int(seed) & 0x7FFFFFFF,
             "step": 0,
         }
@@ -514,23 +555,25 @@ class InferenceEngine:
             for s0 in range(0, len(ids), c):
                 part = ids[s0 : s0 + c]
                 padded = jnp.asarray(part + [0] * (c - len(part)), jnp.int32)
-                (self.cache, self.counts, self.tokens, self.active,
-                 self.sampling) = self._prefill_chunk_fn(
-                    self.params, padded, self.cache, self.counts,
-                    self.tokens, self.active, self.sampling,
-                    jnp.int32(s0), jnp.int32(len(part)), jnp.int32(slot),
-                    row, jnp.bool_(s0 + c >= len(ids)),
+                (self.cache, self.counts, self.window, self.wlen,
+                 self.tokens, self.active, self.sampling) = (
+                    self._prefill_chunk_fn(
+                        self.params, padded, self.cache, self.counts,
+                        self.window, self.wlen, self.tokens, self.active,
+                        self.sampling, jnp.int32(s0), jnp.int32(len(part)),
+                        jnp.int32(slot), row, jnp.bool_(s0 + c >= len(ids)),
+                    )
                 )
         else:
             bucket = self._bucket_for(len(ids))
             padded = jnp.asarray(
                 ids + [0] * (bucket - len(ids)), jnp.int32
             )
-            (self.cache, self.counts, self.tokens, self.active,
-             self.sampling) = self._prefill_fn(
+            (self.cache, self.counts, self.window, self.wlen, self.tokens,
+             self.active, self.sampling) = self._prefill_fn(
                 self.params, padded, self.cache, self.counts,
-                self.tokens, self.active, self.sampling,
-                jnp.int32(len(ids)), jnp.int32(slot), row,
+                self.window, self.wlen, self.tokens, self.active,
+                self.sampling, jnp.int32(len(ids)), jnp.int32(slot), row,
             )
         # dispatch wall time only — the prefill runs asynchronously and its
         # sampled token first becomes host-visible in the next block fetch;
@@ -607,11 +650,10 @@ class InferenceEngine:
     def _dispatch_block(self, k: int) -> None:
         """Dispatch one fused k-step decode block (no host sync)."""
         self._gen += 1
-        out, self.tokens, self.cache, self.counts, self.sampling = (
-            self._decode_block_fn(
-                self.params, self.cache, self.tokens, self.active,
-                self.counts, self.sampling, k=k,
-            )
+        (out, self.tokens, self.cache, self.counts, self.window, self.wlen,
+         self.sampling) = self._decode_block_fn(
+            self.params, self.cache, self.tokens, self.active,
+            self.counts, self.window, self.wlen, self.sampling, k=k,
         )
         self._inflight.append((self._gen, out, k))
 

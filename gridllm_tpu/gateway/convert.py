@@ -95,7 +95,21 @@ def to_openai_chat(response: dict[str, Any], model: str, request_id: str) -> dic
         "finish_reason": _finish_reason(response),
     }
     if message.get("tool_calls"):
-        choice["message"]["tool_calls"] = message["tool_calls"]
+        # Ollama shape (arguments: object) → OpenAI shape (id/type +
+        # arguments as a JSON string), matching the reference's facade
+        choice["message"]["tool_calls"] = [
+            {
+                "id": f"call_{request_id[:8]}_{i}",
+                "type": "function",
+                "function": {
+                    "name": (tc.get("function") or {}).get("name", ""),
+                    "arguments": json.dumps(
+                        (tc.get("function") or {}).get("arguments", {})
+                    ),
+                },
+            }
+            for i, tc in enumerate(message["tool_calls"])
+        ]
     out: dict[str, Any] = {
         "id": f"chatcmpl-{request_id}",
         "object": "chat.completion",

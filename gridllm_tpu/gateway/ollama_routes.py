@@ -20,6 +20,8 @@ model required.
 
 from __future__ import annotations
 
+import re
+import time
 import uuid
 from typing import Any
 
@@ -64,10 +66,49 @@ def _validate_prompt(body: dict) -> str | None:
     return prompt
 
 
+_GO_DURATION_RE = re.compile(r"(\d+(?:\.\d+)?)(ns|us|µs|ms|s|m|h)")
+_GO_UNITS = {"ns": 1e-9, "us": 1e-6, "µs": 1e-6, "ms": 1e-3,
+             "s": 1.0, "m": 60.0, "h": 3600.0}
+
+
+def _parse_keep_alive(v: Any) -> float | None:
+    """Ollama keep_alive → seconds. Numbers are seconds; strings take Go
+    durations incl. compound forms ("1h30m", "500ms"); negative → keep
+    forever (None); default 5m when unset or unparseable."""
+    if v is None:
+        return 300.0
+    if isinstance(v, (int, float)):
+        return None if v < 0 else float(v)
+    s = str(v).strip()
+    neg = s.startswith("-")
+    if neg:
+        s = s[1:]
+    parts = _GO_DURATION_RE.findall(s)
+    if parts and _GO_DURATION_RE.sub("", s) == "":
+        sec = sum(float(n) * _GO_UNITS[u] for n, u in parts)
+        return None if neg else sec
+    try:
+        sec = float(s)
+        return None if neg or sec < 0 else sec
+    except ValueError:
+        return 300.0
+
+
 def build_routes(registry: WorkerRegistry, scheduler: JobScheduler,
                  version: str, default_timeout_ms: int = 300_000) -> list[web.RouteDef]:
     routes: list[web.RouteDef] = []
     DEFAULT_TIMEOUT_MS = default_timeout_ms
+    # keep_alive bookkeeping: engines stay HBM-resident (a TPU worker's
+    # weights are provisioned at startup — reloading a 3-70B checkpoint
+    # per request would dwarf any serving win), so keep_alive is honored
+    # as ADVERTISED residency: /api/ps reports expires_at from the last
+    # request's keep_alive, and keep_alive=0 + empty prompt returns the
+    # unload shape (Ollama clients use both to manage memory).
+    model_expiry: dict[str, float | None] = {}
+
+    def _touch_keep_alive(model: str, keep_alive: Any) -> None:
+        sec = _parse_keep_alive(keep_alive)
+        model_expiry[model] = None if sec is None else time.time() + sec
 
     # ---------------- /api/generate ----------------
     async def generate(request: web.Request) -> web.StreamResponse:
@@ -108,6 +149,7 @@ def build_routes(registry: WorkerRegistry, scheduler: JobScheduler,
                 "submittedAt": iso_now(),
             },
         )
+        _touch_keep_alive(model, body.get("keep_alive"))
         log.job("ollama generate submitted", req.id, model=model, stream=stream)
 
         if not stream:
@@ -159,6 +201,7 @@ def build_routes(registry: WorkerRegistry, scheduler: JobScheduler,
                 "submittedAt": iso_now(),
             },
         )
+        _touch_keep_alive(model, body.get("keep_alive"))
         log.job("ollama chat submitted", req.id, model=model,
                 stream=stream, messages=len(messages))
 
@@ -272,11 +315,19 @@ def build_routes(registry: WorkerRegistry, scheduler: JobScheduler,
         seen: dict[str, dict] = {}
         for worker in registry.get_online_workers():
             for m in worker.capabilities.availableModels:
+                if m.name in model_expiry:
+                    exp = model_expiry[m.name]
+                    expires = (
+                        "never" if exp is None else
+                        time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime(exp))
+                    )
+                else:
+                    expires = ""
                 entry = seen.setdefault(m.name, {
                     "name": m.name, "model": m.model or m.name,
                     "size": m.size or 0, "digest": m.digest or "",
                     "details": m.details or {},
-                    "expires_at": "",
+                    "expires_at": expires,
                     "size_vram": 0,
                     "gridllm_metadata": {"workers": []},
                 })

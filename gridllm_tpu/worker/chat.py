@@ -33,19 +33,8 @@ def collect_images(req) -> list[str]:
 
 
 def render_chat(messages: list[dict[str, Any]], tokenizer: Tokenizer) -> str:
-    inner = getattr(tokenizer, "_tok", None)
-    if inner is not None and getattr(inner, "chat_template", None):
-        return inner.apply_chat_template(
-            messages, tokenize=False, add_generation_prompt=True
-        )
-    parts = []
-    for m in messages:
-        role = m.get("role", "user")
-        content = m.get("content", "")
-        if isinstance(content, list):  # OpenAI content-part arrays
-            content = "".join(
-                p.get("text", "") for p in content if isinstance(p, dict)
-            )
-        parts.append(f"<|{role}|>\n{content}\n")
-    parts.append("<|assistant|>\n")
-    return "".join(parts)
+    """Back-compat shim: tool/think-aware rendering lives in
+    worker/prompting.py (render_chat_full); plain chats route through it."""
+    from gridllm_tpu.worker.prompting import render_chat_full
+
+    return render_chat_full(messages, tokenizer)

@@ -37,7 +37,15 @@ from gridllm_tpu.utils.types import (
     iso_now,
 )
 from gridllm_tpu.worker.capabilities import gather_capabilities
-from gridllm_tpu.worker.chat import collect_images, render_chat
+from gridllm_tpu.worker.chat import collect_images
+from gridllm_tpu.worker.prompting import (
+    build_generate_prompt,
+    extract_json,
+    json_instruction,
+    parse_tool_calls,
+    render_chat_full,
+    split_thinking,
+)
 
 log = get_logger("worker")
 
@@ -312,14 +320,36 @@ class WorkerService(EventEmitter):
         self, engine: InferenceEngine, assignment: JobAssignment
     ) -> InferenceResponse | None:
         req = assignment.request
+        md = req.metadata or {}
         streaming = bool(req.stream)
         is_chat = req.request_type == "chat" or (
             req.messages is not None and req.prompt is None
         )
+        fmt = req.format if req.format is not None else md.get("format")
+        think = md.get("think")
+        raw = bool(md.get("raw"))
         if is_chat:
-            prompt = render_chat(req.messages or [], engine.tokenizer)
+            messages = list(req.messages or [])
+            if md.get("system") and not any(
+                m.get("role") == "system" for m in messages
+            ):
+                messages = [{"role": "system", "content": md["system"]}] + messages
+            if fmt:
+                messages = messages + [
+                    {"role": "system", "content": json_instruction(fmt)}
+                ]
+            prompt = render_chat_full(
+                messages, engine.tokenizer, tools=req.tools, think=think,
+            )
         else:
-            prompt = req.prompt or ""
+            base = req.prompt or ""
+            if fmt and not raw:
+                base = base + json_instruction(fmt)
+            prompt = build_generate_prompt(
+                base, engine.tokenizer,
+                system=md.get("system"), template=md.get("template"),
+                suffix=md.get("suffix"), raw=raw,
+            )
 
         loop = asyncio.get_running_loop()
         q: asyncio.Queue = asyncio.Queue()
@@ -331,9 +361,15 @@ class WorkerService(EventEmitter):
         context = opts.pop("context", None) or getattr(req, "context", None)
         gen = GenerationRequest(
             id=req.id, prompt=prompt, options=opts,
-            raw=bool(opts.get("raw")), on_chunk=on_chunk,
+            raw=raw or bool(opts.get("raw")), on_chunk=on_chunk,
             images=collect_images(req) or None,
         )
+        # format / tools / think outputs are post-processed from the FULL
+        # text; suppress intermediate stream frames so streamed bytes can
+        # never disagree with the final extracted result (divergence from
+        # Ollama's grammar-constrained streaming, documented in prompting.py)
+        if fmt or req.tools or think:
+            streaming = False
         if context:
             gen.prompt_ids = list(context) + engine.tokenizer.encode(
                 prompt, add_bos=False
@@ -362,7 +398,8 @@ class WorkerService(EventEmitter):
                         raise NonRetryableJobError(msg)
                     raise RuntimeError(msg)
                 return await self._finalize_generation(
-                    req, res, buf, is_chat, streaming
+                    req, res, buf, is_chat, streaming,
+                    fmt=fmt, tools=req.tools, think=think,
                 )
             eval_count += 1
             if streaming and buf and (
@@ -383,7 +420,8 @@ class WorkerService(EventEmitter):
         await self.bus.publish(f"job:stream:{req.id}", chunk.model_dump_json())
 
     async def _finalize_generation(
-        self, req, res: GenerationResult, tail: str, is_chat: bool, streaming: bool
+        self, req, res: GenerationResult, tail: str, is_chat: bool,
+        streaming: bool, fmt=None, tools=None, think=None,
     ) -> InferenceResponse:
         if streaming and tail:
             await self._flush_stream(req, tail, res.eval_count)
@@ -397,9 +435,24 @@ class WorkerService(EventEmitter):
             eval_count=res.eval_count,
             eval_duration=res.eval_duration_ns,
         )
+        text = res.text
+        thinking = None
+        if think:
+            thinking, text = split_thinking(text)
+        tool_calls: list[dict] = []
+        if is_chat and tools:
+            tool_calls, text = parse_tool_calls(text)
+        if fmt:
+            text = extract_json(text)
         if is_chat:
-            response.message = {"role": "assistant", "content": res.text}
+            message: dict = {"role": "assistant", "content": text}
+            if thinking:
+                message["thinking"] = thinking
+            if tool_calls:
+                message["tool_calls"] = tool_calls
+            response.message = message
         else:
-            response.response = res.text
+            response.response = text
+            response.thinking = thinking
             response.context = res.context
         return response

@@ -1,0 +1,198 @@
+"""Ollama option semantics at the worker: template/system/suffix rendering,
+format:"json" extraction, think splitting, tool-call parsing — VERDICT r03
+missing #2/#3. Reference behavior contract:
+client/src/services/OllamaService.ts:197-226 (options forwarded and
+applied), server/src/routes/ollama.ts:26-56 (option schema)."""
+
+import asyncio
+import json
+
+import pytest
+
+from gridllm_tpu.engine.tokenizer import get_tokenizer
+from gridllm_tpu.worker.prompting import (
+    build_generate_prompt,
+    extract_json,
+    json_instruction,
+    parse_tool_calls,
+    render_chat_full,
+    render_template,
+    split_thinking,
+)
+
+TOK = get_tokenizer(None, 512)  # byte tokenizer (no chat template)
+
+
+# ---------------------------------------------------------------------------
+# Go-template subset
+# ---------------------------------------------------------------------------
+
+def test_render_template_vars_and_ifs():
+    t = "{{ if .System }}SYS:{{ .System }}\n{{ end }}USER:{{ .Prompt }}"
+    assert render_template(t, {"System": "be brief", "Prompt": "hi"}) == (
+        "SYS:be brief\nUSER:hi"
+    )
+    assert render_template(t, {"System": "", "Prompt": "hi"}) == "USER:hi"
+
+
+def test_render_template_suffix_fim():
+    t = "<PRE>{{ .Prompt }}<SUF>{{ .Suffix }}<MID>"
+    out = render_template(t, {"Prompt": "def f(", "Suffix": "return x"})
+    assert out == "<PRE>def f(<SUF>return x<MID>"
+
+
+def test_generate_prompt_paths():
+    # raw bypasses everything
+    assert build_generate_prompt(
+        "p", TOK, system="s", template="T{{ .Prompt }}", raw=True
+    ) == "p"
+    # custom template wins
+    assert build_generate_prompt(
+        "p", TOK, system="s", template="[{{ .System }}]{{ .Prompt }}"
+    ) == "[s]p"
+    # system without template → framed (byte tokenizer has no chat template)
+    out = build_generate_prompt("p", TOK, system="be nice")
+    assert "be nice" in out and out.index("be nice") < out.index("p")
+    # suffix without a template referencing it is ignored (Ollama semantics)
+    assert build_generate_prompt("p", TOK, suffix="tail") == "p"
+
+
+# ---------------------------------------------------------------------------
+# format: json
+# ---------------------------------------------------------------------------
+
+def test_extract_json_trims_prose():
+    assert extract_json('Sure! {"a": [1, 2]} hope that helps') == '{"a": [1, 2]}'
+    assert extract_json("no json here") == "no json here"
+    assert json.loads(extract_json('x ["ok", {"k": "v"}] y')) == ["ok", {"k": "v"}]
+
+
+def test_json_instruction_includes_schema():
+    schema = {"type": "object", "properties": {"a": {"type": "number"}}}
+    assert "JSON schema" in json_instruction(schema)
+    assert '"properties"' in json_instruction(schema)
+    assert "JSON" in json_instruction("json")
+
+
+# ---------------------------------------------------------------------------
+# thinking
+# ---------------------------------------------------------------------------
+
+def test_split_thinking():
+    th, rest = split_thinking("<think>hmm\nplan</think>The answer is 4.")
+    assert th == "hmm\nplan"
+    assert rest == "The answer is 4."
+    th, rest = split_thinking("plain")
+    assert th is None and rest == "plain"
+
+
+# ---------------------------------------------------------------------------
+# tool calls
+# ---------------------------------------------------------------------------
+
+def test_parse_tool_calls_hermes_tag():
+    text = ('<tool_call>{"name": "get_weather", "arguments": '
+            '{"city": "Paris"}}</tool_call>')
+    calls, rest = parse_tool_calls(text)
+    assert calls == [{"function": {"name": "get_weather",
+                                   "arguments": {"city": "Paris"}}}]
+    assert rest == ""
+
+
+def test_parse_tool_calls_llama3_bare_json():
+    text = '{"name": "add", "parameters": {"a": 1, "b": 2}}'
+    calls, rest = parse_tool_calls(text)
+    assert calls == [{"function": {"name": "add",
+                                   "arguments": {"a": 1, "b": 2}}}]
+    assert rest == ""
+
+
+def test_parse_tool_calls_plain_text_untouched():
+    calls, rest = parse_tool_calls("The answer is 42.")
+    assert calls == [] and rest == "The answer is 42."
+    # a JSON object that is NOT a tool call stays content
+    calls, rest = parse_tool_calls('{"answer": 42}')
+    assert calls == [] and rest == '{"answer": 42}'
+
+
+def test_render_chat_tools_in_prompt():
+    tools = [{"type": "function", "function": {
+        "name": "get_weather",
+        "parameters": {"type": "object", "properties": {}}}}]
+    out = render_chat_full(
+        [{"role": "user", "content": "weather?"}], TOK, tools=tools
+    )
+    assert "get_weather" in out and "weather?" in out
+
+
+def test_render_chat_openai_string_arguments_normalized():
+    msgs = [
+        {"role": "user", "content": "add 1 2"},
+        {"role": "assistant", "content": "", "tool_calls": [
+            {"type": "function", "function": {
+                "name": "add", "arguments": '{"a": 1, "b": 2}'}}]},
+        {"role": "tool", "content": "3"},
+    ]
+    out = render_chat_full(msgs, TOK)
+    assert '"a": 1' in out and "[tool result] 3" in out
+
+
+# ---------------------------------------------------------------------------
+# end-to-end through the worker (in-memory bus, tiny engine)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def stack():
+    from gridllm_tpu.bus.memory import InMemoryBus
+    from gridllm_tpu.engine import EngineConfig, InferenceEngine
+    from gridllm_tpu.utils.config import WorkerConfig
+    from gridllm_tpu.worker.service import WorkerService
+
+    async def build():
+        eng = InferenceEngine(EngineConfig(
+            model="tiny-llama", max_slots=2, page_size=8, num_pages=32,
+            max_pages_per_slot=8, prefill_buckets=(16, 32),
+        ))
+        bus = InMemoryBus()
+        await bus.connect()
+        worker = WorkerService(bus, {"tiny-llama": eng}, WorkerConfig())
+        await worker.start()
+        return bus, worker
+
+    return build
+
+
+def _run(coro):
+    return asyncio.new_event_loop().run_until_complete(coro)
+
+
+def test_worker_applies_system_and_format(stack):
+    """system travels into the rendered prompt; format triggers JSON
+    extraction on the final text (soft-constraint + hard-extract)."""
+    from gridllm_tpu.utils.types import InferenceRequest, JobAssignment
+
+    async def main():
+        bus, worker = await stack()
+        results = {}
+
+        async def on_done(_ch, raw):
+            d = json.loads(raw)
+            results[d["jobId"]] = d
+
+        await bus.subscribe("job:completed", on_done)
+        req = InferenceRequest(
+            id="j1", model="tiny-llama", prompt="hello",
+            options={"temperature": 0, "num_predict": 4}, stream=False,
+            metadata={"requestType": "inference", "system": "You are terse.",
+                      "format": "json"},
+        )
+        import time as _t
+        await worker._execute(JobAssignment(
+            jobId="j1", workerId=worker.worker_id, request=req,
+            assignedAt=_t.time()))
+        await asyncio.sleep(0.05)
+        assert "j1" in results and results["j1"]["success"]
+        await worker.stop()
+        await bus.disconnect()
+
+    _run(main())
