@@ -85,6 +85,10 @@ class EngineConfig:
     checkpoint_path: str | None = None   # None → random init (tests/synthetic bench)
     tokenizer: str | None = None         # None/"byte" → ByteTokenizer
     dtype: str = "bfloat16"
+    # "int8" → per-out-channel weight-only quantization of the matmul
+    # leaves (ops/quant.py). Halves weight HBM + decode bandwidth; the
+    # only way llama3:70b fits a v5e-8 slice (BASELINE config #3).
+    quantize: str | None = None
     max_slots: int = 8
     page_size: int = 64
     num_pages: int = 1024
@@ -236,6 +240,23 @@ class InferenceEngine:
         c, mc = self.config, self.cfg
         dtype = jnp.dtype(c.dtype)
         t0 = time.perf_counter_ns()
+        if c.quantize and c.quantize != "int8":
+            raise ValueError(f"unknown quantize mode: {c.quantize!r}")
+        if c.quantize and self.embedding_only:
+            # bert_embed consumes its weights with plain dots (no qdot
+            # routing) — loud failure beats a TypeError mid-forward
+            raise ValueError(
+                f"{self.cfg.name}: quantize is not supported for "
+                "embedding-only models"
+            )
+
+        def _maybe_quant(p):
+            if c.quantize == "int8":
+                from gridllm_tpu.ops.quant import quantize_params
+
+                return quantize_params(p)
+            return p
+
         if c.checkpoint_path:
             from gridllm_tpu.engine.loader import load_checkpoint
             from gridllm_tpu.parallel.sharding import param_shardings
@@ -243,12 +264,18 @@ class InferenceEngine:
             shardings = None
             if self.mesh is not None:
                 proto = jax.eval_shape(
-                    lambda: self.mod.init_params(mc, jax.random.PRNGKey(0), dtype)
+                    lambda: _maybe_quant(
+                        self.mod.init_params(mc, jax.random.PRNGKey(0), dtype)
+                    )
                 )
                 shardings = param_shardings(proto, self.mesh)
-            self.params = load_checkpoint(mc, c.checkpoint_path, dtype, shardings)
+            self.params = load_checkpoint(
+                mc, c.checkpoint_path, dtype, shardings, quantize=c.quantize
+            )
         else:
-            self.params = self.mod.init_params(mc, jax.random.PRNGKey(0), dtype)
+            self.params = _maybe_quant(
+                self.mod.init_params(mc, jax.random.PRNGKey(0), dtype)
+            )
             if self.mesh is not None:
                 self.params = shard_params(self.params, self.mesh)
         if self.embedding_only:
@@ -365,7 +392,8 @@ class InferenceEngine:
                              tokens, active, sp, start, length, slot,
                              table_row, is_final):
             logits, cache = self.mod.prefill_chunk(
-                params, mc, prompt, start, length, cache, slot, table_row
+                params, mc, prompt, start, length, cache, slot, table_row,
+                mesh=self.mesh,
             )
             rl = sp.repeat_last_n[slot]
             window, wlen, counts = window_set_slot(
@@ -404,7 +432,7 @@ class InferenceEngine:
             def body(carry, _):
                 tokens, cache, counts, window, wlen, sp = carry
                 logits, cache = self.mod.decode_step(
-                    params, mc, tokens, cache, active
+                    params, mc, tokens, cache, active, mesh=self.mesh
                 )
                 sampled = sample_tokens(logits, sp, counts)
                 tokens = jnp.where(active, sampled, tokens)

@@ -65,19 +65,28 @@ def load_checkpoint(
     path: str,
     dtype=jnp.bfloat16,
     shardings: Any | None = None,
+    quantize: str | None = None,
 ) -> Any:
     """Load an HF checkpoint dir into our stacked-layer pytree.
 
     `shardings`: optional pytree (from parallel.param_shardings on params of
     the same structure) — each leaf is placed onto its sharding as soon as it
-    is assembled.
+    is assembled. `quantize="int8"`: matmul leaves are quantized HOST-side
+    (ops/quant.py) so the bf16 copy never reaches HBM — required for the
+    llama3:70b-on-v5e-8 memory budget (BASELINE config #3).
     """
     from gridllm_tpu.models import hf_layout
+    from gridllm_tpu.ops.quant import quantize_np_leaf
 
     idx = _open_safetensors(path)
 
     def place(pathkeys: tuple[str, ...], arr: np.ndarray):
-        out = jnp.asarray(arr, dtype)
+        if quantize == "int8":
+            out = quantize_np_leaf(pathkeys[-1], arr)
+            if not hasattr(out, "q"):
+                out = jnp.asarray(out, dtype)
+        else:
+            out = jnp.asarray(arr, dtype)
         if shardings is not None:
             s = shardings
             for k in pathkeys:

@@ -34,6 +34,7 @@ from gridllm_tpu.ops.kvcache import (
     write_decode_all,
     write_prefill_all,
 )
+from gridllm_tpu.ops.quant import qdot
 from gridllm_tpu.ops.layers import apply_rope, precompute_rope, rms_norm
 
 Params = dict[str, Any]
@@ -119,9 +120,9 @@ def init_params(
 
 def _mlp(lp: Params, x: jnp.ndarray) -> jnp.ndarray:
     p = _precision(x)
-    gate = jnp.dot(x, lp["w_gate"], precision=p)
-    up = jnp.dot(x, lp["w_up"], precision=p)
-    return jnp.dot(jax.nn.silu(gate) * up, lp["w_down"], precision=p)
+    gate = qdot(x, lp["w_gate"], precision=p)
+    up = qdot(x, lp["w_up"], precision=p)
+    return qdot(jax.nn.silu(gate) * up, lp["w_down"], precision=p)
 
 
 def _qkv(cfg: ModelConfig, lp: Params, x: jnp.ndarray):
@@ -133,9 +134,9 @@ def _qkv(cfg: ModelConfig, lp: Params, x: jnp.ndarray):
     """
     p = _precision(x)
     d = cfg.head_dim_
-    q = jnp.dot(x, lp["wq"], precision=p)
-    k = jnp.dot(x, lp["wk"], precision=p)
-    v = jnp.dot(x, lp["wv"], precision=p)
+    q = qdot(x, lp["wq"], precision=p)
+    k = qdot(x, lp["wk"], precision=p)
+    v = qdot(x, lp["wv"], precision=p)
     if cfg.attn_bias:
         q = q + lp["bq"]
         k = k + lp["bk"]
@@ -151,7 +152,7 @@ def _qkv(cfg: ModelConfig, lp: Params, x: jnp.ndarray):
 
 def _unembed(cfg: ModelConfig, params: Params, x: jnp.ndarray) -> jnp.ndarray:
     head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
-    return jnp.dot(
+    return qdot(
         x, head, precision=_precision(x), preferred_element_type=jnp.float32
     )
 
@@ -182,7 +183,7 @@ def hidden_states(
         q = apply_rope(q, pos, inv_freq)
         k = apply_rope(k, pos, inv_freq)
         att = attn(q, k, v, seq_lens).reshape(b, t, -1)
-        x = x + jnp.dot(att, lp["wo"], precision=_precision(x))
+        x = x + qdot(att, lp["wo"], precision=_precision(x))
         hx = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
         return x + mlp(lp, hx), None
 
@@ -252,7 +253,7 @@ def prefill(
         q = apply_rope(q, pos, inv_freq)
         k = apply_rope(k, pos, inv_freq)
         att = attn(q, k, v, seq_lens).reshape(1, t, -1)
-        x = seq_c(x + jnp.dot(att, lp["wo"], precision=_precision(x)))
+        x = seq_c(x + qdot(att, lp["wo"], precision=_precision(x)))
         hx = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
         # K/V ride out as scan ys; the pool is written ONCE after the scan
         # (per-layer writes inside the scan defeat XLA's in-place aliasing
@@ -288,6 +289,7 @@ def prefill_chunk(
     slot: jnp.ndarray,
     table_row: jnp.ndarray,
     mlp: MlpFn = _mlp,
+    mesh=None,  # accepted for family-API uniformity (MoE uses it)
 ) -> tuple[jnp.ndarray, PagedKVCache]:
     """Prefill ONE CHUNK of one slot against its cached prefix.
 
@@ -319,7 +321,7 @@ def prefill_chunk(
             q, cache.k, cache.v, table_row, start, total, cache.page_size,
             k_cur=k[0], v_cur=v[0], layer=li, use_pallas=cfg.use_pallas,
         ).reshape(1, t, -1)
-        x = x + jnp.dot(att, lp["wo"], precision=_precision(x))
+        x = x + qdot(att, lp["wo"], precision=_precision(x))
         hx = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
         return x + mlp(lp, hx), (k[0], v[0])
 
@@ -351,6 +353,7 @@ def decode_step(
     cache: PagedKVCache,
     active: jnp.ndarray,
     mlp: MlpFn = _mlp,
+    mesh=None,  # accepted for family-API uniformity (MoE uses it)
 ) -> tuple[jnp.ndarray, PagedKVCache]:
     """One decode step for ALL slots. tokens: [S] (last sampled token per
     slot), active: [S] bool. Returns (logits [S, V] fp32, updated cache
@@ -385,7 +388,7 @@ def decode_step(
             cache.page_size, k_cur=k, v_cur=v, layer=li,
             use_pallas=cfg.use_pallas,
         ).reshape(s, -1)
-        x = x + jnp.dot(attn, lp["wo"], precision=_precision(x))
+        x = x + qdot(attn, lp["wo"], precision=_precision(x))
         hx = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
         return x + mlp(lp, hx), (k, v)
 

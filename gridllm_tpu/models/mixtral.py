@@ -102,36 +102,121 @@ def _moe_mlp_ragged(cfg: ModelConfig, lp: Params, x: jnp.ndarray) -> jnp.ndarray
     return out.reshape(*lead, e)
 
 
-def _moe_mlp(cfg: ModelConfig, lp: Params, x: jnp.ndarray) -> jnp.ndarray:
+def _moe_mlp_ragged_ep(
+    cfg: ModelConfig, lp: Params, x: jnp.ndarray, mesh
+) -> jnp.ndarray:
+    """EP ragged dispatch under a mesh (VERDICT r03 next-round #7: the
+    meshed dense form paid X/top_k = 4× redundant expert FLOPs exactly
+    where EP matters — sharded prefill).
+
+    shard_map over ("ep", "tp"): each shard holds X/ep experts (their
+    gate/up/down slabs further split F-wise over tp), runs the SAME sorted
+    ragged_dot dispatch as the single-device path but over its LOCAL
+    expert range (assignments outside the range sort to the tail, get
+    group_sizes 0, and are zero-weighted — NaN-proofed before the
+    combine), then one psum over (ep, tp) merges expert contributions and
+    the tp partial sums in a single collective. Tokens are replicated into
+    the shard (activations are bytes; expert weights are the GBs), so the
+    only cross-device traffic is the output psum — an all-to-all token
+    exchange buys nothing at these activation sizes on ICI.
+
+    Per-shard row FLOPs: T·top_k/ep on average vs the dense form's T·X/ep
+    — the same 4× saving (8×7b, top_k=2) the single-device ragged path
+    gets, now under the mesh.
+    """
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    k = cfg.experts_per_token
+    lead = x.shape[:-1]
+    e = x.shape[-1]
+    xf = x.reshape(-1, e)
+    t = xf.shape[0]
+    # routing inputs are replicated — run the canonical _route ONCE
+    # outside the shard_map (keeps the HF routing numerics single-sourced)
+    top_w, top_i = _route(cfg, lp, xf)
+
+    def shard_fn(xf, top_w, top_i, wg, wu, wd):
+        xl = wg.shape[0]                       # local experts
+        lo = jax.lax.axis_index("ep") * xl
+        flat = top_i.reshape(-1)               # [T*k] global expert ids
+        tok = jnp.repeat(jnp.arange(t), k)
+        el = flat - lo
+        valid = (el >= 0) & (el < xl)
+        order = jnp.argsort(jnp.where(valid, el, xl))  # invalid → tail
+        rows = tok[order]
+        xs = xf[rows]
+        gs = jnp.bincount(
+            jnp.where(valid, el, xl), length=xl + 1
+        )[:xl].astype(jnp.int32)
+
+        g = jax.lax.ragged_dot(xs, wg, gs)
+        u = jax.lax.ragged_dot(xs, wu, gs)
+        y = (jax.nn.silu(g) * u).astype(xf.dtype)
+        d = jax.lax.ragged_dot(y, wd, gs)
+
+        vs = valid[order]
+        w = jnp.where(vs, top_w.reshape(-1)[order], 0.0).astype(xf.dtype)
+        d = jnp.where(vs[:, None], d, 0)       # rows past all groups
+        out = jnp.zeros((t, e), xf.dtype).at[rows].add(d * w[:, None])
+        return jax.lax.psum(out, ("ep", "tp"))
+
+    out = shard_map(
+        shard_fn, mesh=mesh,
+        in_specs=(P(), P(), P(), P("ep", None, "tp"), P("ep", None, "tp"),
+                  P("ep", "tp", None)),
+        out_specs=P(),
+    )(xf, top_w, top_i, lp["we_gate"], lp["we_up"], lp["we_down"])
+    return out.reshape(*lead, e)
+
+
+def _ragged_enabled() -> bool:
+    import os
+
+    raw = os.environ.get("GRIDLLM_MOE_RAGGED", "auto").lower()
+    if raw == "auto":
+        # CPU's ragged_dot lowering is a serial group loop, measured ~25%
+        # SLOWER than dense even at X=8 — the grouped matmul win is a
+        # TPU/Mosaic property. Env override lets tests force it on CPU.
+        return jax.default_backend() == "tpu"
+    return raw in ("1", "on", "true")
+
+
+def _moe_mlp(
+    cfg: ModelConfig, mesh, lp: Params, x: jnp.ndarray
+) -> jnp.ndarray:
     """Sparse-MoE FFN: x [..., E] → [..., E].
 
     lp carries router [E, X] and stacked experts we_gate/we_up [X, E, F],
     we_down [X, F, E] (the per-layer slice of the [L, X, ...] leaves).
 
-    Form selection (trace-time, static): ragged dispatch for prefill-sized
-    token counts on a single TPU device; dense all-experts everywhere else —
-    decode-sized batches (dispatch overhead dominates), meshed engines
-    (ragged_dot has no GSPMD partitioning rule; under "ep" the dense einsum
-    shards cleanly), and CPU (XLA's CPU ragged_dot lowering is a serial
-    group loop, measured ~25% SLOWER than dense even at X=8 — the grouped
-    matmul win is a TPU/Mosaic property). Env GRIDLLM_MOE_RAGGED=1/0
-    overrides the backend gate (tests force the ragged path on CPU).
+    Form selection (trace-time, static):
+    - meshed + prefill-sized tokens + divisible layout → shard_map EP
+      ragged dispatch (top_k-proportional FLOPs per shard);
+    - meshed otherwise (decode-sized batches, indivisible X/F) → dense
+      all-experts einsum (EP-shardable via GSPMD, no dynamic shapes);
+    - single device → sorted ragged_dot for prefill-sized counts on TPU,
+      dense for decode-sized counts and CPU.
     """
-    import os
-
     n_tokens = 1
     for s in x.shape[:-1]:
         n_tokens *= s
-    if cfg.use_pallas is False or n_tokens < _RAGGED_MIN_TOKENS:
-        # cfg.use_pallas False ⇔ engine runs under a mesh (engine.py sets
-        # it on its cfg copy) — keep the EP-shardable dense form there
+    if mesh is not None:
+        ep = mesh.shape.get("ep", 1)
+        tp = mesh.shape.get("tp", 1)
+        divisible = (
+            cfg.num_experts % ep == 0
+            and cfg.intermediate_size % tp == 0
+        )
+        if (n_tokens >= _RAGGED_MIN_TOKENS and divisible
+                and _ragged_enabled()):
+            return _moe_mlp_ragged_ep(cfg, lp, x, mesh)
         return _moe_mlp_dense(cfg, lp, x)
-    raw = os.environ.get("GRIDLLM_MOE_RAGGED", "auto").lower()
-    use_ragged = (
-        jax.default_backend() == "tpu" if raw == "auto"
-        else raw in ("1", "on", "true")
-    )
-    return _moe_mlp_ragged(cfg, lp, x) if use_ragged else _moe_mlp_dense(cfg, lp, x)
+    if cfg.use_pallas is False or n_tokens < _RAGGED_MIN_TOKENS:
+        return _moe_mlp_dense(cfg, lp, x)
+    if _ragged_enabled():
+        return _moe_mlp_ragged(cfg, lp, x)
+    return _moe_mlp_dense(cfg, lp, x)
 
 
 def init_params(cfg: ModelConfig, key: jax.Array, dtype=jnp.bfloat16) -> Params:
@@ -153,8 +238,8 @@ def init_params(cfg: ModelConfig, key: jax.Array, dtype=jnp.bfloat16) -> Params:
     return params
 
 
-def _mlp_for(cfg: ModelConfig):
-    return partial(_moe_mlp, cfg)
+def _mlp_for(cfg: ModelConfig, mesh=None):
+    return partial(_moe_mlp, cfg, mesh)
 
 
 def hidden_states(
@@ -162,14 +247,16 @@ def hidden_states(
     cfg: ModelConfig,
     tokens: jnp.ndarray,
     seq_lens: jnp.ndarray | None = None,
+    mesh=None,
 ) -> jnp.ndarray:
     return llama.hidden_states(
-        params, cfg, tokens, mlp=_mlp_for(cfg), seq_lens=seq_lens
+        params, cfg, tokens, mlp=_mlp_for(cfg, mesh), seq_lens=seq_lens
     )
 
 
-def forward(params: Params, cfg: ModelConfig, tokens: jnp.ndarray) -> jnp.ndarray:
-    return llama.forward(params, cfg, tokens, mlp=_mlp_for(cfg))
+def forward(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
+            mesh=None) -> jnp.ndarray:
+    return llama.forward(params, cfg, tokens, mlp=_mlp_for(cfg, mesh))
 
 
 def prefill(
@@ -185,7 +272,7 @@ def prefill(
 ) -> tuple[jnp.ndarray, PagedKVCache]:
     return llama.prefill(
         params, cfg, tokens, length, cache, slot, table_row,
-        mlp=_mlp_for(cfg), attn=attn, mesh=mesh,
+        mlp=_mlp_for(cfg, mesh), attn=attn, mesh=mesh,
     )
 
 
@@ -198,10 +285,11 @@ def prefill_chunk(
     cache: PagedKVCache,
     slot: jnp.ndarray,
     table_row: jnp.ndarray,
+    mesh=None,
 ) -> tuple[jnp.ndarray, PagedKVCache]:
     return llama.prefill_chunk(
         params, cfg, tokens, start, length, cache, slot, table_row,
-        mlp=_mlp_for(cfg),
+        mlp=_mlp_for(cfg, mesh),
     )
 
 
@@ -211,8 +299,11 @@ def decode_step(
     tokens: jnp.ndarray,
     cache: PagedKVCache,
     active: jnp.ndarray,
+    mesh=None,
 ) -> tuple[jnp.ndarray, PagedKVCache]:
-    return llama.decode_step(params, cfg, tokens, cache, active, mlp=_mlp_for(cfg))
+    return llama.decode_step(
+        params, cfg, tokens, cache, active, mlp=_mlp_for(cfg, mesh)
+    )
 
 
 # ---------------------------------------------------------------------------

@@ -77,7 +77,7 @@ def test_moe_mlp_matches_per_token_brute_force(params_fp32):
     gating bugs (dropped renormalization, wrong combine) without torch."""
     x = jax.random.normal(jax.random.PRNGKey(2), (5, CFG.hidden_size), jnp.float32)
     lp = jax.tree.map(lambda a: a[0], params_fp32["layers"])
-    got = np.asarray(mixtral._moe_mlp(CFG, lp, x))
+    got = np.asarray(mixtral._moe_mlp(CFG, None, lp, x))
 
     def silu(a):
         return a / (1.0 + np.exp(-a))
@@ -188,3 +188,59 @@ def test_ragged_dispatch_through_full_model(monkeypatch):
     dense = InferenceEngine(EngineConfig(**kw)).generate(
         GenerationRequest(id="d", prompt="hello world test", options=opts))
     assert ragged.token_ids == dense.token_ids
+
+
+def test_meshed_ep_ragged_matches_dense(monkeypatch):
+    """VERDICT r03 #7: under a mesh the MoE must not pay the 4× dense tax.
+    The shard_map EP ragged dispatch must match the dense all-experts form
+    numerically (fp32, 8-device CPU mesh with ep=2 × tp=2)."""
+    import numpy as np
+    from gridllm_tpu.models import mixtral
+    from gridllm_tpu.models.configs import get_config
+    from gridllm_tpu.parallel.mesh import MeshConfig, build_mesh
+    from gridllm_tpu.parallel.sharding import shard_params
+
+    cfg = get_config("tiny-mixtral")
+    mesh = build_mesh(MeshConfig(dp=2, tp=2, ep=2))
+    params = mixtral.init_params(cfg, jax.random.PRNGKey(5), jnp.float32)
+    lp = {k: v[0] for k, v in params["layers"].items()
+          if k in ("router", "we_gate", "we_up", "we_down")}
+    x = jax.random.normal(jax.random.PRNGKey(6), (1, 32, cfg.hidden_size),
+                          jnp.float32)
+
+    dense = mixtral._moe_mlp_dense(cfg, lp, x)
+    monkeypatch.setenv("GRIDLLM_MOE_RAGGED", "1")
+    with mesh:
+        ragged = mixtral._moe_mlp(cfg, mesh, lp, x)
+    np.testing.assert_allclose(
+        np.asarray(ragged), np.asarray(dense), rtol=2e-4, atol=2e-4,
+    )
+
+
+def test_meshed_moe_selects_ragged_for_prefill(monkeypatch):
+    """Gate logic: meshed + prefill-sized tokens + divisible layout +
+    ragged enabled → the EP shard_map path (not dense)."""
+    from unittest import mock
+    from gridllm_tpu.models import mixtral
+    from gridllm_tpu.models.configs import get_config
+    from gridllm_tpu.parallel.mesh import MeshConfig, build_mesh
+
+    cfg = get_config("tiny-mixtral")
+    mesh = build_mesh(MeshConfig(dp=2, tp=2, ep=2))
+    params = mixtral.init_params(cfg, jax.random.PRNGKey(5), jnp.float32)
+    lp = {k: v[0] for k, v in params["layers"].items()
+          if k in ("router", "we_gate", "we_up", "we_down")}
+    x = jnp.zeros((1, 32, cfg.hidden_size), jnp.float32)
+    monkeypatch.setenv("GRIDLLM_MOE_RAGGED", "1")
+    with mock.patch.object(
+        mixtral, "_moe_mlp_ragged_ep", wraps=mixtral._moe_mlp_ragged_ep
+    ) as spy:
+        with mesh:
+            mixtral._moe_mlp(cfg, mesh, lp, x)
+        assert spy.called
+    # decode-sized batch stays dense under the mesh
+    xs = jnp.zeros((4, cfg.hidden_size), jnp.float32)
+    with mock.patch.object(mixtral, "_moe_mlp_ragged_ep") as spy2:
+        with mesh:
+            mixtral._moe_mlp(cfg, mesh, lp, xs)
+        assert not spy2.called
