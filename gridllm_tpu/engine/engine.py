@@ -231,6 +231,20 @@ class InferenceEngine:
         self._work = threading.Condition()
         self._runner: threading.Thread | None = None
         self._runner_stop = threading.Event()
+        # Multi-host SPMD (SURVEY §5.8b): in a worker group every process
+        # must issue the SAME jitted computations in the same order or the
+        # first cross-host collective deadlocks. The liaison's engine
+        # emits one record per device-dispatching action (admit / block /
+        # deact / reset) through `plan_sink`; follower engines replay them
+        # via apply_plan_op. All record payloads are plain host data
+        # (token ids, page rows, resolved sampler values incl. the seed),
+        # so replay is bit-identical. `dispatch_lock` makes (emission,
+        # dispatch) atomic; worker/main.py shares ONE lock across all of a
+        # slice's engines so the liaison's cross-engine dispatch order
+        # equals the plan order followers replay (embed dispatches from
+        # the executor thread serialize through it too).
+        self.plan_sink: Callable[[dict[str, Any]], None] | None = None
+        self.dispatch_lock: threading.RLock = threading.RLock()
         self._load()
         self._build_fns()
 
@@ -330,10 +344,13 @@ class InferenceEngine:
         abort_all() first — slot state is discarded here."""
         if self.embedding_only:
             return
-        self._slots.clear()
-        self._inflight.clear()
-        self._free_slots = list(range(self.config.max_slots - 1, -1, -1))
-        self._init_device_state()
+        with self.dispatch_lock:
+            if self.plan_sink is not None:
+                self.plan_sink({"op": "reset"})
+            self._slots.clear()
+            self._inflight.clear()
+            self._free_slots = list(range(self.config.max_slots - 1, -1, -1))
+            self._init_device_state()
 
     def _build_fns(self) -> None:
         mc = self.cfg
@@ -566,15 +583,33 @@ class InferenceEngine:
             "seed": int(seed) & 0x7FFFFFFF,
             "step": 0,
         }
+        row_list = self.alloc.table_row(slot)
+        t0 = time.perf_counter_ns()
+        with self.dispatch_lock:
+            if self.plan_sink is not None:
+                self.plan_sink({"op": "admit", "slot": slot, "ids": ids,
+                                "row": row_list, "sp": upd})
+            self._dispatch_prefill(slot, ids, row_list, upd)
+        # dispatch wall time only — the prefill runs asynchronously and its
+        # sampled token first becomes host-visible in the next block fetch;
+        # t_prefill_ns is finalized there (admission → first-token)
+        st.t_prefill_ns = time.perf_counter_ns() - t0
+        st.joined_gen = self._gen + 1  # first block dispatched after this
+        self._slots[slot] = st
+        return True
+
+    def _dispatch_prefill(self, slot: int, ids: list[int],
+                          row_list: list[int], upd: dict[str, Any]) -> None:
+        """The device half of admission — everything a multi-host follower
+        must replay identically: sampler row update + prefill dispatch.
+        All inputs are plain host values (the admit plan record)."""
         self.sampling = SamplingParams(**{
             f.name: getattr(self.sampling, f.name).at[slot].set(upd[f.name])
             for f in dataclasses.fields(SamplingParams)
         })
         # counts[slot] is cleared INSIDE prefill_fn / prefill_chunk_fn —
         # no host-side clear here (it would be a dead full-row rewrite)
-
-        row = jnp.asarray(self.alloc.table_row(slot), jnp.int32)
-        t0 = time.perf_counter_ns()
+        row = jnp.asarray(row_list, jnp.int32)
         if self._use_chunked and len(ids) > self._chunk_len:
             # chunked prefill: repeated invocations of ONE fixed-shape
             # program against the growing cached prefix — no per-length
@@ -603,13 +638,31 @@ class InferenceEngine:
                 self.window, self.wlen, self.tokens, self.active,
                 self.sampling, jnp.int32(len(ids)), jnp.int32(slot), row,
             )
-        # dispatch wall time only — the prefill runs asynchronously and its
-        # sampled token first becomes host-visible in the next block fetch;
-        # t_prefill_ns is finalized there (admission → first-token)
-        st.t_prefill_ns = time.perf_counter_ns() - t0
-        st.joined_gen = self._gen + 1  # first block dispatched after this
-        self._slots[slot] = st
-        return True
+
+    def apply_plan_op(self, rec: dict[str, Any]) -> None:
+        """Follower-side replay of one liaison plan record (multi-host
+        SPMD lockstep — see plan_sink). Must be called in record order
+        from ONE thread. Followers never fetch results; their dispatches
+        pace themselves against the shared collectives."""
+        op = rec["op"]
+        if op == "admit":
+            self._dispatch_prefill(
+                int(rec["slot"]), [int(i) for i in rec["ids"]],
+                [int(p) for p in rec["row"]], dict(rec["sp"]),
+            )
+        elif op == "block":
+            self._dispatch_block(int(rec["k"]))
+            self._inflight.clear()  # replay never fetches
+        elif op == "deact":
+            self.active = self.active.at[int(rec["slot"])].set(False)
+        elif op == "embed":
+            tok = jnp.asarray(np.asarray(rec["tok"], np.int32))
+            lens = jnp.asarray(np.asarray(rec["lens"], np.int32))
+            self._embed_fn(self.params, tok, lens)  # result unused
+        elif op == "reset":
+            self.reset_device_state()
+        else:
+            raise ValueError(f"unknown plan op: {op!r}")
 
     # ------------------------------------------------------------ stepping
 
@@ -668,7 +721,10 @@ class InferenceEngine:
             load_duration_ns=self.load_duration_ns,
             total_duration_ns=now - st.t_start,
         )
-        self.active = self.active.at[slot].set(False)
+        with self.dispatch_lock:
+            if self.plan_sink is not None:
+                self.plan_sink({"op": "deact", "slot": slot})
+            self.active = self.active.at[slot].set(False)
         self.alloc.free(slot)
         del self._slots[slot]
         self._free_slots.append(slot)
@@ -677,13 +733,16 @@ class InferenceEngine:
 
     def _dispatch_block(self, k: int) -> None:
         """Dispatch one fused k-step decode block (no host sync)."""
-        self._gen += 1
-        (out, self.tokens, self.cache, self.counts, self.window, self.wlen,
-         self.sampling) = self._decode_block_fn(
-            self.params, self.cache, self.tokens, self.active,
-            self.counts, self.window, self.wlen, self.sampling, k=k,
-        )
-        self._inflight.append((self._gen, out, k))
+        with self.dispatch_lock:
+            if self.plan_sink is not None:
+                self.plan_sink({"op": "block", "k": k})
+            self._gen += 1
+            (out, self.tokens, self.cache, self.counts, self.window,
+             self.wlen, self.sampling) = self._decode_block_fn(
+                self.params, self.cache, self.tokens, self.active,
+                self.counts, self.window, self.wlen, self.sampling, k=k,
+            )
+            self._inflight.append((self._gen, out, k))
 
     def _ingest_block(self, gen: int, tok_np: np.ndarray) -> None:
         """Feed one fetched [k+1, S] token block through per-token
@@ -885,8 +944,20 @@ class InferenceEngine:
                     ids = enc[i]
                     tok[j, : len(ids)] = ids
                     lens[j] = max(len(ids), 1)
-                lens_j = jnp.asarray(lens)
-                h = self._embed_fn(self.params, jnp.asarray(tok), lens_j)
+                # multi-host: the embed forward is a sharded program too —
+                # it must enter the slice's serialized plan stream or its
+                # collectives deadlock (embed runs on the executor thread,
+                # so the shared dispatch_lock is what pins its position
+                # relative to the runner's decode blocks)
+                with self.dispatch_lock:
+                    if self.plan_sink is not None:
+                        self.plan_sink({
+                            "op": "embed",
+                            "tok": tok.tolist(),
+                            "lens": lens.tolist(),
+                        })
+                    lens_j = jnp.asarray(lens)
+                    h = self._embed_fn(self.params, jnp.asarray(tok), lens_j)
                 vecs = np.asarray(pool(h, lens_j, self.cfg.pooling), np.float32)
                 for j, i in enumerate(group):
                     out[i] = vecs[j].tolist()

@@ -22,6 +22,12 @@ from gridllm_tpu.utils.config import Config, load_config
 from gridllm_tpu.utils.logging import get_logger
 from gridllm_tpu.utils.types import iso_now
 from gridllm_tpu.worker.capabilities import system_resources
+from gridllm_tpu.worker.plan import (
+    PlanFollower,
+    PlanPublisher,
+    plan_channel,
+    ready_key,
+)
 from gridllm_tpu.worker.service import WorkerService
 
 log = get_logger("worker.main")
@@ -159,6 +165,36 @@ async def run(config: Config | None = None) -> None:
             on_slice_failure=on_slice_failure,
         )
         await membership.start()
+        # multi-host SPMD: broadcast every device-dispatching action so
+        # followers issue the same computations (worker/plan.py; VERDICT
+        # r03 missing #1 — liaison-only dispatch deadlocks the collectives)
+        publishers: list[PlanPublisher] = []
+        if group.is_group:
+            import threading
+
+            loop = asyncio.get_running_loop()
+            pub = PlanPublisher(bus, plan_channel(service.worker_id), loop)
+            pub.start()
+            publishers.append(pub)
+            # ONE dispatch lock across every engine: the liaison's
+            # cross-engine dispatch order must equal the plan order
+            shared_lock = threading.RLock()
+            for model, eng in engines.items():
+                eng.dispatch_lock = shared_lock
+                eng.plan_sink = (
+                    lambda rec, m=model: pub.sink({**rec, "model": m})
+                )
+            # barrier: every follower's plan subscription must be LIVE
+            # before the first job can be assigned — pub/sub has no replay
+            for pid in range(1, group.num_processes):
+                for _ in range(1200):
+                    if await bus.get(ready_key(service.worker_id, pid)):
+                        break
+                    await asyncio.sleep(0.1)
+                else:
+                    raise SystemExit(
+                        f"slice follower {pid} never became plan-ready"
+                    )
         await service.start()
         app = build_health_app(service)
         runner = web.AppRunner(app)
@@ -171,6 +207,8 @@ async def run(config: Config | None = None) -> None:
         finally:
             await membership.stop()
             await service.stop()
+            for pub in publishers:
+                await pub.stop()
             await runner.cleanup()
             await bus.disconnect()
             if slice_broken:
@@ -180,7 +218,11 @@ async def run(config: Config | None = None) -> None:
                 os._exit(1)
             shutdown_group(group)
     else:
-        # follower: participate in the jax group; exit when the slice breaks
+        # follower: build the SAME engines (identical jit programs over the
+        # global mesh) and replay the liaison's step plan — every process
+        # must issue the same computation or the collectives deadlock
+        engines = build_engines(config)
+
         async def on_slice_failure(reason: str) -> None:
             slice_broken.append(reason)
             stop.set()
@@ -191,9 +233,19 @@ async def run(config: Config | None = None) -> None:
             on_slice_failure=on_slice_failure,
         )
         await membership.start()
+        follower = PlanFollower(
+            bus, plan_channel(config.worker.worker_id), engines,
+            on_divergence=on_slice_failure,
+        )
+        await follower.start()
+        # signal the liaison this process can hear the plan (it holds
+        # registration until every follower is ready)
+        await bus.set(ready_key(config.worker.worker_id, group.process_id), "1")
+        log.info("follower replaying step plan", models=list(engines))
         try:
             await stop.wait()
         finally:
+            await follower.stop()
             await membership.stop()
             await bus.disconnect()
             if slice_broken:

@@ -1,0 +1,168 @@
+"""Multi-host step-plan transport: liaison → followers over the bus.
+
+Round-3 VERDICT missing #1: followers joined the jax group and then just
+waited — but JAX multi-controller SPMD requires EVERY process to issue the
+same computation, so a mesh spanning hosts with liaison-only dispatch
+deadlocks on the first collective. This module closes the loop:
+
+- The liaison's engines emit one compact record per device-dispatching
+  action (engine.plan_sink: admit / block / deact / embed / reset — all
+  plain host data). PlanPublisher stamps a sequence number and publishes
+  them on ONE per-worker channel ``slice:{worker_id}:plan`` with the
+  model name attached — a single totally-ordered stream, because a
+  multi-model slice's engines all dispatch into the same global mesh and
+  cross-model dispatch order must match across processes (the engines'
+  shared dispatch_lock makes the liaison's emission order equal its
+  dispatch order). The records ride the SAME bus the job protocol uses
+  (SURVEY §5.8's two-plane design: bus for control, ICI/XLA collectives
+  for array traffic).
+- PlanFollower (on every non-liaison process) subscribes, checks the
+  sequence is gapless (bus pub/sub has no replay: one lost record means
+  irrecoverable divergence → fail the slice fast so the supervisor
+  restarts it together), and replays each record through
+  engine.apply_plan_op on a dedicated thread — the follower's analogue
+  of the liaison's runner thread.
+
+Latency: a record crosses the bus in ~ms while a decode block occupies
+the devices for tens of ms, and dispatch is asynchronous on every
+process — the collectives themselves rendezvous the slice, so follower
+lag never stalls the liaison until it exceeds the device queue depth.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import queue
+import threading
+from typing import Awaitable, Callable
+
+from gridllm_tpu.bus.base import MessageBus, Subscription
+from gridllm_tpu.engine import InferenceEngine
+from gridllm_tpu.utils.logging import get_logger
+
+log = get_logger("worker.plan")
+
+
+def plan_channel(worker_id: str) -> str:
+    return f"slice:{worker_id}:plan"
+
+
+def ready_key(worker_id: str, process_id: int) -> str:
+    """Bus key a follower sets once its plan subscription is LIVE — the
+    liaison must not register (and start taking jobs) before every
+    follower can hear the plan, or the first records land on a channel
+    with no subscribers (pub/sub has no replay) and the slice diverges
+    at startup."""
+    return f"slice:{worker_id}:ready:{process_id}"
+
+
+class PlanPublisher:
+    """Liaison side: engine.plan_sink → ordered bus publishes.
+
+    The sink is called from the engine's runner thread; records are
+    queued thread-safely and drained by ONE async task so wire order
+    always equals emission order (a create_task per publish could
+    interleave at await points)."""
+
+    def __init__(self, bus: MessageBus, channel: str,
+                 loop: asyncio.AbstractEventLoop):
+        self.bus = bus
+        self.channel = channel
+        self._loop = loop
+        self._seq = 0
+        self._q: asyncio.Queue[str] = asyncio.Queue()
+        self._task: asyncio.Task | None = None
+
+    def start(self) -> None:
+        self._task = self._loop.create_task(self._drain())
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None
+
+    def sink(self, rec: dict) -> None:
+        """engine.plan_sink entry — runner-thread safe."""
+        self._seq += 1
+        msg = json.dumps({"seq": self._seq, "rec": rec})
+        self._loop.call_soon_threadsafe(self._q.put_nowait, msg)
+
+    async def _drain(self) -> None:
+        while True:
+            msg = await self._q.get()
+            try:
+                await self.bus.publish(self.channel, msg)
+            except Exception as e:  # noqa: BLE001 — bus hiccup: keep order,
+                log.error("plan publish failed", error=str(e))
+                # a dropped record breaks lockstep; followers detect the
+                # seq gap and fail the slice — nothing useful to do here
+
+
+class PlanFollower:
+    """Follower side: bus records → engine.apply_plan_op, in order, on ONE
+    dedicated replay thread across all of the worker's models (total
+    order matches the liaison's shared dispatch lock)."""
+
+    def __init__(self, bus: MessageBus, channel: str,
+                 engines: dict[str, InferenceEngine],
+                 on_divergence: Callable[[str], Awaitable[None]]):
+        self.bus = bus
+        self.channel = channel
+        self.engines = engines
+        self.on_divergence = on_divergence
+        self.applied = 0
+        self._expected = 1
+        self._sub: Subscription | None = None
+        self._q: queue.Queue = queue.Queue()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._loop: asyncio.AbstractEventLoop | None = None
+
+    async def start(self) -> None:
+        self._loop = asyncio.get_running_loop()
+        self._thread = threading.Thread(
+            target=self._replay, name="plan-replay", daemon=True,
+        )
+        self._thread.start()
+        self._sub = await self.bus.subscribe(self.channel, self._on_msg)
+
+    async def stop(self) -> None:
+        if self._sub is not None:
+            await self._sub.unsubscribe()
+            self._sub = None
+        self._stop.set()
+        self._q.put(None)
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+
+    async def _on_msg(self, _ch: str, raw: str) -> None:
+        d = json.loads(raw)
+        if d["seq"] != self._expected:
+            await self.on_divergence(
+                f"plan sequence gap: expected {self._expected}, "
+                f"got {d['seq']} (lost record → SPMD divergence)"
+            )
+            return
+        self._expected += 1
+        self._q.put(d["rec"])
+
+    def _replay(self) -> None:
+        while not self._stop.is_set():
+            rec = self._q.get()
+            if rec is None:
+                return
+            try:
+                eng = self.engines[rec["model"]]
+                eng.apply_plan_op(rec)
+                self.applied += 1
+            except Exception as e:  # noqa: BLE001
+                log.error("plan replay failed", op=rec.get("op"),
+                          error=str(e))
+                if self._loop is not None:
+                    asyncio.run_coroutine_threadsafe(
+                        self.on_divergence(f"plan replay failed: {e}"),
+                        self._loop,
+                    )
+                return
