@@ -45,7 +45,10 @@ EMBED_BASELINE_QPS = {
 
 
 async def run_bench(model: str, n_requests: int, n_tokens: int,
-                    max_slots: int, prompt_len: int) -> dict:
+                    max_slots: int, prompt_len: int,
+                    profile_dir: str | None = None) -> dict:
+    import os
+
     import aiohttp
     from aiohttp.test_utils import TestClient, TestServer
 
@@ -54,10 +57,20 @@ async def run_bench(model: str, n_requests: int, n_tokens: int,
     from gridllm_tpu.gateway.app import create_app
     from gridllm_tpu.scheduler import JobScheduler, WorkerRegistry
     from gridllm_tpu.utils.config import Config, WorkerConfig
+    from gridllm_tpu.worker.main import resolve_checkpoint
     from gridllm_tpu.worker.service import WorkerService
 
+    # bench honesty (VERDICT r03 weak #4): with no checkpoint the run uses
+    # random weights + the byte tokenizer (representative compute,
+    # unrepresentative tokenization) and the metric string says so. Same
+    # resolution logic as the worker entrypoint — one source of truth.
+    ckpt, tok = resolve_checkpoint(
+        os.environ.get("GRIDLLM_CHECKPOINT_DIR"), model
+    )
     engine = InferenceEngine(EngineConfig(
         model=model,
+        checkpoint_path=ckpt,
+        tokenizer=tok,
         max_slots=max_slots,
         page_size=64,
         num_pages=max(256, max_slots * 48),
@@ -93,6 +106,14 @@ async def run_bench(model: str, n_requests: int, n_tokens: int,
     ttfts: list[float] = []
     tokens_out = [0]
 
+    if profile_dir:
+        # SURVEY §5.1 / VERDICT r03 #1: capture a device trace of the
+        # measured window for op-level attribution (view with
+        # tensorboard --logdir or xprof)
+        import jax
+
+        jax.profiler.start_trace(profile_dir)
+
     async def one(i: int) -> None:
         t0 = time.perf_counter()
         first = True
@@ -112,7 +133,13 @@ async def run_bench(model: str, n_requests: int, n_tokens: int,
                     tokens_out[0] += frame.get("eval_count") or 0
 
     t_start = time.perf_counter()
-    await asyncio.gather(*(one(i) for i in range(n_requests)))
+    try:
+        await asyncio.gather(*(one(i) for i in range(n_requests)))
+    finally:
+        if profile_dir:  # finalize the trace even when a request fails
+            import jax
+
+            jax.profiler.stop_trace()
     wall = time.perf_counter() - t_start
 
     await client.close()
@@ -126,6 +153,7 @@ async def run_bench(model: str, n_requests: int, n_tokens: int,
         "p50_ttft_ms": statistics.median(ttfts) * 1000,
         "tokens": tokens_out[0],
         "wall_s": wall,
+        "weights": "real-checkpoint" if ckpt else "random-weights synthetic",
     }
 
 
@@ -239,6 +267,9 @@ def main() -> int:
                     help="embeddings QPS bench (BASELINE config #5)")
     ap.add_argument("--tiny", action="store_true",
                     help="tiny-llama CPU smoke test")
+    ap.add_argument("--profile", metavar="DIR", default=None,
+                    help="capture a jax.profiler trace of the measured "
+                         "window into DIR (SURVEY §5.1)")
     args = ap.parse_args()
     if args.embed and args.model == ap.get_default("model"):
         args.model = "all-minilm"
@@ -273,7 +304,7 @@ def main() -> int:
                 f"degraded: cpu fallback, {requested} replaced with {args.model}"
             )
 
-    metric_name = (
+    metric_name = (  # provisional — refined with weights provenance below
         f"embeddings/sec via /ollama/api/embed ({args.model})" if args.embed
         else f"output tokens/sec via /ollama/api/generate ({args.model}, "
              f"{args.requests} concurrent streams)"
@@ -286,10 +317,17 @@ def main() -> int:
         else:
             r = asyncio.run(run_bench(
                 args.model, args.requests, args.tokens, args.slots,
-                args.prompt_len,
+                args.prompt_len, profile_dir=args.profile,
             ))
             baseline = A100_OLLAMA_TOK_S.get(args.model, 0.0)
             value, unit = r["tok_s"], "tok/s"
+            # the weights provenance lives IN the metric string so a
+            # synthetic number can never be misread as a real-model one
+            # (VERDICT r03 weak #4)
+            metric_name = (
+                f"output tokens/sec via /ollama/api/generate ({args.model}, "
+                f"{args.requests} concurrent streams, {r['weights']})"
+            )
     except BaseException as e:  # noqa: BLE001 — the JSON line must survive anything
         import traceback
 

@@ -345,12 +345,12 @@ class InferenceEngine:
         if self.embedding_only:
             return
         with self.dispatch_lock:
-            if self.plan_sink is not None:
-                self.plan_sink({"op": "reset"})
             self._slots.clear()
             self._inflight.clear()
             self._free_slots = list(range(self.config.max_slots - 1, -1, -1))
             self._init_device_state()
+            if self.plan_sink is not None:  # after-success; see _try_admit
+                self.plan_sink({"op": "reset"})
 
     def _build_fns(self) -> None:
         mc = self.cfg
@@ -586,10 +586,16 @@ class InferenceEngine:
         row_list = self.alloc.table_row(slot)
         t0 = time.perf_counter_ns()
         with self.dispatch_lock:
+            # emit AFTER the dispatch succeeds: a record for a program the
+            # liaison never actually issued would make followers replay a
+            # phantom computation and silently desync the slice. (If a
+            # MULTI-chunk prefill fails partway, the liaison's own stream
+            # is already unpaired and the slice-failure machinery tears the
+            # group down — there is no cheap reconciliation for that.)
+            self._dispatch_prefill(slot, ids, row_list, upd)
             if self.plan_sink is not None:
                 self.plan_sink({"op": "admit", "slot": slot, "ids": ids,
                                 "row": row_list, "sp": upd})
-            self._dispatch_prefill(slot, ids, row_list, upd)
         # dispatch wall time only — the prefill runs asynchronously and its
         # sampled token first becomes host-visible in the next block fetch;
         # t_prefill_ns is finalized there (admission → first-token)
@@ -722,9 +728,9 @@ class InferenceEngine:
             total_duration_ns=now - st.t_start,
         )
         with self.dispatch_lock:
-            if self.plan_sink is not None:
-                self.plan_sink({"op": "deact", "slot": slot})
             self.active = self.active.at[slot].set(False)
+            if self.plan_sink is not None:  # after-success; see _try_admit
+                self.plan_sink({"op": "deact", "slot": slot})
         self.alloc.free(slot)
         del self._slots[slot]
         self._free_slots.append(slot)
@@ -734,8 +740,6 @@ class InferenceEngine:
     def _dispatch_block(self, k: int) -> None:
         """Dispatch one fused k-step decode block (no host sync)."""
         with self.dispatch_lock:
-            if self.plan_sink is not None:
-                self.plan_sink({"op": "block", "k": k})
             self._gen += 1
             (out, self.tokens, self.cache, self.counts, self.window,
              self.wlen, self.sampling) = self._decode_block_fn(
@@ -743,6 +747,8 @@ class InferenceEngine:
                 self.counts, self.window, self.wlen, self.sampling, k=k,
             )
             self._inflight.append((self._gen, out, k))
+            if self.plan_sink is not None:  # after-success; see _try_admit
+                self.plan_sink({"op": "block", "k": k})
 
     def _ingest_block(self, gen: int, tok_np: np.ndarray) -> None:
         """Feed one fetched [k+1, S] token block through per-token
@@ -950,14 +956,14 @@ class InferenceEngine:
                 # so the shared dispatch_lock is what pins its position
                 # relative to the runner's decode blocks)
                 with self.dispatch_lock:
-                    if self.plan_sink is not None:
+                    lens_j = jnp.asarray(lens)
+                    h = self._embed_fn(self.params, jnp.asarray(tok), lens_j)
+                    if self.plan_sink is not None:  # after-success
                         self.plan_sink({
                             "op": "embed",
                             "tok": tok.tolist(),
                             "lens": lens.tolist(),
                         })
-                    lens_j = jnp.asarray(lens)
-                    h = self._embed_fn(self.params, jnp.asarray(tok), lens_j)
                 vecs = np.asarray(pool(h, lens_j, self.cfg.pooling), np.float32)
                 for j, i in enumerate(group):
                     out[i] = vecs[j].tolist()

@@ -33,6 +33,20 @@ from gridllm_tpu.worker.service import WorkerService
 log = get_logger("worker.main")
 
 
+def resolve_checkpoint(root: str | None, model: str) -> tuple[str | None, str | None]:
+    """(checkpoint_path, tokenizer_path) for `model` under a checkpoint
+    root: weights at {root}/{name-with-:-replaced-by-_}, tokenizer either
+    in a tokenizer/ subdir or alongside the weights. Single source of
+    truth — bench.py resolves real-checkpoint runs through this too."""
+    if not root:
+        return None, None
+    cand = os.path.join(root, model.replace(":", "_"))
+    if not os.path.isdir(cand):
+        return None, None
+    tok_sub = os.path.join(cand, "tokenizer")
+    return cand, tok_sub if os.path.isdir(tok_sub) else cand
+
+
 def build_engines(config: Config) -> dict[str, InferenceEngine]:
     engines: dict[str, InferenceEngine] = {}
     names = [m.strip() for m in config.engine.models.split(",") if m.strip()]
@@ -43,20 +57,14 @@ def build_engines(config: Config) -> dict[str, InferenceEngine]:
         )
         mesh = MeshConfig(**{k: int(v) for k, v in axes.items()})
     for name in names:
-        ckpt = None
-        if config.engine.checkpoint_dir:
-            cand = os.path.join(
-                config.engine.checkpoint_dir, name.replace(":", "_")
-            )
-            ckpt = cand if os.path.isdir(cand) else None
+        ckpt, tok = resolve_checkpoint(config.engine.checkpoint_dir, name)
         buckets = tuple(
             int(b) for b in config.engine.prefill_buckets.split(",") if b
         )
         engines[name] = InferenceEngine(EngineConfig(
             model=name,
             checkpoint_path=ckpt,
-            tokenizer=os.path.join(ckpt, "tokenizer") if ckpt and os.path.isdir(
-                os.path.join(ckpt, "tokenizer")) else (ckpt if ckpt else None),
+            tokenizer=tok,
             dtype=config.engine.dtype,
             max_slots=config.engine.max_batch_slots,
             page_size=config.engine.kv_page_size,
@@ -238,13 +246,26 @@ async def run(config: Config | None = None) -> None:
             on_divergence=on_slice_failure,
         )
         await follower.start()
+
         # signal the liaison this process can hear the plan (it holds
-        # registration until every follower is ready)
-        await bus.set(ready_key(config.worker.worker_id, group.process_id), "1")
+        # registration until every follower is ready). TTL + refresh, NOT
+        # a plain set: a persistent key from a previous slice incarnation
+        # would let a restarted liaison pass the barrier while this
+        # process is still building engines — publishing to a channel
+        # with no subscriber (pub/sub has no replay).
+        rk = ready_key(config.worker.worker_id, group.process_id)
+
+        async def refresh_ready() -> None:
+            while True:
+                await bus.set_with_expiry(rk, "1", ttl_s=10.0)
+                await asyncio.sleep(3.0)
+
+        ready_task = asyncio.create_task(refresh_ready())
         log.info("follower replaying step plan", models=list(engines))
         try:
             await stop.wait()
         finally:
+            ready_task.cancel()
             await follower.stop()
             await membership.stop()
             await bus.disconnect()

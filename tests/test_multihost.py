@@ -123,11 +123,20 @@ async def test_multihost_slice_serves_generate():
     env = {**os.environ, "PYTHONPATH": str(CHILD.parent.parent)}
     env.pop("XLA_FLAGS", None)
 
+    import tempfile
+
+    logdir = tempfile.mkdtemp(prefix="mh_serve_")
+    logs = {}
+
     def spawn(pid: int) -> subprocess.Popen:
+        # log to FILES, not pipes: an undrained pipe fills its ~64KB buffer
+        # and blocks the child mid-serving, hanging the test instead of
+        # failing it with diagnostics
+        logs[pid] = open(os.path.join(logdir, f"child{pid}.log"), "w+")
         return subprocess.Popen(
             [sys.executable, str(SERVE_CHILD), str(pid), str(coord_port),
              str(broker.port), worker_id, str(_free_port())],
-            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            env=env, stdout=logs[pid], stderr=subprocess.STDOUT,
             text=True,
         )
 
@@ -153,15 +162,15 @@ async def test_multihost_slice_serves_generate():
                 break
             await asyncio.sleep(0.1)
         else:
-            out = ""
-            if liaison.poll() is not None:
-                out = liaison.communicate(timeout=5)[0]
-            pytest.fail(f"slice worker never registered; liaison: {out[-2000:]}")
+            logs[0].flush()
+            logs[0].seek(0)
+            pytest.fail("slice worker never registered; liaison: "
+                        + logs[0].read()[-2000:])
 
-        resp = await client.post("/ollama/api/generate", json={
+        resp = await asyncio.wait_for(client.post("/ollama/api/generate", json={
             "model": "tiny-llama", "prompt": "hello slice", "stream": False,
             "options": {"temperature": 0, "num_predict": 6},
-        })
+        }), timeout=120)
         body = await resp.json()
         assert resp.status == 200, body
         assert body["done"] is True
@@ -172,16 +181,18 @@ async def test_multihost_slice_serves_generate():
         # non-replaying follower would deadlock the first collective and
         # the request would never complete. A second request asserts the
         # lockstep survives sustained serving (slot reuse, fresh admit).
-        resp2 = await client.post("/ollama/api/generate", json={
+        resp2 = await asyncio.wait_for(client.post("/ollama/api/generate", json={
             "model": "tiny-llama", "prompt": "again", "stream": False,
             "options": {"temperature": 0, "num_predict": 4},
-        })
+        }), timeout=120)
         body2 = await resp2.json()
         assert resp2.status == 200 and body2["eval_count"] == 4
     finally:
         for p in (liaison, follower):
             if p.poll() is None:
                 p.kill()
+        for f in logs.values():
+            f.close()
         await client.close()
         await scheduler.shutdown()
         await registry.shutdown()
