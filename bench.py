@@ -273,6 +273,10 @@ def main() -> int:
     args = ap.parse_args()
     if args.embed and args.model == ap.get_default("model"):
         args.model = "all-minilm"
+    if args.profile and args.embed:
+        # only the generate path threads profile_dir through; failing fast
+        # beats silently never writing the trace
+        ap.error("--profile is only supported on the generate bench")
 
     errors: list[str] = []
     if args.tiny:

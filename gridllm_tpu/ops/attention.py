@@ -33,9 +33,9 @@ _NEG_INF = -1e30
 
 
 # VMEM budget for flash_prefill's resident per-head K+V (the kernel pins
-# [T, D] of each); past this, Mosaic would reject the kernel at compile
-# time (~16 MB/core), so dispatch falls back to the jnp path. Chunked HBM
-# streaming for very long prefill buckets is future kernel work.
+# [T, D] of each; Mosaic rejects kernels past ~16 MB/core at compile
+# time). Buckets past this route to flash_prefill_streamed, which DMAs
+# K/V from HBM block-by-block instead of pinning them.
 _FLASH_KV_VMEM_CAP = 8 * 1024 * 1024
 
 

@@ -165,7 +165,7 @@ def _flash_prefill_stream_kernel(
     m_scr,       # VMEM (BQ*G, 1) f32 — online-softmax carry across k blocks
     l_scr,       # VMEM (BQ*G, 1) f32
     acc_scr,     # VMEM (BQ*G, D) f32
-    *, bq: int, bk: int, t: int,
+    *, bq: int, bk: int,
 ):
     """Streaming variant of _flash_prefill_kernel: the k-block loop is a
     GRID dimension, so K/V blocks are DMA'd HBM→VMEM per step instead of
@@ -241,7 +241,7 @@ def flash_prefill_streamed(
     bk = min(128, t)
     assert t % bq == 0 and t % bk == 0, (t, bq, bk)
 
-    kernel = functools.partial(_flash_prefill_stream_kernel, bq=bq, bk=bk, t=t)
+    kernel = functools.partial(_flash_prefill_stream_kernel, bq=bq, bk=bk)
 
     def one(qb, kb_, vb, ln):
         return pl.pallas_call(
@@ -323,8 +323,20 @@ def _paged_decode_kernel(
             v_hbm.at[layer, page], v_scr.at[slot], sems.at[slot, 1]
         )
 
-    k_dma(0, 0).start()
-    v_dma(0, 0).start()
+    # pages the loop will actually visit: in merge_cur mode a length-0
+    # (inactive) slot skips the loop entirely. The initial DMA start MUST
+    # be guarded by the same bound — an async copy that is started but
+    # never waited leaves its semaphore signalled into the NEXT grid
+    # iteration (scratch + semaphores persist across grid steps on TPU),
+    # corrupting every later slot's double-buffer handshake. Interpret
+    # mode completes copies synchronously and never sees this; real
+    # Mosaic dies with an opaque device error (round-4 TPU bench crash).
+    n_eff = jnp.where(length > 0, n_pages, 0) if merge_cur else n_pages
+
+    @pl.when(n_eff > 0)
+    def _():
+        k_dma(0, 0).start()
+        v_dma(0, 0).start()
 
     def body(p, carry):
         m, l, acc = carry
@@ -376,10 +388,9 @@ def _paged_decode_kernel(
         # `length` counts the PREFIX only; the current token's K/V arrive
         # via kc/vc (not yet written to the pool — the engine writes all
         # layers at once after the layer scan). length == 0 (fresh slot
-        # with empty pool) skips the page loop entirely.
-        m, l, acc = jax.lax.fori_loop(
-            0, jnp.where(length > 0, n_pages, 0), body, (m0, l0, acc0)
-        )
+        # with empty pool) skips the page loop entirely (n_eff == 0; the
+        # initial DMA start above is guarded by the same bound).
+        m, l, acc = jax.lax.fori_loop(0, n_eff, body, (m0, l0, acc0))
         # online-softmax merge of the single current-token column. The
         # current token's K is scaled along with q (q already carries
         # 1/sqrt(d)), matching the in-pool keys.

@@ -256,8 +256,16 @@ async def run(config: Config | None = None) -> None:
         rk = ready_key(config.worker.worker_id, group.process_id)
 
         async def refresh_ready() -> None:
+            # transient bus errors must not kill the heartbeat: a dead
+            # refresh loop lets the key expire and a later liaison restart
+            # then waits out its whole barrier timeout on a live follower
+            # (same per-beat guard as GroupMembership._beacon_loop)
             while True:
-                await bus.set_with_expiry(rk, "1", ttl_s=10.0)
+                try:
+                    await bus.set_with_expiry(rk, "1", ttl_s=10.0)
+                except Exception as e:  # noqa: BLE001
+                    log.warning("ready-key refresh failed; retrying",
+                                key=rk, error=str(e))
                 await asyncio.sleep(3.0)
 
         ready_task = asyncio.create_task(refresh_ready())
