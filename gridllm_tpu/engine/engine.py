@@ -76,6 +76,10 @@ def _model_module(cfg: ModelConfig):
         from gridllm_tpu.models import bert_embed
 
         return bert_embed
+    if cfg.family == "llava":
+        from gridllm_tpu.models import llava
+
+        return llava
     return llama  # llama, qwen2, qwen3 share the decoder skeleton
 
 
@@ -383,10 +387,10 @@ class InferenceEngine:
         # repeat_last_n tokens (llama.cpp penalty_last_n semantics).
         @partial(jax.jit, donate_argnums=(2, 3, 4, 5, 6, 7, 8))
         def prefill_fn(params, prompt, cache, counts, window, wlen, tokens,
-                       active, sp, length, slot, table_row):
+                       active, sp, length, slot, table_row, embeds=None):
             logits, cache = self.mod.prefill(
                 params, mc, prompt, length, cache, slot, table_row, attn=attn,
-                mesh=self.mesh,
+                mesh=self.mesh, embeds=embeds,
             )
             rl = sp.repeat_last_n[slot]
             window, wlen, counts = window_set_slot(
@@ -407,10 +411,10 @@ class InferenceEngine:
         @partial(jax.jit, donate_argnums=(2, 3, 4, 5, 6, 7, 8))
         def prefill_chunk_fn(params, prompt, cache, counts, window, wlen,
                              tokens, active, sp, start, length, slot,
-                             table_row, is_final):
+                             table_row, is_final, embeds=None):
             logits, cache = self.mod.prefill_chunk(
                 params, mc, prompt, start, length, cache, slot, table_row,
-                mesh=self.mesh,
+                mesh=self.mesh, embeds=embeds,
             )
             rl = sp.repeat_last_n[slot]
             window, wlen, counts = window_set_slot(
@@ -470,6 +474,18 @@ class InferenceEngine:
 
         self._prefill_fn = prefill_fn
         self._prefill_chunk_fn = prefill_chunk_fn
+        if self.cfg.vision:
+            # vision path (llava family): encode_images per image-count
+            # (jit caches per shape — image counts are tiny), splice per
+            # (bucket, image-count) pair
+            self._encode_fn = jax.jit(
+                lambda params, px: self.mod.encode_images(params, mc, px)
+            )
+            self._splice_fn = jax.jit(
+                lambda params, toks, ie, off: self.mod.splice_embeds(
+                    params, mc, toks, ie, off
+                )
+            )
         # ring attention (sp) runs whole-prompt prefill; the chunked path
         # reads the paged prefix instead and has no sp variant yet
         self._use_chunked = attn is None
@@ -528,6 +544,26 @@ class InferenceEngine:
                 return False
             req = self._pending.popleft()
         ids = self._tokenize(req)
+        images = list(req.images or [])
+        if images:
+            try:
+                ids = self._expand_image_tokens(ids, len(images))
+            except ValueError as e:
+                self._fail(req, str(e), retryable=False)
+                return True
+        elif (
+            self.cfg.vision and req.prompt_ids is not None
+            and self.cfg.vision_cfg
+            and self.cfg.vision_cfg.image_token in ids
+        ):
+            # Ollama `context` round-trip from an image turn: the expanded
+            # image-token run is in the context but the pixels are not.
+            # Prefilling placeholder embeddings would silently answer
+            # about an image the model cannot see — fail loudly instead.
+            self._fail(req, "context contains image tokens; follow-ups on "
+                            "image conversations must re-send the images",
+                       retryable=False)
+            return True
         opts = req.options or {}
         # num_ctx caps THIS request's context (Ollama option; engine-wide
         # max_context still bounds it) — VERDICT r03 weak #7
@@ -540,6 +576,14 @@ class InferenceEngine:
         eff_ctx = max(eff_ctx, 2)
         if len(ids) >= eff_ctx:
             ids = ids[-(eff_ctx - 1):]  # Ollama truncates from the left
+            if images:
+                vc = self.cfg.vision_cfg
+                if ids.count(vc.image_token) != len(images) * vc.num_patches:
+                    # truncation cut into an image span — the splice would
+                    # misalign patch rows; loud failure beats garbage
+                    self._fail(req, "context window too small for image "
+                                    "inputs", retryable=False)
+                    return True
         num_predict = int(opts.get("num_predict", -1))
         want = (
             len(ids) + num_predict
@@ -592,10 +636,15 @@ class InferenceEngine:
             # MULTI-chunk prefill fails partway, the liaison's own stream
             # is already unpaired and the slice-failure machinery tears the
             # group down — there is no cheap reconciliation for that.)
-            self._dispatch_prefill(slot, ids, row_list, upd)
+            self._dispatch_prefill(slot, ids, row_list, upd, images=images)
             if self.plan_sink is not None:
-                self.plan_sink({"op": "admit", "slot": slot, "ids": ids,
-                                "row": row_list, "sp": upd})
+                rec = {"op": "admit", "slot": slot, "ids": ids,
+                       "row": row_list, "sp": upd}
+                if images:
+                    # raw base64 payload: followers re-run the
+                    # deterministic preprocessing + encode themselves
+                    rec["images"] = images
+                self.plan_sink(rec)
         # dispatch wall time only — the prefill runs asynchronously and its
         # sampled token first becomes host-visible in the next block fetch;
         # t_prefill_ns is finalized there (admission → first-token)
@@ -604,8 +653,48 @@ class InferenceEngine:
         self._slots[slot] = st
         return True
 
+    def _expand_image_tokens(self, ids: list[int], n_images: int) -> list[int]:
+        """Expand image placeholders to num_patches copies each (the splice
+        contract, models/llava.py). Prompts carrying explicit placeholders
+        (HF-style `<image>`) must have exactly one per image; marker-free
+        prompts (the Ollama API shape — images as a side list) get all
+        image spans inserted up front, after BOS, matching Ollama's
+        images-before-prompt layout."""
+        vc = self.cfg.vision_cfg
+        if vc is None:
+            raise ValueError(f"{self.cfg.name}: vision model without "
+                             "vision_cfg")
+        tok, n = vc.image_token, vc.num_patches
+        count = ids.count(tok)
+        if count == 0:
+            at = 1 if (ids and ids[0] == self.tokenizer.bos_id) else 0
+            return ids[:at] + [tok] * (n * n_images) + ids[at:]
+        if count == n_images * n:
+            # already expanded — an Ollama `context` round-trip of a prior
+            # image turn (st.ids carries the expanded runs) with the
+            # images re-sent; splice positions line up as-is
+            return list(ids)
+        if count != n_images:
+            raise ValueError(
+                f"prompt has {count} image placeholder(s) for "
+                f"{n_images} image(s)"
+            )
+        out: list[int] = []
+        for t in ids:
+            out.extend([tok] * n if t == tok else [t])
+        return out
+
+    def _image_embeds(self, images: list[str]) -> jnp.ndarray:
+        """base64 images → flattened projected patch rows [n*N, E]."""
+        from gridllm_tpu.engine.images import preprocess_images
+
+        px = preprocess_images(images, self.cfg.vision_cfg.image_size)
+        emb = self._encode_fn(self.params, jnp.asarray(px))  # [n, N, E]
+        return emb.reshape(-1, emb.shape[-1])
+
     def _dispatch_prefill(self, slot: int, ids: list[int],
-                          row_list: list[int], upd: dict[str, Any]) -> None:
+                          row_list: list[int], upd: dict[str, Any],
+                          images: list[str] | None = None) -> None:
         """The device half of admission — everything a multi-host follower
         must replay identically: sampler row update + prefill dispatch.
         All inputs are plain host values (the admit plan record)."""
@@ -613,6 +702,8 @@ class InferenceEngine:
             f.name: getattr(self.sampling, f.name).at[slot].set(upd[f.name])
             for f in dataclasses.fields(SamplingParams)
         })
+        img_flat = self._image_embeds(images) if images else None
+        img_tok = self.cfg.vision_cfg.image_token if images else -1
         # counts[slot] is cleared INSIDE prefill_fn / prefill_chunk_fn —
         # no host-side clear here (it would be a dead full-row rewrite)
         row = jnp.asarray(row_list, jnp.int32)
@@ -624,6 +715,12 @@ class InferenceEngine:
             for s0 in range(0, len(ids), c):
                 part = ids[s0 : s0 + c]
                 padded = jnp.asarray(part + [0] * (c - len(part)), jnp.int32)
+                embeds = None
+                if img_flat is not None:
+                    off = sum(1 for t in ids[:s0] if t == img_tok)
+                    embeds = self._splice_fn(
+                        self.params, padded, img_flat, jnp.int32(off)
+                    )
                 (self.cache, self.counts, self.window, self.wlen,
                  self.tokens, self.active, self.sampling) = (
                     self._prefill_chunk_fn(
@@ -631,6 +728,7 @@ class InferenceEngine:
                         self.window, self.wlen, self.tokens, self.active,
                         self.sampling, jnp.int32(s0), jnp.int32(len(part)),
                         jnp.int32(slot), row, jnp.bool_(s0 + c >= len(ids)),
+                        embeds=embeds,
                     )
                 )
         else:
@@ -638,11 +736,17 @@ class InferenceEngine:
             padded = jnp.asarray(
                 ids + [0] * (bucket - len(ids)), jnp.int32
             )
+            embeds = None
+            if img_flat is not None:
+                embeds = self._splice_fn(
+                    self.params, padded, img_flat, jnp.int32(0)
+                )
             (self.cache, self.counts, self.window, self.wlen, self.tokens,
              self.active, self.sampling) = self._prefill_fn(
                 self.params, padded, self.cache, self.counts,
                 self.window, self.wlen, self.tokens, self.active,
                 self.sampling, jnp.int32(len(ids)), jnp.int32(slot), row,
+                embeds=embeds,
             )
 
     def apply_plan_op(self, rec: dict[str, Any]) -> None:
@@ -655,6 +759,7 @@ class InferenceEngine:
             self._dispatch_prefill(
                 int(rec["slot"]), [int(i) for i in rec["ids"]],
                 [int(p) for p in rec["row"]], dict(rec["sp"]),
+                images=list(rec.get("images") or []) or None,
             )
         elif op == "block":
             self._dispatch_block(int(rec["k"]))

@@ -1,0 +1,49 @@
+"""Host-side image preprocessing for vision models (CLIP pipeline).
+
+Mirrors HF CLIPImageProcessor's default llava-1.5 pipeline exactly
+(tests/test_llava.py checks against it): RGB convert → resize the SHORT
+side to `image_size` (bicubic) → center crop `image_size`² → scale 1/255
+→ normalize with the CLIP mean/std. Deterministic: multi-host followers
+re-run it on the raw base64 payload from the liaison's plan record and
+get bit-identical pixel arrays.
+
+The reference shipped base64 images straight to Ollama
+(client/src/services/OllamaService.ts:197-226); this is the native
+replacement's host half — the device half is models/llava.py.
+"""
+
+from __future__ import annotations
+
+import base64
+import io
+
+import numpy as np
+
+# CLIP normalization constants (OPENAI_CLIP_MEAN/STD)
+_MEAN = np.array([0.48145466, 0.4578275, 0.40821073], np.float32)
+_STD = np.array([0.26862954, 0.26130258, 0.27577711], np.float32)
+
+
+def preprocess_images(images_b64: list[str], image_size: int) -> np.ndarray:
+    """base64 (or raw-bytes) images → [N, 3, S, S] float32 pixel values."""
+    from PIL import Image
+
+    out = []
+    for item in images_b64:
+        raw = base64.b64decode(item) if isinstance(item, str) else bytes(item)
+        img = Image.open(io.BytesIO(raw)).convert("RGB")
+        w, h = img.size
+        # shortest-edge resize (CLIPImageProcessor {"shortest_edge": S})
+        if w <= h:
+            nw, nh = image_size, max(1, round(h * image_size / w))
+        else:
+            nw, nh = max(1, round(w * image_size / h)), image_size
+        img = img.resize((nw, nh), Image.Resampling.BICUBIC)
+        # center crop S×S (matches transformers' center_crop rounding)
+        left = (nw - image_size) // 2
+        top = (nh - image_size) // 2
+        img = img.crop((left, top, left + image_size, top + image_size))
+        arr = np.asarray(img, np.float32) / 255.0        # [S, S, 3]
+        arr = (arr - _MEAN) / _STD
+        out.append(arr.transpose(2, 0, 1))               # [3, S, S]
+    return np.stack(out)

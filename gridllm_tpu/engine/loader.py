@@ -76,12 +76,12 @@ def load_checkpoint(
     llama3:70b-on-v5e-8 memory budget (BASELINE config #3).
     """
     from gridllm_tpu.models import hf_layout
-    from gridllm_tpu.ops.quant import quantize_np_leaf
+    from gridllm_tpu.ops.quant import NO_QUANT_SUBTREES, quantize_np_leaf
 
     idx = _open_safetensors(path)
 
     def place(pathkeys: tuple[str, ...], arr: np.ndarray):
-        if quantize == "int8":
+        if quantize == "int8" and pathkeys[0] not in NO_QUANT_SUBTREES:
             out = quantize_np_leaf(pathkeys[-1], arr)
             if not hasattr(out, "q"):
                 out = jnp.asarray(out, dtype)
@@ -102,6 +102,10 @@ def load_checkpoint(
         from gridllm_tpu.models import bert_embed
 
         return bert_embed.from_getter(cfg, get, dtype, place)
+    if cfg.family == "llava":
+        from gridllm_tpu.models import llava
+
+        return llava.from_getter(cfg, get, dtype, place)
     return hf_layout.to_pytree(cfg, get, _name_map(cfg), dtype, place)
 
 

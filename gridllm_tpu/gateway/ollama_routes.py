@@ -341,12 +341,17 @@ def build_routes(registry: WorkerRegistry, scheduler: JobScheduler,
             for m in worker.capabilities.availableModels:
                 if m.name == model:
                     details = m.details or {}
+                    caps = ["completion"]
+                    if details.get("vision") or "clip" in (
+                        details.get("families") or []
+                    ):
+                        caps.append("vision")
                     return web.json_response({
                         "modelfile": "", "parameters": "", "template": "",
                         "details": details,
                         "model_info": {"general.name": model,
                                        "general.size": m.size or 0},
-                        "capabilities": ["completion"],
+                        "capabilities": caps,
                     })
         raise ApiError(f"Model '{model}' not found", 404, "MODEL_NOT_FOUND")
 

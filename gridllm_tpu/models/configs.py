@@ -16,6 +16,34 @@ from gridllm_tpu.ops.layers import RopeScaling
 
 
 @dataclasses.dataclass(frozen=True)
+class VisionConfig:
+    """CLIP-style vision tower (llava family). Defaults = CLIP-ViT-L/14-336,
+    the tower every llava-1.5 checkpoint ships."""
+    hidden_size: int = 1024
+    intermediate_size: int = 4096
+    num_layers: int = 24
+    num_heads: int = 16
+    image_size: int = 336
+    patch_size: int = 14
+    layer_norm_eps: float = 1e-5
+    # HF semantics: hidden_states index fed to the projector (-2 = output
+    # of the penultimate encoder layer; llava-1.5 default)
+    feature_layer: int = -2
+    # id of the per-image placeholder token in the TEXT vocab; the engine
+    # expands each to num_patches copies and the prefill splices projected
+    # patch embeddings over them
+    image_token: int = 32_000
+
+    @property
+    def num_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+
+@dataclasses.dataclass(frozen=True)
 class ModelConfig:
     name: str
     family: str = "llama"            # llama | mixtral | bert_embed
@@ -41,9 +69,10 @@ class ModelConfig:
     qk_norm: bool = False            # qwen3: per-head RMSNorm on q/k pre-rope
     # embeddings (bert_embed family)
     pooling: str = "mean"            # "mean" | "cls"
-    # multimodal: accepts image inputs (no vision family yet — the flag is
-    # the per-model capability gate the engine rejects on)
+    # multimodal: accepts image inputs (the per-model capability gate the
+    # engine rejects on); llava family carries the tower config here
     vision: bool = False
+    vision_cfg: VisionConfig | None = None
     # kernel dispatch: None = env/auto policy (ops.attention); the engine
     # sets False on its config copy when serving under a device mesh
     use_pallas: bool | None = None
@@ -87,6 +116,26 @@ class ModelConfig:
                 intermediate_size=self.intermediate_size,
                 max_position_embeddings=self.max_seq_len,
                 layer_norm_eps=self.rms_eps,
+            )
+        if self.family == "llava":
+            from transformers import CLIPVisionConfig, LlamaConfig, LlavaConfig
+
+            vc = self.vision_cfg or VisionConfig()
+            return LlavaConfig(
+                vision_config=CLIPVisionConfig(
+                    hidden_size=vc.hidden_size,
+                    intermediate_size=vc.intermediate_size,
+                    num_hidden_layers=vc.num_layers,
+                    num_attention_heads=vc.num_heads,
+                    image_size=vc.image_size,
+                    patch_size=vc.patch_size,
+                    layer_norm_eps=vc.layer_norm_eps,
+                ),
+                text_config=LlamaConfig(**common),
+                image_token_index=vc.image_token,
+                vision_feature_layer=vc.feature_layer,
+                vision_feature_select_strategy="default",
+                projector_hidden_act="gelu",
             )
         if self.family == "qwen2":
             from transformers import Qwen2Config
@@ -176,6 +225,22 @@ register(ModelConfig(
     head_dim=128, rope_theta=1_000_000.0, rms_eps=1e-6,
     max_seq_len=40_960, qk_norm=True,
 ))
+# llava-1.5 (BASELINE vision parity): vicuna/llama2 text stack + CLIP-L/14
+# tower. vocab 32064 = llama2's 32000 padded with the <image>/<pad> extras
+# the llava-hf checkpoints ship.
+register(ModelConfig(
+    name="llava:7b", family="llava", vocab_size=32_064, hidden_size=4096,
+    intermediate_size=11_008, num_layers=32, num_heads=32, num_kv_heads=32,
+    rope_theta=10_000.0, max_seq_len=4096, rms_eps=1e-5,
+    vision=True, vision_cfg=VisionConfig(),
+))
+register(ModelConfig(
+    name="llava:13b", family="llava", vocab_size=32_064, hidden_size=5120,
+    intermediate_size=13_824, num_layers=40, num_heads=40, num_kv_heads=40,
+    rope_theta=10_000.0, max_seq_len=4096, rms_eps=1e-5,
+    vision=True, vision_cfg=VisionConfig(),
+))
+
 register(ModelConfig(
     name="mixtral:8x7b", family="mixtral", vocab_size=32_000,
     hidden_size=4096, intermediate_size=14_336, num_layers=32,
@@ -223,6 +288,15 @@ register(ModelConfig(
     intermediate_size=128, num_layers=2, num_heads=4, num_kv_heads=4,
     rms_eps=1e-12, max_seq_len=128,
 ))
+register(ModelConfig(
+    name="tiny-llava", family="llava", vocab_size=256, hidden_size=64,
+    intermediate_size=128, num_layers=2, num_heads=4, num_kv_heads=2,
+    head_dim=16, rope_theta=10_000.0, max_seq_len=512,
+    vision=True, vision_cfg=VisionConfig(
+        hidden_size=32, intermediate_size=64, num_layers=3, num_heads=2,
+        image_size=28, patch_size=14, image_token=250,
+    ),
+))
 
 
 def get_config(name: str) -> ModelConfig:
@@ -255,11 +329,45 @@ def config_from_hf_dir(name: str, path: str) -> ModelConfig:
 
     with open(os.path.join(path, "config.json")) as f:
         hf = json.load(f)
+    return _config_from_hf_dict(name, hf, path)
+
+
+def _config_from_hf_dict(name: str, hf: dict, path: str) -> ModelConfig:
     mt = hf.get("model_type", "llama")
+    if mt == "llava":
+        vc = hf.get("vision_config") or {}
+        text = dict(hf.get("text_config") or {})
+        text.setdefault("model_type", "llama")
+        # llava text_configs may be sparse (LlamaConfig defaults implied)
+        for k, v in (("vocab_size", 32_064), ("hidden_size", 4096),
+                     ("intermediate_size", 11_008), ("num_hidden_layers", 32),
+                     ("num_attention_heads", 32),
+                     ("max_position_embeddings", 4096)):
+            text.setdefault(k, v)
+        # only keys the HF config actually carries — VisionConfig's field
+        # defaults (the single source of truth) fill the rest
+        vkeys = {
+            "hidden_size": vc.get("hidden_size"),
+            "intermediate_size": vc.get("intermediate_size"),
+            "num_layers": vc.get("num_hidden_layers"),
+            "num_heads": vc.get("num_attention_heads"),
+            "image_size": vc.get("image_size"),
+            "patch_size": vc.get("patch_size"),
+            "layer_norm_eps": vc.get("layer_norm_eps"),
+            "feature_layer": hf.get("vision_feature_layer"),
+            "image_token": hf.get("image_token_index"),
+        }
+        return dataclasses.replace(
+            _config_from_hf_dict(name, text, path),
+            family="llava", vision=True,
+            vision_cfg=VisionConfig(
+                **{k: v for k, v in vkeys.items() if v is not None}
+            ),
+        )
     if mt not in _HF_FAMILY:
         raise ValueError(
             f"unsupported HF model_type {mt!r} in {path} "
-            f"(supported: {sorted(_HF_FAMILY)})"
+            f"(supported: {sorted(_HF_FAMILY)} + llava)"
         )
     family = _HF_FAMILY[mt]
     if family == "bert_embed":

@@ -164,15 +164,19 @@ def hidden_states(
     mlp: MlpFn = _mlp,
     seq_lens: jnp.ndarray | None = None,
     attn: AttnFn | None = None,
+    embeds: jnp.ndarray | None = None,
 ) -> jnp.ndarray:
     """Final-norm hidden states [B, T, E] (embeddings path; no unembed).
-    seq_lens masks padding keys out of attention (None → all valid)."""
+    seq_lens masks padding keys out of attention (None → all valid).
+    `embeds` ([B, T, E]) overrides the embedding lookup (vision splice)."""
     _check_supported(cfg)
     if attn is None:
         attn = _default_attn(cfg)
     b, t = tokens.shape
     inv_freq = precompute_rope(cfg.head_dim_, cfg.rope_theta, cfg.rope_scaling)
-    x = params["embed"][tokens]
+    x = params["embed"][tokens] if embeds is None else embeds.astype(
+        params["embed"].dtype
+    )
     pos = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32), (b, t))
     if seq_lens is None:
         seq_lens = jnp.full((b,), t, jnp.int32)
@@ -192,14 +196,17 @@ def hidden_states(
 
 
 def forward(
-    params: Params, cfg: ModelConfig, tokens: jnp.ndarray, mlp: MlpFn = _mlp
+    params: Params, cfg: ModelConfig, tokens: jnp.ndarray, mlp: MlpFn = _mlp,
+    embeds: jnp.ndarray | None = None,
 ) -> jnp.ndarray:
     """Cache-free full forward: tokens [B, T] → logits [B, T, V] (fp32).
 
     The oracle path — golden tests compare this against HF; prefill/decode
     must agree with it (tested in tests/test_models.py).
     """
-    return _unembed(cfg, params, hidden_states(params, cfg, tokens, mlp))
+    return _unembed(
+        cfg, params, hidden_states(params, cfg, tokens, mlp, embeds=embeds)
+    )
 
 
 def _seq_constraint(mesh) -> Callable[[jnp.ndarray], jnp.ndarray]:
@@ -230,12 +237,16 @@ def prefill(
     mlp: MlpFn = _mlp,
     attn: AttnFn | None = None,
     mesh=None,
+    embeds: jnp.ndarray | None = None,
 ) -> tuple[jnp.ndarray, PagedKVCache]:
     """Prefill ONE slot. tokens: [T] (padded bucket), length: scalar valid
     count, table_row: [max_pages] this slot's pages. Returns (last-token
     logits [V] fp32, updated cache). Sets cache.lengths[slot] = length.
     `mesh` (with sp > 1) pins the residual stream's T axis to the sp mesh
     axis so prefill activations really are O(T/sp) per device.
+    `embeds` ([T, E]) overrides the token-embedding lookup — the vision
+    path (models/llava.py splice_embeds) feeds image-spliced embeddings;
+    tokens are still used for lengths/window bookkeeping by the caller.
     """
     _check_supported(cfg)
     if attn is None:
@@ -243,7 +254,8 @@ def prefill(
     seq_c = _seq_constraint(mesh)
     t = tokens.shape[0]
     inv_freq = precompute_rope(cfg.head_dim_, cfg.rope_theta, cfg.rope_scaling)
-    x = seq_c(params["embed"][tokens][None])  # [1, T, E]
+    x = params["embed"][tokens] if embeds is None else embeds
+    x = seq_c(x.astype(params["embed"].dtype)[None])  # [1, T, E]
     pos = jnp.arange(t, dtype=jnp.int32)[None]
     seq_lens = length[None]
 
@@ -290,6 +302,7 @@ def prefill_chunk(
     table_row: jnp.ndarray,
     mlp: MlpFn = _mlp,
     mesh=None,  # accepted for family-API uniformity (MoE uses it)
+    embeds: jnp.ndarray | None = None,  # [C, E] override (vision splice)
 ) -> tuple[jnp.ndarray, PagedKVCache]:
     """Prefill ONE CHUNK of one slot against its cached prefix.
 
@@ -304,7 +317,8 @@ def prefill_chunk(
     _check_supported(cfg)
     t = tokens.shape[0]
     inv_freq = precompute_rope(cfg.head_dim_, cfg.rope_theta, cfg.rope_scaling)
-    x = params["embed"][tokens][None]  # [1, C, E]
+    x = params["embed"][tokens] if embeds is None else embeds
+    x = x.astype(params["embed"].dtype)[None]  # [1, C, E]
     pos = (start + jnp.arange(t, dtype=jnp.int32))[None]
     total = start + length
 

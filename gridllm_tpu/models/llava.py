@@ -1,0 +1,321 @@
+"""Llava-family vision-language model: CLIP-ViT tower + MLP projector +
+the shared llama decoder skeleton.
+
+The reference served llava-class models by passing base64 `images`
+through to Ollama (client/src/services/OllamaService.ts:197-226 `images`
+option); this is the rebuild's native implementation (VERDICT r03
+missing #5). TPU-first choices:
+
+- The patch "convolution" is a reshape + one [N, 3*ps*ps] x [3*ps*ps, D]
+  matmul — a conv with stride == kernel size IS a patch matmul, and the
+  matmul form lands on the MXU without any conv lowering.
+- The tower is scan-stacked like every other family; the HF
+  `vision_feature_layer=-2` semantics (stop before the last encoder
+  layer) become a STATIC slice of the stacked layer params — no
+  per-layer Python loop, no dead compute for the unused tail layers.
+- Image-token splice is a gather-select inside the jitted prefill: the
+  engine expands each image placeholder to `num_patches` copies of
+  `vision_cfg.image_token` host-side, and `splice_embeds` overlays the
+  j-th image-token position with projected patch row j. Same scatter
+  semantics as HF's masked_scatter fill, but as a dense where() —
+  shape-static and trivially shardable.
+
+Weight layout contract: HF `LlavaForConditionalGeneration`. Both HF
+namings are accepted — the 4.52+ "model.vision_tower.* / lm_head" flat
+layout and the original "vision_tower.* / language_model.model.*"
+checkpoint layout that llava-hf publishes (tests/test_llava.py).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from gridllm_tpu.models import llama
+from gridllm_tpu.models.configs import ModelConfig, VisionConfig
+from gridllm_tpu.ops.layers import layer_norm
+
+Params = dict[str, Any]
+
+# the decoder skeleton is llama's — prefill/decode/forward are shared
+# verbatim (the text stack of llava-1.5 is a vanilla llama/vicuna)
+prefill = llama.prefill
+prefill_chunk = llama.prefill_chunk
+decode_step = llama.decode_step
+forward = llama.forward
+hidden_states = llama.hidden_states
+hf_map = llama.hf_map
+
+
+def _quick_gelu(x: jnp.ndarray) -> jnp.ndarray:
+    # CLIP's activation (HF ACT2FN["quick_gelu"])
+    return x * jax.nn.sigmoid(1.702 * x)
+
+
+def init_params(
+    cfg: ModelConfig, key: jax.Array, dtype=jnp.bfloat16
+) -> Params:
+    """llama text params at the top level (so the engine's decode path is
+    family-agnostic) + `vision` / `projector` subtrees."""
+    vc = cfg.vision_cfg or VisionConfig()
+    kt, kv = jax.random.split(key)
+    params = llama.init_params(cfg, kt, dtype)
+    dv, fv, lv = vc.hidden_size, vc.intermediate_size, vc.num_layers
+    e = cfg.hidden_size
+    pdim = 3 * vc.patch_size * vc.patch_size
+    ks = iter(jax.random.split(kv, 12))
+
+    def w(k, *shape, scale=0.02):
+        return (jax.random.normal(k, shape, jnp.float32) * scale).astype(dtype)
+
+    params["vision"] = {
+        "cls": w(next(ks), dv),
+        "patch_embed": w(next(ks), pdim, dv),
+        "pos_embed": w(next(ks), vc.num_patches + 1, dv),
+        "pre_ln_w": jnp.ones((dv,), dtype),
+        "pre_ln_b": jnp.zeros((dv,), dtype),
+        "layers": {
+            "ln1_w": jnp.ones((lv, dv), dtype),
+            "ln1_b": jnp.zeros((lv, dv), dtype),
+            "wq": w(next(ks), lv, dv, dv),
+            "bq": jnp.zeros((lv, dv), dtype),
+            "wk": w(next(ks), lv, dv, dv),
+            "bk": jnp.zeros((lv, dv), dtype),
+            "wv": w(next(ks), lv, dv, dv),
+            "bv": jnp.zeros((lv, dv), dtype),
+            "wo": w(next(ks), lv, dv, dv),
+            "bo": jnp.zeros((lv, dv), dtype),
+            "ln2_w": jnp.ones((lv, dv), dtype),
+            "ln2_b": jnp.zeros((lv, dv), dtype),
+            "fc1": w(next(ks), lv, dv, fv),
+            "b1": jnp.zeros((lv, fv), dtype),
+            "fc2": w(next(ks), lv, fv, dv),
+            "b2": jnp.zeros((lv, dv), dtype),
+        },
+    }
+    params["projector"] = {
+        "w1": w(next(ks), dv, e),
+        "b1": jnp.zeros((e,), dtype),
+        "w2": w(next(ks), e, e),
+        "b2": jnp.zeros((e,), dtype),
+    }
+    return params
+
+
+def _patchify(vc: VisionConfig, pixel_values: jnp.ndarray) -> jnp.ndarray:
+    """[B, 3, S, S] → [B, N, 3*ps*ps] with per-patch dims flattened in the
+    HF conv kernel's (channel, row, col) order."""
+    b = pixel_values.shape[0]
+    ps = vc.patch_size
+    n = vc.image_size // ps
+    x = pixel_values.reshape(b, 3, n, ps, n, ps)
+    x = x.transpose(0, 2, 4, 1, 3, 5)          # [B, nh, nw, 3, ps, ps]
+    return x.reshape(b, n * n, 3 * ps * ps)
+
+
+def vision_tower(
+    params: Params, vc: VisionConfig, pixel_values: jnp.ndarray
+) -> jnp.ndarray:
+    """CLIP vision encoder → feature-layer patch embeddings.
+
+    pixel_values: [B, 3, S, S] (CLIP-normalized). Returns [B, N, Dv]: the
+    hidden states at `vc.feature_layer` (HF hidden_states indexing), CLS
+    dropped ("default" select strategy — llava-1.5's).
+    """
+    vp = params["vision"]
+    b = pixel_values.shape[0]
+    dv, heads, dh = vc.hidden_size, vc.num_heads, vc.head_dim
+    eps = vc.layer_norm_eps
+
+    patches = _patchify(vc, pixel_values.astype(vp["patch_embed"].dtype))
+    x = patches @ vp["patch_embed"]                       # [B, N, Dv]
+    cls = jnp.broadcast_to(vp["cls"], (b, 1, dv)).astype(x.dtype)
+    x = jnp.concatenate([cls, x], axis=1) + vp["pos_embed"]
+    x = layer_norm(x, vp["pre_ln_w"], vp["pre_ln_b"], eps)
+
+    # HF hidden_states[i] = input of layer i (hidden_states[0] = post-
+    # pre-LN embeddings, [-1] = final layer's output); feature_layer=-2
+    # therefore runs all but the last encoder layer. Static slice of the
+    # stacked params — the unused tail layers cost nothing.
+    fl = vc.feature_layer
+    n_run = vc.num_layers + 1 + fl if fl < 0 else fl
+    if not 0 <= n_run <= vc.num_layers:
+        raise ValueError(f"vision feature_layer {fl} out of range")
+    lp_run = jax.tree.map(lambda a: a[:n_run], vp["layers"])
+
+    def layer(x, lp):
+        # pre-LN transformer block, bidirectional MHA with biases
+        h = layer_norm(x, lp["ln1_w"], lp["ln1_b"], eps)
+        t = h.shape[1]
+        q = (h @ lp["wq"] + lp["bq"]).reshape(b, t, heads, dh)
+        k = (h @ lp["wk"] + lp["bk"]).reshape(b, t, heads, dh)
+        v = (h @ lp["wv"] + lp["bv"]).reshape(b, t, heads, dh)
+        logits = jnp.einsum("bthd,bshd->bhts", q, k).astype(jnp.float32)
+        probs = jax.nn.softmax(logits / np.sqrt(dh), axis=-1).astype(v.dtype)
+        att = jnp.einsum("bhts,bshd->bthd", probs, v).reshape(b, t, dv)
+        x = x + (att @ lp["wo"] + lp["bo"])
+        h = layer_norm(x, lp["ln2_w"], lp["ln2_b"], eps)
+        h = _quick_gelu(h @ lp["fc1"] + lp["b1"]) @ lp["fc2"] + lp["b2"]
+        return x + h, None
+
+    if n_run > 0:
+        x, _ = jax.lax.scan(layer, x, lp_run)
+    return x[:, 1:]  # drop CLS
+
+
+def encode_images(
+    params: Params, cfg: ModelConfig, pixel_values: jnp.ndarray
+) -> jnp.ndarray:
+    """[B, 3, S, S] → projected image embeddings [B, N, E_text]."""
+    vc = cfg.vision_cfg or VisionConfig()
+    feats = vision_tower(params, vc, pixel_values)
+    pj = params["projector"]
+    h = jax.nn.gelu(feats @ pj["w1"] + pj["b1"], approximate=False)
+    return h @ pj["w2"] + pj["b2"]
+
+
+def splice_embeds(
+    params: Params,
+    cfg: ModelConfig,
+    tokens: jnp.ndarray,
+    img_embeds: jnp.ndarray,
+    offset: jnp.ndarray | int = 0,
+) -> jnp.ndarray:
+    """Token embeddings with image positions overlaid.
+
+    tokens: [T] (image placeholders already EXPANDED to num_patches copies
+    of vision_cfg.image_token per image, engine-side); img_embeds: [M, E]
+    flattened projected patches (M = n_images * num_patches; rows in
+    prompt order). The j-th image-token position takes img_embeds[offset+j]
+    — HF's masked-fill semantics as a dense select. `offset` is the count
+    of image tokens BEFORE this span (chunked prefill passes per-chunk
+    offsets so one fixed-shape program serves every chunk). Returns [T, E].
+    """
+    vc = cfg.vision_cfg or VisionConfig()
+    base = params["embed"][tokens]                       # [T, E]
+    is_img = tokens == vc.image_token
+    j = offset + jnp.cumsum(is_img.astype(jnp.int32)) - 1  # [T]
+    j = jnp.clip(j, 0, img_embeds.shape[0] - 1)
+    return jnp.where(is_img[:, None], img_embeds[j].astype(base.dtype), base)
+
+
+# ---------------------------------------------------------------------------
+# HF weight layout
+# ---------------------------------------------------------------------------
+
+# our vision leaf → (HF suffix template under the vision tower, transpose?)
+_VISION_LAYER_MAP: dict[str, tuple[str, bool]] = {
+    "ln1_w": ("encoder.layers.{}.layer_norm1.weight", False),
+    "ln1_b": ("encoder.layers.{}.layer_norm1.bias", False),
+    "wq": ("encoder.layers.{}.self_attn.q_proj.weight", True),
+    "bq": ("encoder.layers.{}.self_attn.q_proj.bias", False),
+    "wk": ("encoder.layers.{}.self_attn.k_proj.weight", True),
+    "bk": ("encoder.layers.{}.self_attn.k_proj.bias", False),
+    "wv": ("encoder.layers.{}.self_attn.v_proj.weight", True),
+    "bv": ("encoder.layers.{}.self_attn.v_proj.bias", False),
+    "wo": ("encoder.layers.{}.self_attn.out_proj.weight", True),
+    "bo": ("encoder.layers.{}.self_attn.out_proj.bias", False),
+    "ln2_w": ("encoder.layers.{}.layer_norm2.weight", False),
+    "ln2_b": ("encoder.layers.{}.layer_norm2.bias", False),
+    "fc1": ("encoder.layers.{}.mlp.fc1.weight", True),
+    "b1": ("encoder.layers.{}.mlp.fc1.bias", False),
+    "fc2": ("encoder.layers.{}.mlp.fc2.weight", True),
+    "b2": ("encoder.layers.{}.mlp.fc2.bias", False),
+}
+
+Get = Callable[[str], np.ndarray]
+
+
+def _resolving_get(get: Get) -> Callable[[str], np.ndarray]:
+    """Accept both HF llava namings: transformers ≥4.52's flat
+    "model.language_model.* / model.vision_tower.* / lm_head.*" and the
+    published checkpoints' "language_model.model.* / vision_tower.* /
+    language_model.lm_head.*"."""
+    alts = {
+        "model.": ("model.language_model.", "language_model.model."),
+        "lm_head.": ("lm_head.", "language_model.lm_head."),
+        "VIS.": ("model.vision_tower.vision_model.",
+                 "vision_tower.vision_model."),
+        "PROJ.": ("model.multi_modal_projector.",
+                  "multi_modal_projector."),
+    }
+
+    def resolve(name: str) -> np.ndarray:
+        for pfx, subs in alts.items():
+            if name.startswith(pfx):
+                last = None
+                for sub in subs:
+                    try:
+                        return get(sub + name[len(pfx):])
+                    except KeyError as e:
+                        last = e
+                raise last
+        return get(name)
+
+    return resolve
+
+
+def from_getter(
+    cfg: ModelConfig, get: Get, dtype, place
+) -> Params:
+    """Assemble the llava pytree from HF-named tensors (engine/loader)."""
+    from gridllm_tpu.models import hf_layout
+
+    vc = cfg.vision_cfg or VisionConfig()
+    rget = _resolving_get(get)
+    params = hf_layout.to_pytree(cfg, rget, hf_map(cfg), dtype, place)
+
+    ps = vc.patch_size
+    patch = np.asarray(rget("VIS.embeddings.patch_embedding.weight"))
+    vision: Params = {
+        "cls": place(("vision", "cls"),
+                     np.asarray(rget("VIS.embeddings.class_embedding"))),
+        # conv [Dv, 3, ps, ps] → matmul [3*ps*ps, Dv]
+        "patch_embed": place(("vision", "patch_embed"),
+                             patch.reshape(patch.shape[0], 3 * ps * ps).T),
+        "pos_embed": place(("vision", "pos_embed"),
+                           np.asarray(rget("VIS.embeddings.position_embedding.weight"))),
+        # (sic) "pre_layrnorm" is HF's own spelling
+        "pre_ln_w": place(("vision", "pre_ln_w"),
+                          np.asarray(rget("VIS.pre_layrnorm.weight"))),
+        "pre_ln_b": place(("vision", "pre_ln_b"),
+                          np.asarray(rget("VIS.pre_layrnorm.bias"))),
+    }
+    layers: Params = {}
+    for leaf, (tmpl, tr) in _VISION_LAYER_MAP.items():
+        rows = []
+        for i in range(vc.num_layers):
+            w = np.asarray(rget("VIS." + tmpl.format(i)))
+            rows.append(w.T if tr else w)
+        layers[leaf] = place(("vision", "layers", leaf), np.stack(rows))
+    vision["layers"] = layers
+    params["vision"] = vision
+    params["projector"] = {
+        "w1": place(("projector", "w1"),
+                    np.asarray(rget("PROJ.linear_1.weight")).T),
+        "b1": place(("projector", "b1"),
+                    np.asarray(rget("PROJ.linear_1.bias"))),
+        "w2": place(("projector", "w2"),
+                    np.asarray(rget("PROJ.linear_2.weight")).T),
+        "b2": place(("projector", "b2"),
+                    np.asarray(rget("PROJ.linear_2.bias"))),
+    }
+    return params
+
+
+def convert_hf_state_dict(
+    cfg: ModelConfig, sd: dict[str, Any], dtype=jnp.bfloat16
+) -> Params:
+    """torch state dict (LlavaForConditionalGeneration) → our pytree
+    (golden tests)."""
+    from gridllm_tpu.models import hf_layout
+
+    def get(name: str) -> np.ndarray:
+        if name not in sd:
+            raise KeyError(name)
+        return sd[name].to("cpu").float().numpy()
+
+    return from_getter(cfg, get, dtype, hf_layout.default_place(dtype))

@@ -269,10 +269,11 @@ def prefill(
     table_row: jnp.ndarray,
     attn: llama.AttnFn | None = None,
     mesh=None,
+    embeds: jnp.ndarray | None = None,  # family-API uniformity (vision)
 ) -> tuple[jnp.ndarray, PagedKVCache]:
     return llama.prefill(
         params, cfg, tokens, length, cache, slot, table_row,
-        mlp=_mlp_for(cfg, mesh), attn=attn, mesh=mesh,
+        mlp=_mlp_for(cfg, mesh), attn=attn, mesh=mesh, embeds=embeds,
     )
 
 
@@ -286,10 +287,11 @@ def prefill_chunk(
     slot: jnp.ndarray,
     table_row: jnp.ndarray,
     mesh=None,
+    embeds: jnp.ndarray | None = None,  # family-API uniformity (vision)
 ) -> tuple[jnp.ndarray, PagedKVCache]:
     return llama.prefill_chunk(
         params, cfg, tokens, start, length, cache, slot, table_row,
-        mlp=_mlp_for(cfg, mesh),
+        mlp=_mlp_for(cfg, mesh), embeds=embeds,
     )
 
 

@@ -88,6 +88,11 @@ QUANT_LEAVES = frozenset(
     {"wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down", "lm_head"}
 )
 
+# subtrees whose leaves consume weights with plain `@`, not qdot — their
+# "wq"/"wo" NAMES collide with QUANT_LEAVES but must never quantize (the
+# llava vision tower/projector; small next to the LM anyway)
+NO_QUANT_SUBTREES = frozenset({"vision", "projector"})
+
 
 def quantize_params(params: dict[str, Any]) -> dict[str, Any]:
     """Quantize the known matmul leaves of a llama-family pytree in place
@@ -99,7 +104,7 @@ def quantize_params(params: dict[str, Any]) -> dict[str, Any]:
         out = {}
         for name, leaf in node.items():
             if isinstance(leaf, dict):
-                out[name] = walk(leaf)
+                out[name] = leaf if name in NO_QUANT_SUBTREES else walk(leaf)
             elif name in QUANT_LEAVES:
                 out[name] = quantize_array(leaf)
             else:

@@ -67,6 +67,29 @@ def tpu_topology() -> TpuTopology:
     )
 
 
+def _param_count_estimate(mc) -> int:
+    """Decoder param count from the config dims (embed + L×(attn+ffn))."""
+    try:
+        e, f, v = mc.hidden_size, mc.intermediate_size, mc.vocab_size
+        h, kvh, d, L = mc.num_heads, mc.num_kv_heads, mc.head_dim_, mc.num_layers
+        attn = e * h * d + 2 * e * kvh * d + h * d * e
+        ffn = 3 * e * f
+        if getattr(mc, "num_experts", 0):
+            ffn *= mc.num_experts
+        head = 0 if mc.tie_embeddings else e * v
+        return v * e + L * (attn + ffn) + head
+    except AttributeError:
+        return 0
+
+
+def _human_params(n: int) -> str:
+    if n <= 0:
+        return "Unknown"
+    if n >= 1e9:
+        return f"{n / 1e9:.1f}B"
+    return f"{n / 1e6:.0f}M"
+
+
 def gather_capabilities(
     worker_id: str,
     engines: dict[str, object],
@@ -81,7 +104,24 @@ def gather_capabilities(
         c = getattr(eng, "config", None)
         mc = getattr(eng, "cfg", None)
         max_slots += getattr(c, "max_slots", 1)
-        models.append(ModelInfo(name=name, model=name))
+        details = None
+        if mc is not None:
+            family = getattr(mc, "family", "unknown")
+            families = [family]
+            if getattr(mc, "vision", False):
+                families.append("clip")  # Ollama marks vision via families
+            n_params = _param_count_estimate(mc)
+            details = {
+                "parent_model": "", "format": "safetensors",
+                "family": family, "families": families,
+                "parameter_size": _human_params(n_params),
+                "quantization_level": (
+                    "Q8_0" if getattr(c, "quantize", None) == "int8"
+                    else str(getattr(c, "dtype", "bfloat16")).upper()
+                ),
+                "vision": bool(getattr(mc, "vision", False)),
+            }
+        models.append(ModelInfo(name=name, model=name, details=details))
         mesh = getattr(eng, "mesh", None)
         layouts.append(ModelShardLayout(
             name=name,

@@ -113,3 +113,55 @@ def test_quantized_param_shardings_resolve():
     sh = param_shardings(proto, mesh)
     # congruent tree: every leaf has a sharding
     jax.tree_util.tree_map(lambda p, s: None, proto, sh)
+
+
+def test_llava_vision_subtrees_never_quantize():
+    """VERDICT r04 review: the vision tower's wq/wk/wv/wo NAMES collide
+    with QUANT_LEAVES but are consumed with plain `@` — int8 must skip the
+    vision/projector subtrees (and the engine must serve images under
+    quantize="int8")."""
+    import jax
+
+    from gridllm_tpu.models import llava
+    from gridllm_tpu.models.configs import get_config
+    from gridllm_tpu.ops.quant import QuantizedTensor, quantize_params
+
+    cfg = get_config("tiny-llava")
+    params = llava.init_params(cfg, jax.random.PRNGKey(0))
+    q = quantize_params(params)
+    assert isinstance(q["layers"]["wq"], QuantizedTensor)  # LM still quantizes
+    flat = jax.tree_util.tree_leaves_with_path(
+        {"vision": q["vision"], "projector": q["projector"]},
+        is_leaf=lambda x: isinstance(x, QuantizedTensor),
+    )
+    assert flat and not any(
+        isinstance(leaf, QuantizedTensor) for _, leaf in flat
+    )
+
+
+def test_llava_engine_serves_int8():
+    import base64
+    import io
+
+    import numpy as np
+
+    from gridllm_tpu.engine import EngineConfig, InferenceEngine
+    from gridllm_tpu.engine.engine import GenerationRequest
+    from PIL import Image
+
+    rng = np.random.default_rng(7)
+    img = Image.fromarray(rng.integers(0, 255, (20, 20, 3), np.uint8))
+    buf = io.BytesIO()
+    img.save(buf, format="PNG")
+    b64 = base64.b64encode(buf.getvalue()).decode()
+
+    eng = InferenceEngine(EngineConfig(
+        model="tiny-llava", quantize="int8", max_slots=1, page_size=16,
+        num_pages=32, max_pages_per_slot=8, prefill_buckets=(32,),
+    ))
+    res = eng.generate(GenerationRequest(
+        id="q1", prompt="hi", images=[b64],
+        options={"temperature": 0, "num_predict": 3, "seed": 0},
+    ))
+    assert res.done_reason in ("stop", "length")
+    assert res.eval_count >= 1
