@@ -33,11 +33,14 @@ def preprocess_images(images_b64: list[str], image_size: int) -> np.ndarray:
         raw = base64.b64decode(item) if isinstance(item, str) else bytes(item)
         img = Image.open(io.BytesIO(raw)).convert("RGB")
         w, h = img.size
-        # shortest-edge resize (CLIPImageProcessor {"shortest_edge": S})
+        # shortest-edge resize (CLIPImageProcessor {"shortest_edge": S});
+        # the long side TRUNCATES (transformers get_resize_output_image_size
+        # uses int(), not round()) — bit-parity matters: multi-host
+        # followers re-run this on the raw payload
         if w <= h:
-            nw, nh = image_size, max(1, round(h * image_size / w))
+            nw, nh = image_size, max(1, int(h * image_size / w))
         else:
-            nw, nh = max(1, round(w * image_size / h)), image_size
+            nw, nh = max(1, int(w * image_size / h)), image_size
         img = img.resize((nw, nh), Image.Resampling.BICUBIC)
         # center crop S×S (matches transformers' center_crop rounding)
         left = (nw - image_size) // 2

@@ -10,9 +10,10 @@ Reference analogue: server/src/routes/ollama.ts (714 LoC). Endpoints:
   gridllm_metadata.num_workers_with_model
 - POST /api/embed     (:574-643), POST /api/embeddings legacy (:646-711)
 Plus endpoints the reference README claims but never implemented
-(README.md:149, 207-211; SURVEY.md §2.2): /api/version, /api/ps, /api/show.
-/api/pull, /api/delete, /api/copy, /api/push return a structured 501 until
-worker-side model management lands.
+(README.md:149, 207-211; SURVEY.md §2.2): /api/version, /api/ps, /api/show,
+and real model management — /api/pull (cluster-wide load-on-demand from
+each worker's checkpoint root, with streamed progress), /api/delete,
+/api/copy. /api/push stays 501 (no remote registry to push to).
 
 Validation mirrors the Joi schemas (ollama.ts:17-117): prompt ≤ 100 kB,
 model required.
@@ -341,7 +342,10 @@ def build_routes(registry: WorkerRegistry, scheduler: JobScheduler,
             for m in worker.capabilities.availableModels:
                 if m.name == model:
                     details = m.details or {}
-                    caps = ["completion"]
+                    if details.get("family") == "bert_embed":
+                        caps = ["embedding"]  # Ollama's shape for embed-only
+                    else:
+                        caps = ["completion"]
                     if details.get("vision") or "clip" in (
                         details.get("families") or []
                     ):
@@ -355,10 +359,117 @@ def build_routes(registry: WorkerRegistry, scheduler: JobScheduler,
                     })
         raise ApiError(f"Model '{model}' not found", 404, "MODEL_NOT_FOUND")
 
+    # ------------- model management (/api/pull, /api/delete, /api/copy) --
+    #
+    # Cluster semantics: the op broadcasts to every online worker over the
+    # bus admin channel (worker/service.py _on_admin); "pull" means
+    # load-on-demand from each worker's local checkpoint root (there is no
+    # remote registry in this deployment — the reference's pullModel/
+    # deleteModel were dead client-side stubs, OllamaService.ts:286-331).
+
+    async def _admin_broadcast(
+        op: str, payload: dict, timeout_s: float,
+        on_result=None,
+    ) -> list[dict]:
+        import asyncio
+        import json as _json
+
+        bus = registry.bus
+        rid = uuid.uuid4().hex
+        expect = max(len(registry.get_online_workers()), 1)
+        results: list[dict] = []
+        done = asyncio.Event()
+
+        async def handler(_ch: str, raw: str) -> None:
+            rec = _json.loads(raw)
+            results.append(rec)
+            # count/done BEFORE the progress callback: a raising on_result
+            # (e.g. streamed-pull client disconnect mid-write) must not
+            # leave the broadcast waiting out its whole timeout
+            if len(results) >= expect:
+                done.set()
+            if on_result is not None:
+                await on_result(rec)
+
+        sub = await bus.subscribe(f"admin:result:{rid}", handler)
+        await asyncio.sleep(0.05)  # pub/sub delivery is async (broker)
+        await bus.publish("worker:admin",
+                          _json.dumps({"op": op, "id": rid, **payload}))
+        try:
+            await asyncio.wait_for(done.wait(), timeout_s)
+        except asyncio.TimeoutError:
+            pass
+        await sub.unsubscribe()
+        return results
+
+    def _mgmt_model(body: dict) -> str:
+        model = body.get("model") or body.get("name")
+        if not model or not isinstance(model, str):
+            raise ApiError("Validation error: \"model\" is required", 400)
+        return model
+
+    async def pull(request: web.Request) -> web.StreamResponse:
+        body = await request.json()
+        model = _mgmt_model(body)
+        stream = body.get("stream", True)
+        if not registry.get_online_workers():
+            raise ApiError("no workers online", 503, "NO_WORKERS")
+        timeout_s = DEFAULT_TIMEOUT_MS / 1000.0
+        if stream:
+            resp = await start_ndjson(request)
+            await write_ndjson(resp, {"status": "pulling manifest"})
+
+            async def progress(rec: dict) -> None:
+                await write_ndjson(resp, {
+                    "status": f"{rec.get('detail') or 'done'} "
+                              f"on {rec.get('workerId')}"
+                })
+
+            results = await _admin_broadcast(
+                "load_model", {"model": model}, timeout_s, progress)
+            ok = any(r.get("ok") for r in results)
+            if ok:
+                await write_ndjson(resp, {"status": "verifying sha256 digest"})
+                await write_ndjson(resp, {"status": "success"})
+            else:
+                detail = "; ".join(
+                    str(r.get("detail")) for r in results) or "no worker replied"
+                await write_ndjson(resp, {"error": f"pull failed: {detail}"})
+            await resp.write_eof()
+            return resp
+        results = await _admin_broadcast("load_model", {"model": model}, timeout_s)
+        if any(r.get("ok") for r in results):
+            return web.json_response({"status": "success"})
+        detail = "; ".join(str(r.get("detail")) for r in results) or "no worker replied"
+        raise ApiError(f"pull failed: {detail}", 500, "PULL_FAILED")
+
+    async def delete_model(request: web.Request) -> web.Response:
+        body = await request.json()
+        model = _mgmt_model(body)
+        results = await _admin_broadcast("unload_model", {"model": model}, 30.0)
+        if any(r.get("ok") for r in results):
+            model_expiry.pop(model, None)
+            return web.json_response({})  # Ollama: 200 empty on success
+        raise ApiError(f"Model '{model}' not found", 404, "MODEL_NOT_FOUND")
+
+    async def copy_model(request: web.Request) -> web.Response:
+        body = await request.json()
+        src, dst = body.get("source"), body.get("destination")
+        if not src or not dst:
+            raise ApiError(
+                "Validation error: \"source\" and \"destination\" are required",
+                400)
+        results = await _admin_broadcast(
+            "copy_model", {"source": src, "destination": dst}, 30.0)
+        if any(r.get("ok") for r in results):
+            return web.json_response({})
+        raise ApiError(f"Model '{src}' not found", 404, "MODEL_NOT_FOUND")
+
     async def not_supported(request: web.Request) -> web.Response:
         raise ApiError(
-            "Model management is handled by worker configuration in GridLLM-TPU; "
-            f"{request.path} is not supported by the gateway", 501, "NOT_SUPPORTED")
+            "There is no remote model registry in GridLLM-TPU; "
+            f"{request.path} is not supported by the gateway", 501,
+            "NOT_SUPPORTED")
 
     routes.append(web.post("/api/generate", generate))
     routes.append(web.post("/api/chat", chat))
@@ -368,8 +479,9 @@ def build_routes(registry: WorkerRegistry, scheduler: JobScheduler,
     routes.append(web.get("/api/version", api_version))
     routes.append(web.get("/api/ps", ps))
     routes.append(web.post("/api/show", show))
-    for path in ("/api/pull", "/api/push", "/api/copy"):
-        routes.append(web.post(path, not_supported))
-    routes.append(web.delete("/api/delete", not_supported))
+    routes.append(web.post("/api/pull", pull))
+    routes.append(web.post("/api/copy", copy_model))
+    routes.append(web.delete("/api/delete", delete_model))
+    routes.append(web.post("/api/push", not_supported))
     return routes
 

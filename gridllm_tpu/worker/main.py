@@ -47,32 +47,39 @@ def resolve_checkpoint(root: str | None, model: str) -> tuple[str | None, str | 
     return cand, tok_sub if os.path.isdir(tok_sub) else cand
 
 
+def _mesh_config(config: Config) -> MeshConfig | None:
+    if not config.engine.mesh_shape:
+        return None
+    axes = dict(
+        kv.split(":") for kv in config.engine.mesh_shape.split(",") if kv
+    )
+    return MeshConfig(**{k: int(v) for k, v in axes.items()})
+
+
+def build_one_engine(config: Config, name: str) -> InferenceEngine:
+    """Engine for one model under this worker's settings — used at startup
+    and by /api/pull load-on-demand (WorkerService.engine_factory)."""
+    ckpt, tok = resolve_checkpoint(config.engine.checkpoint_dir, name)
+    buckets = tuple(
+        int(b) for b in config.engine.prefill_buckets.split(",") if b
+    )
+    eng = InferenceEngine(EngineConfig(
+        model=name,
+        checkpoint_path=ckpt,
+        tokenizer=tok,
+        dtype=config.engine.dtype,
+        max_slots=config.engine.max_batch_slots,
+        page_size=config.engine.kv_page_size,
+        prefill_buckets=buckets,
+        mesh=_mesh_config(config),
+    ))
+    log.info("engine ready", model=name, checkpoint=ckpt or "random-init")
+    return eng
+
+
 def build_engines(config: Config) -> dict[str, InferenceEngine]:
-    engines: dict[str, InferenceEngine] = {}
     names = [m.strip() for m in config.engine.models.split(",") if m.strip()]
-    mesh = None
-    if config.engine.mesh_shape:
-        axes = dict(
-            kv.split(":") for kv in config.engine.mesh_shape.split(",") if kv
-        )
-        mesh = MeshConfig(**{k: int(v) for k, v in axes.items()})
-    for name in names:
-        ckpt, tok = resolve_checkpoint(config.engine.checkpoint_dir, name)
-        buckets = tuple(
-            int(b) for b in config.engine.prefill_buckets.split(",") if b
-        )
-        engines[name] = InferenceEngine(EngineConfig(
-            model=name,
-            checkpoint_path=ckpt,
-            tokenizer=tok,
-            dtype=config.engine.dtype,
-            max_slots=config.engine.max_batch_slots,
-            page_size=config.engine.kv_page_size,
-            prefill_buckets=buckets,
-            mesh=mesh,
-        ))
-        log.info("engine ready", model=name, checkpoint=ckpt or "random-init")
-    return engines
+    return {name: build_one_engine(config, name) for name in names}
 
 
 def build_health_app(service: WorkerService) -> web.Application:
@@ -159,6 +166,13 @@ async def run(config: Config | None = None) -> None:
         service = WorkerService(
             bus, engines, config.worker,
             stream_flush_ms=config.engine.stream_flush_ms,
+            # load-on-demand (/api/pull) only outside a worker group: a
+            # slice's engines must be built in lockstep on every process
+            # (plan replay has no engine-construction op)
+            engine_factory=(
+                None if group.is_group
+                else (lambda name: build_one_engine(config, name))
+            ),
         )
 
         async def on_slice_failure(reason: str) -> None:

@@ -66,6 +66,7 @@ class WorkerService(EventEmitter):
         engines: dict[str, InferenceEngine],
         config: WorkerConfig | None = None,
         stream_flush_ms: int = 20,
+        engine_factory: Any | None = None,
     ):
         super().__init__()
         self.bus = bus
@@ -78,6 +79,10 @@ class WorkerService(EventEmitter):
         self.max_concurrent = max(
             sum(e.config.max_slots for e in engines.values()), 1
         )
+        # model management (/api/pull): builds an InferenceEngine for a
+        # model name on demand (worker/main.py passes its config-bound
+        # builder). None → load_model admin ops are rejected.
+        self.engine_factory = engine_factory
         self._running = False
         self._subs: list[Subscription] = []
         self._tasks: list[asyncio.Task] = []
@@ -92,6 +97,8 @@ class WorkerService(EventEmitter):
             f"worker:{self.worker_id}:job", self._on_job_message))
         self._subs.append(await self.bus.subscribe(
             f"worker:reregister:{self.worker_id}", self._on_reregister))
+        self._subs.append(await self.bus.subscribe(
+            "worker:admin", self._on_admin))
         await self.register()
         self._tasks.append(asyncio.create_task(self._heartbeat_loop()))
         self._tasks.append(asyncio.create_task(self._resource_loop()))
@@ -146,6 +153,87 @@ class WorkerService(EventEmitter):
     async def _on_reregister(self, _ch: str, _raw: str) -> None:
         log.info("re-registration requested", workerId=self.worker_id)
         await self.register()
+
+    # --------------------------------------------------- model management
+    #
+    # Ollama's pull/delete/copy, reimagined for a cluster: the gateway
+    # broadcasts an admin op on `worker:admin`; every worker answers on
+    # `admin:result:{op id}` with {workerId, ok, detail}. The reference
+    # had client-side pullModel/deleteModel stubs that no route ever
+    # called (client/src/services/OllamaService.ts:286-331) — here the
+    # routes are real and the weights come from the worker's local
+    # checkpoint root ("pull" = load-on-demand; this deployment has no
+    # remote registry to download from).
+
+    async def _on_admin(self, _ch: str, raw: str) -> None:
+        msg = json.loads(raw)
+        op, rid = msg.get("op"), msg.get("id")
+        if not op or not rid:
+            return
+        ok, detail = False, ""
+        try:
+            if op == "load_model":
+                ok, detail = await self._admin_load(msg["model"])
+            elif op == "unload_model":
+                ok, detail = await self._admin_unload(msg["model"])
+            elif op == "copy_model":
+                ok, detail = await self._admin_copy(
+                    msg["source"], msg["destination"]
+                )
+            else:
+                detail = f"unknown admin op {op!r}"
+        except Exception as e:  # noqa: BLE001 — always answer the gateway
+            detail = str(e)
+        await self.bus.publish(f"admin:result:{rid}", json.dumps({
+            "workerId": self.worker_id, "op": op, "ok": ok, "detail": detail,
+        }))
+
+    async def _admin_load(self, model: str) -> tuple[bool, str]:
+        if self._resolve_engine(model) is not None:
+            return True, "already loaded"
+        if self.engine_factory is None:
+            return False, "model management disabled on this worker"
+        eng = await asyncio.to_thread(self.engine_factory, model)
+        if not eng.embedding_only:
+            eng.start()
+        self.engines[model] = eng
+        self.max_concurrent = max(
+            sum(e.config.max_slots for e in self.engines.values()), 1
+        )
+        await self.register()
+        src = "checkpoint" if eng.config.checkpoint_path else "random-init"
+        log.info("model loaded on demand", model=model, weights=src)
+        return True, f"loaded ({src})"
+
+    async def _admin_unload(self, model: str) -> tuple[bool, str]:
+        name = self._resolve_name(model)
+        if name is None:
+            return False, "not loaded here"
+        eng = self.engines.pop(name)
+        # copies alias the same engine under other names; only stop the
+        # runner when the last name referencing it is gone. Abort first:
+        # stop() alone would leave in-flight/queued requests without their
+        # error callback, hanging their clients until the gateway timeout.
+        if eng not in self.engines.values() and not eng.embedding_only:
+            eng.abort_all(f"model {name} unloaded")
+            await asyncio.to_thread(eng.stop)
+        self.max_concurrent = max(
+            sum(e.config.max_slots for e in self.engines.values()), 1
+        )
+        await self.register()
+        log.info("model unloaded", model=name)
+        return True, "unloaded"
+
+    async def _admin_copy(self, source: str, dest: str) -> tuple[bool, str]:
+        eng = self._resolve_engine(source)
+        if eng is None:
+            return False, "source not loaded here"
+        if dest in self.engines:
+            return True, "destination already exists"
+        self.engines[dest] = eng  # alias: same engine, second name
+        await self.register()
+        log.info("model copied", source=source, destination=dest)
+        return True, "copied"
 
     # -------------------------------------------------------------- loops
 
@@ -239,18 +327,23 @@ class WorkerService(EventEmitter):
             return
         asyncio.ensure_future(self._execute(assignment))
 
-    def _resolve_engine(self, model: str) -> InferenceEngine | None:
-        """Exact match, plus the one alias Ollama itself applies: a bare
-        model name means the ':latest' tag and vice versa. (The round-1
-        dash heuristic — model.split('-')[0] — could only ever produce
-        wrong or missed lookups, e.g. 'all-minilm' → 'all'.)"""
+    def _resolve_name(self, model: str) -> str | None:
+        """Served-engine key for a requested model name: exact match, plus
+        the one alias Ollama itself applies — a bare model name means the
+        ':latest' tag and vice versa. (The round-1 dash heuristic —
+        model.split('-')[0] — could only ever produce wrong or missed
+        lookups, e.g. 'all-minilm' → 'all'.)"""
         if model in self.engines:
-            return self.engines[model]
-        if model.endswith(":latest"):
-            return self.engines.get(model[: -len(":latest")])
-        if ":" not in model:
-            return self.engines.get(f"{model}:latest")
+            return model
+        if model.endswith(":latest") and model[: -len(":latest")] in self.engines:
+            return model[: -len(":latest")]
+        if ":" not in model and f"{model}:latest" in self.engines:
+            return f"{model}:latest"
         return None
+
+    def _resolve_engine(self, model: str) -> InferenceEngine | None:
+        name = self._resolve_name(model)
+        return None if name is None else self.engines[name]
 
     async def _execute(self, assignment: JobAssignment) -> None:
         req = assignment.request

@@ -327,7 +327,9 @@ async def test_api_version_and_ps():
     resp = await client.get("/api/ps")
     body = await resp.json()
     assert body["models"][0]["name"] == "m1"
-    resp = await client.post("/api/pull", json={"model": "m1"})
+    # /api/pull is real now (model management); /api/push has no remote
+    # registry to push to and stays 501
+    resp = await client.post("/api/push", json={"model": "m1"})
     assert resp.status == 501
     await teardown(client, bus, registry, scheduler, w)
 
