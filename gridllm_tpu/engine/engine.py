@@ -376,6 +376,16 @@ class InferenceEngine:
 
             attn = partial(ring_attention, mesh=self.mesh)
 
+        # pp > 1 → pipeline parallelism: layer blocks as token-passing
+        # stages (parallel/pipeline.py); same family API, so the jitted
+        # step fns below are oblivious to which module serves them
+        mod = self.mod
+        if self.mesh is not None and self.mesh.shape.get("pp", 1) > 1:
+            from gridllm_tpu.parallel import pipeline
+
+            pipeline.validate(self.cfg, self.mesh)
+            mod = pipeline
+
         def _gather_sp(sp: SamplingParams, slot) -> SamplingParams:
             return jax.tree.map(lambda a: a[slot][None], sp)
 
@@ -388,7 +398,7 @@ class InferenceEngine:
         @partial(jax.jit, donate_argnums=(2, 3, 4, 5, 6, 7, 8))
         def prefill_fn(params, prompt, cache, counts, window, wlen, tokens,
                        active, sp, length, slot, table_row, embeds=None):
-            logits, cache = self.mod.prefill(
+            logits, cache = mod.prefill(
                 params, mc, prompt, length, cache, slot, table_row, attn=attn,
                 mesh=self.mesh, embeds=embeds,
             )
@@ -412,7 +422,7 @@ class InferenceEngine:
         def prefill_chunk_fn(params, prompt, cache, counts, window, wlen,
                              tokens, active, sp, start, length, slot,
                              table_row, is_final, embeds=None):
-            logits, cache = self.mod.prefill_chunk(
+            logits, cache = mod.prefill_chunk(
                 params, mc, prompt, start, length, cache, slot, table_row,
                 mesh=self.mesh, embeds=embeds,
             )
@@ -452,7 +462,7 @@ class InferenceEngine:
 
             def body(carry, _):
                 tokens, cache, counts, window, wlen, sp = carry
-                logits, cache = self.mod.decode_step(
+                logits, cache = mod.decode_step(
                     params, mc, tokens, cache, active, mesh=self.mesh
                 )
                 sampled = sample_tokens(logits, sp, counts)

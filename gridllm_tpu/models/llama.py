@@ -226,6 +226,43 @@ def _seq_constraint(mesh) -> Callable[[jnp.ndarray], jnp.ndarray]:
     return lambda x: jax.lax.with_sharding_constraint(x, s)
 
 
+def prefill_layers(
+    layers: Params,
+    cfg: ModelConfig,
+    x: jnp.ndarray,
+    seq_lens: jnp.ndarray,
+    mlp: MlpFn = _mlp,
+    attn: AttnFn | None = None,
+    seq_c: Callable[[jnp.ndarray], jnp.ndarray] = lambda x: x,
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Self-contained prefill layer scan over an arbitrary stacked block of
+    layers (full [L] stack from `prefill`; per-stage blocks from
+    parallel/pipeline.py). x: [1, T, E] in; returns (x out,
+    k_new [N, T, KVH, D], v_new) — pool writes are the caller's.
+    """
+    if attn is None:
+        attn = _default_attn(cfg)
+    t = x.shape[1]
+    inv_freq = precompute_rope(cfg.head_dim_, cfg.rope_theta, cfg.rope_scaling)
+    pos = jnp.arange(t, dtype=jnp.int32)[None]
+
+    def layer(x, lp):
+        hx = rms_norm(x, lp["attn_norm"], cfg.rms_eps)
+        q, k, v = _qkv(cfg, lp, hx)
+        q = apply_rope(q, pos, inv_freq)
+        k = apply_rope(k, pos, inv_freq)
+        att = attn(q, k, v, seq_lens).reshape(1, t, -1)
+        x = seq_c(x + qdot(att, lp["wo"], precision=_precision(x)))
+        hx = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
+        # K/V ride out as scan ys; the pool is written ONCE after the scan
+        # (per-layer writes inside the scan defeat XLA's in-place aliasing
+        # and cost full-pool copies — round-4 profiling)
+        return seq_c(x + mlp(lp, hx)), (k[0], v[0])
+
+    x, (k_new, v_new) = jax.lax.scan(layer, x, layers)
+    return x, k_new, v_new
+
+
 def prefill(
     params: Params,
     cfg: ModelConfig,
@@ -253,26 +290,11 @@ def prefill(
         attn = _default_attn(cfg)
     seq_c = _seq_constraint(mesh)
     t = tokens.shape[0]
-    inv_freq = precompute_rope(cfg.head_dim_, cfg.rope_theta, cfg.rope_scaling)
     x = params["embed"][tokens] if embeds is None else embeds
     x = seq_c(x.astype(params["embed"].dtype)[None])  # [1, T, E]
-    pos = jnp.arange(t, dtype=jnp.int32)[None]
-    seq_lens = length[None]
-
-    def layer(x, lp):
-        hx = rms_norm(x, lp["attn_norm"], cfg.rms_eps)
-        q, k, v = _qkv(cfg, lp, hx)
-        q = apply_rope(q, pos, inv_freq)
-        k = apply_rope(k, pos, inv_freq)
-        att = attn(q, k, v, seq_lens).reshape(1, t, -1)
-        x = seq_c(x + qdot(att, lp["wo"], precision=_precision(x)))
-        hx = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
-        # K/V ride out as scan ys; the pool is written ONCE after the scan
-        # (per-layer writes inside the scan defeat XLA's in-place aliasing
-        # and cost full-pool copies — round-4 profiling)
-        return seq_c(x + mlp(lp, hx)), (k[0], v[0])
-
-    x, (k_new, v_new) = jax.lax.scan(layer, x, params["layers"])
+    x, k_new, v_new = prefill_layers(
+        params["layers"], cfg, x, length[None], mlp, attn, seq_c
+    )
     x = rms_norm(x, params["final_norm"], cfg.rms_eps)
     # last *valid* token's logits
     last = x[0, jnp.maximum(length - 1, 0)]
@@ -315,33 +337,11 @@ def prefill_chunk(
     start + length).
     """
     _check_supported(cfg)
-    t = tokens.shape[0]
-    inv_freq = precompute_rope(cfg.head_dim_, cfg.rope_theta, cfg.rope_scaling)
     x = params["embed"][tokens] if embeds is None else embeds
     x = x.astype(params["embed"].dtype)[None]  # [1, C, E]
-    pos = (start + jnp.arange(t, dtype=jnp.int32))[None]
-    total = start + length
-
-    def layer(x, xs):
-        lp, li = xs
-        hx = rms_norm(x, lp["attn_norm"], cfg.rms_eps)
-        q, k, v = _qkv(cfg, lp, hx)
-        q = apply_rope(q, pos, inv_freq)
-        k = apply_rope(k, pos, inv_freq)
-        # pool holds the PREFIX only (writes deferred past the scan); the
-        # fresh chunk's K/V are overlaid inside the attention. Full pool as
-        # closure + layer index — see decode_step.
-        att = attention_prefix_chunk(
-            q, cache.k, cache.v, table_row, start, total, cache.page_size,
-            k_cur=k[0], v_cur=v[0], layer=li, use_pallas=cfg.use_pallas,
-        ).reshape(1, t, -1)
-        x = x + qdot(att, lp["wo"], precision=_precision(x))
-        hx = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
-        return x + mlp(lp, hx), (k[0], v[0])
-
-    x, (k_new, v_new) = jax.lax.scan(
-        layer, x,
-        (params["layers"], jnp.arange(cfg.num_layers, dtype=jnp.int32)),
+    x, k_new, v_new = prefill_chunk_layers(
+        params["layers"], cfg, x, cache.k, cache.v, table_row, start,
+        length, cache.page_size, mlp,
     )
     x = rms_norm(x, params["final_norm"], cfg.rms_eps)
     last = x[0, jnp.maximum(length - 1, 0)]
@@ -354,10 +354,105 @@ def prefill_chunk(
     cache = PagedKVCache(
         k=k_pool, v=v_pool,
         page_table=cache.page_table.at[slot].set(table_row),
-        lengths=cache.lengths.at[slot].set(total),
+        lengths=cache.lengths.at[slot].set(start + length),
         page_size=cache.page_size,
     )
     return logits, cache
+
+
+def prefill_chunk_layers(
+    layers: Params,
+    cfg: ModelConfig,
+    x: jnp.ndarray,
+    k_pool: jnp.ndarray,
+    v_pool: jnp.ndarray,
+    table_row: jnp.ndarray,
+    start: jnp.ndarray,
+    length: jnp.ndarray,
+    page_size: int,
+    mlp: MlpFn = _mlp,
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Chunked-prefill layer scan over an arbitrary stacked block of
+    layers against the slot's cached prefix (full stack from
+    `prefill_chunk`; per-stage blocks from parallel/pipeline.py).
+    x: [1, C, E] in; returns (x out, k_new [N, C, KVH, D], v_new)."""
+    t = x.shape[1]
+    inv_freq = precompute_rope(cfg.head_dim_, cfg.rope_theta, cfg.rope_scaling)
+    pos = (start + jnp.arange(t, dtype=jnp.int32))[None]
+    total = start + length
+    n = jax.tree.leaves(layers)[0].shape[0]
+
+    def layer(x, xs):
+        lp, li = xs
+        hx = rms_norm(x, lp["attn_norm"], cfg.rms_eps)
+        q, k, v = _qkv(cfg, lp, hx)
+        q = apply_rope(q, pos, inv_freq)
+        k = apply_rope(k, pos, inv_freq)
+        # pool holds the PREFIX only (writes deferred past the scan); the
+        # fresh chunk's K/V are overlaid inside the attention. Full pool as
+        # closure + layer index — see decode_layers.
+        att = attention_prefix_chunk(
+            q, k_pool, v_pool, table_row, start, total, page_size,
+            k_cur=k[0], v_cur=v[0], layer=li, use_pallas=cfg.use_pallas,
+        ).reshape(1, t, -1)
+        x = x + qdot(att, lp["wo"], precision=_precision(x))
+        hx = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
+        return x + mlp(lp, hx), (k[0], v[0])
+
+    x, (k_new, v_new) = jax.lax.scan(
+        layer, x, (layers, jnp.arange(n, dtype=jnp.int32))
+    )
+    return x, k_new, v_new
+
+
+def decode_layers(
+    layers: Params,
+    cfg: ModelConfig,
+    x: jnp.ndarray,
+    k_pool: jnp.ndarray,
+    v_pool: jnp.ndarray,
+    page_table: jnp.ndarray,
+    positions: jnp.ndarray,
+    page_size: int,
+    mlp: MlpFn = _mlp,
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """The decode layer scan over an arbitrary stacked block of layers.
+
+    `layers` leaves are stacked [N, ...]; `k_pool`/`v_pool` is the
+    matching [N, P, ps, KVH, D] pool block. decode_step runs this over the
+    full [L] stack; parallel/pipeline.py runs it per pp stage with the
+    stage's local block. x: [S, E] residual stream in; returns
+    (x out, k_new [N, S, KVH, D], v_new) — pool writes are the caller's
+    (deferred one-shot write after the scan).
+    """
+    s = x.shape[0]
+    inv_freq = precompute_rope(cfg.head_dim_, cfg.rope_theta, cfg.rope_scaling)
+    n = jax.tree.leaves(layers)[0].shape[0]
+
+    def layer(x, xs):
+        lp, li = xs
+        hx = rms_norm(x, lp["attn_norm"], cfg.rms_eps)
+        q, k, v = _qkv(cfg, lp, hx)  # q: [S, H, D] (T-less), k/v: [S, KVH, D]
+        q = apply_rope(q[:, None], positions[:, None], inv_freq)[:, 0]
+        k = apply_rope(k[:, None], positions[:, None], inv_freq)[:, 0]
+        # pool holds the prefix only (lengths = positions); the current
+        # token's K/V are merged in-register by the attention and written
+        # to the pool ONCE after the scan (in-place DMA kernel). The FULL
+        # pool rides in as a scan closure with `li` selecting the layer —
+        # per-layer xs slices would materialize 2×pool-slice copies/iter.
+        attn = paged_attention_decode(
+            q, k_pool, v_pool, page_table, positions,
+            page_size, k_cur=k, v_cur=v, layer=li,
+            use_pallas=cfg.use_pallas,
+        ).reshape(s, -1)
+        x = x + qdot(attn, lp["wo"], precision=_precision(x))
+        hx = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
+        return x + mlp(lp, hx), (k, v)
+
+    x, (k_new, v_new) = jax.lax.scan(
+        layer, x, (layers, jnp.arange(n, dtype=jnp.int32))
+    )
+    return x, k_new, v_new
 
 
 def decode_step(
@@ -374,8 +469,6 @@ def decode_step(
     with lengths advanced for active slots).
     """
     _check_supported(cfg)
-    s = tokens.shape[0]
-    inv_freq = precompute_rope(cfg.head_dim_, cfg.rope_theta, cfg.rope_scaling)
     x = params["embed"][tokens]  # [S, E]
     positions = cache.lengths  # new token's position per slot
     # clamp at pool-wide capacity: finished slots stay device-active for up
@@ -386,29 +479,9 @@ def decode_step(
         cache.lengths + active.astype(jnp.int32), cache.max_context
     )
 
-    def layer(x, xs):
-        lp, li = xs
-        hx = rms_norm(x, lp["attn_norm"], cfg.rms_eps)
-        q, k, v = _qkv(cfg, lp, hx)  # q: [S, H, D] (T-less), k/v: [S, KVH, D]
-        q = apply_rope(q[:, None], positions[:, None], inv_freq)[:, 0]
-        k = apply_rope(k[:, None], positions[:, None], inv_freq)[:, 0]
-        # pool holds the prefix only (lengths = positions); the current
-        # token's K/V are merged in-register by the attention and written
-        # to the pool ONCE after the scan (in-place DMA kernel). The FULL
-        # pool rides in as a scan closure with `li` selecting the layer —
-        # per-layer xs slices would materialize 2×pool-slice copies/iter.
-        attn = paged_attention_decode(
-            q, cache.k, cache.v, cache.page_table, positions,
-            cache.page_size, k_cur=k, v_cur=v, layer=li,
-            use_pallas=cfg.use_pallas,
-        ).reshape(s, -1)
-        x = x + qdot(attn, lp["wo"], precision=_precision(x))
-        hx = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
-        return x + mlp(lp, hx), (k, v)
-
-    x, (k_new, v_new) = jax.lax.scan(
-        layer, x,
-        (params["layers"], jnp.arange(cfg.num_layers, dtype=jnp.int32)),
+    x, k_new, v_new = decode_layers(
+        params["layers"], cfg, x, cache.k, cache.v, cache.page_table,
+        positions, cache.page_size, mlp,
     )
     x = rms_norm(x, params["final_norm"], cfg.rms_eps)
     logits = _unembed(cfg, params, x)

@@ -1,6 +1,10 @@
 """Device-mesh construction.
 
 Axis vocabulary (fixed across the framework):
+  "pp" — pipeline axis: decoder LAYERS sharded here (parallel/pipeline.py
+         token-passing stages); activations hop stages via ppermute, so
+         per-step traffic is one [S, E] tensor per hop — cheap enough for
+         DCN, hence outermost
   "dp" — replica/data axis: independent continuous batches (slots split here)
   "tp" — tensor axis: attention heads + MLP hidden sharded here; the decode
          all-reduce rides this axis over ICI
@@ -22,20 +26,21 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
-AXES = ("dp", "ep", "tp", "sp")
+AXES = ("pp", "dp", "ep", "tp", "sp")
 
 
 @dataclasses.dataclass(frozen=True)
 class MeshConfig:
     """Logical mesh shape. -1 on at most one axis means "absorb the rest"."""
 
+    pp: int = 1
     dp: int = 1
     ep: int = 1
     tp: int = -1
     sp: int = 1
 
-    def resolve(self, n_devices: int) -> tuple[int, int, int, int]:
-        dims = [self.dp, self.ep, self.tp, self.sp]
+    def resolve(self, n_devices: int) -> tuple[int, int, int, int, int]:
+        dims = [self.pp, self.dp, self.ep, self.tp, self.sp]
         wild = [i for i, d in enumerate(dims) if d == -1]
         if len(wild) > 1:
             raise ValueError("at most one mesh axis may be -1")

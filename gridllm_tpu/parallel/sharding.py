@@ -42,28 +42,32 @@ def _fit(mesh: Mesh, shape: tuple[int, ...], spec: tuple[str | None, ...]) -> Na
 # leaf-name → spec template, by trailing path component. Templates are for
 # the STACKED [L, ...] layout of `layers` leaves; non-layer leaves listed
 # with their own rank.
+# Stacked layer leaves put the [L] axis on "pp" (pipeline stages own
+# contiguous layer blocks; _fit's divisibility fallback replicates when
+# L % pp != 0 — but the pipeline itself requires divisibility and the
+# engine validates it up front).
 _LAYER_SPECS: dict[str, tuple[str | None, ...]] = {
-    "wq": (None, None, "tp"),
-    "wk": (None, None, "tp"),
-    "wv": (None, None, "tp"),
-    "wo": (None, "tp", None),
-    "w_gate": (None, None, "tp"),
-    "w_up": (None, None, "tp"),
-    "w_down": (None, "tp", None),
-    "attn_norm": (None, None),
-    "mlp_norm": (None, None),
+    "wq": ("pp", None, "tp"),
+    "wk": ("pp", None, "tp"),
+    "wv": ("pp", None, "tp"),
+    "wo": ("pp", "tp", None),
+    "w_gate": ("pp", None, "tp"),
+    "w_up": ("pp", None, "tp"),
+    "w_down": ("pp", "tp", None),
+    "attn_norm": ("pp", None),
+    "mlp_norm": ("pp", None),
     # qwen2 qkv bias: [L, out] shards with its projection's out dim
-    "bq": (None, "tp"),
-    "bk": (None, "tp"),
-    "bv": (None, "tp"),
-    # qwen3 per-head qk norms: [L, D] replicated
-    "q_norm": (None, None),
-    "k_norm": (None, None),
+    "bq": ("pp", "tp"),
+    "bk": ("pp", "tp"),
+    "bv": ("pp", "tp"),
+    # qwen3 per-head qk norms: [L, D]
+    "q_norm": ("pp", None),
+    "k_norm": ("pp", None),
     # MoE router + experts (mixtral): experts stacked on a [L, X, ...] axis
-    "router": (None, None, None),
-    "we_gate": (None, "ep", None, "tp"),
-    "we_up": (None, "ep", None, "tp"),
-    "we_down": (None, "ep", "tp", None),
+    "router": ("pp", None, None),
+    "we_gate": ("pp", "ep", None, "tp"),
+    "we_up": ("pp", "ep", None, "tp"),
+    "we_down": ("pp", "ep", "tp", None),
 }
 _TOP_SPECS: dict[str, tuple[str | None, ...]] = {
     "embed": ("tp", None),
@@ -93,9 +97,10 @@ def param_shardings(params: Any, mesh: Mesh) -> Any:
 
 
 def cache_shardings(cache: Any, mesh: Mesh) -> Any:
-    """PagedKVCache-shaped pytree of shardings: pools KVH-sharded on tp,
-    tables/lengths replicated (they are tiny and host-authored)."""
-    pool = _fit(mesh, cache.k.shape, (None, None, None, "tp", None))
+    """PagedKVCache-shaped pytree of shardings: pools layer-sharded on pp
+    and KVH-sharded on tp, tables/lengths replicated (they are tiny and
+    host-authored)."""
+    pool = _fit(mesh, cache.k.shape, ("pp", None, None, "tp", None))
     rep_t = NamedSharding(mesh, P(*(None,) * cache.page_table.ndim))
     rep_l = NamedSharding(mesh, P(None))
     return jax.tree_util.tree_unflatten(

@@ -92,7 +92,9 @@ class EngineConfig(BaseModel):
     prefill_buckets: str = "512,1024,2048,4096,8192"
     kv_page_size: int = 128
     stream_flush_ms: int = 20          # token-frame batching window
-    mesh_shape: str = ""               # e.g. "data:1,model:8"; "" → single device
+    # mesh axes (parallel/mesh.py): e.g. "tp:8", "pp:2,tp:4", "dp:2,tp:4";
+    # "" → single device
+    mesh_shape: str = ""
     decode_steps_per_host_sync: int = 8
 
 

@@ -125,7 +125,8 @@ def gather_capabilities(
         mesh = getattr(eng, "mesh", None)
         layouts.append(ModelShardLayout(
             name=name,
-            strategy="tensor" if mesh is not None and mesh.shape.get("tp", 1) > 1
+            strategy="pipeline" if mesh is not None and mesh.shape.get("pp", 1) > 1
+            else "tensor" if mesh is not None and mesh.shape.get("tp", 1) > 1
             else "expert" if mesh is not None and mesh.shape.get("ep", 1) > 1
             else "replicated",
             meshAxes=dict(mesh.shape) if mesh is not None else {},

@@ -56,9 +56,32 @@ def _mesh_config(config: Config) -> MeshConfig | None:
     return MeshConfig(**{k: int(v) for k, v in axes.items()})
 
 
+def pull_engine_factory(config: Config):
+    """WorkerService.engine_factory for /api/pull: like build_one_engine
+    but REFUSES models whose checkpoint does not resolve — a pull that
+    "succeeds" onto random weights would serve gibberish with a success
+    status. GRIDLLM_ALLOW_SYNTHETIC_WEIGHTS=1 overrides (test/bench
+    deployments that intentionally run synthetic weights)."""
+
+    def factory(name: str) -> InferenceEngine:
+        ckpt, _ = resolve_checkpoint(config.engine.checkpoint_dir, name)
+        if ckpt is None and not os.environ.get(
+            "GRIDLLM_ALLOW_SYNTHETIC_WEIGHTS"
+        ):
+            raise ValueError(
+                f"no checkpoint for {name!r} under "
+                f"{config.engine.checkpoint_dir or '$GRIDLLM_CHECKPOINT_DIR'}"
+                " — refusing to serve random weights (set "
+                "GRIDLLM_ALLOW_SYNTHETIC_WEIGHTS=1 to override)"
+            )
+        return build_one_engine(config, name)
+
+    return factory
+
+
 def build_one_engine(config: Config, name: str) -> InferenceEngine:
     """Engine for one model under this worker's settings — used at startup
-    and by /api/pull load-on-demand (WorkerService.engine_factory)."""
+    and by /api/pull load-on-demand (via pull_engine_factory)."""
     ckpt, tok = resolve_checkpoint(config.engine.checkpoint_dir, name)
     buckets = tuple(
         int(b) for b in config.engine.prefill_buckets.split(",") if b
@@ -166,14 +189,15 @@ async def run(config: Config | None = None) -> None:
         service = WorkerService(
             bus, engines, config.worker,
             stream_flush_ms=config.engine.stream_flush_ms,
-            # load-on-demand (/api/pull) only outside a worker group: a
-            # slice's engines must be built in lockstep on every process
-            # (plan replay has no engine-construction op)
+            # model management only outside a worker group: a slice's
+            # engines must be built (and torn down) in lockstep on every
+            # process — plan replay has no engine-construction op
             engine_factory=(
-                None if group.is_group
-                else (lambda name: build_one_engine(config, name))
+                None if group.is_group else pull_engine_factory(config)
             ),
         )
+        if group.is_group:
+            service.admin_ops_enabled = False
 
         async def on_slice_failure(reason: str) -> None:
             await fail_logical_worker(bus, service.worker_id, reason)

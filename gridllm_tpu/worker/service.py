@@ -50,6 +50,15 @@ from gridllm_tpu.worker.prompting import (
 log = get_logger("worker")
 
 
+def _capacity(engines: dict) -> int:
+    """Total concurrent slots across UNIQUE engines — /api/copy aliases
+    the same engine under a second name, and counting it per name would
+    over-advertise capacity (jobs queueing inside the engine instead of
+    being NACKed to other workers)."""
+    uniq = {id(e): e for e in engines.values()}
+    return max(sum(e.config.max_slots for e in uniq.values()), 1)
+
+
 class NonRetryableJobError(RuntimeError):
     """Failure that is permanent cluster-wide (e.g. generation on an
     embedding-only model) — published with retryable=False so the
@@ -76,13 +85,17 @@ class WorkerService(EventEmitter):
         self.stream_flush_s = stream_flush_ms / 1000.0
         self.current_jobs = 0
         self.total_processed = 0
-        self.max_concurrent = max(
-            sum(e.config.max_slots for e in engines.values()), 1
-        )
+        self.max_concurrent = _capacity(engines)
         # model management (/api/pull): builds an InferenceEngine for a
         # model name on demand (worker/main.py passes its config-bound
         # builder). None → load_model admin ops are rejected.
         self.engine_factory = engine_factory
+        # multi-host worker groups disable ALL admin ops (load/unload/
+        # copy), not just load: a slice builds identical engines on every
+        # process for plan replay — a liaison-only unload would free the
+        # liaison's HBM, orphan the followers' copies, and leave the
+        # slice asymmetric with no way to reload (worker/main.py).
+        self.admin_ops_enabled = True
         self._running = False
         self._subs: list[Subscription] = []
         self._tasks: list[asyncio.Task] = []
@@ -171,6 +184,13 @@ class WorkerService(EventEmitter):
         if not op or not rid:
             return
         ok, detail = False, ""
+        if not self.admin_ops_enabled:
+            await self.bus.publish(f"admin:result:{rid}", json.dumps({
+                "workerId": self.worker_id, "op": op, "ok": False,
+                "detail": "model management disabled on multi-host "
+                          "worker groups",
+            }))
+            return
         try:
             if op == "load_model":
                 ok, detail = await self._admin_load(msg["model"])
@@ -197,9 +217,7 @@ class WorkerService(EventEmitter):
         if not eng.embedding_only:
             eng.start()
         self.engines[model] = eng
-        self.max_concurrent = max(
-            sum(e.config.max_slots for e in self.engines.values()), 1
-        )
+        self.max_concurrent = _capacity(self.engines)
         await self.register()
         src = "checkpoint" if eng.config.checkpoint_path else "random-init"
         log.info("model loaded on demand", model=model, weights=src)
@@ -217,9 +235,7 @@ class WorkerService(EventEmitter):
         if eng not in self.engines.values() and not eng.embedding_only:
             eng.abort_all(f"model {name} unloaded")
             await asyncio.to_thread(eng.stop)
-        self.max_concurrent = max(
-            sum(e.config.max_slots for e in self.engines.values()), 1
-        )
+        self.max_concurrent = _capacity(self.engines)
         await self.register()
         log.info("model unloaded", model=name)
         return True, "unloaded"
@@ -296,9 +312,7 @@ class WorkerService(EventEmitter):
             self.engines = {
                 m: e for m, e in self.engines.items() if m not in dead
             }
-            self.max_concurrent = max(
-                sum(e.config.max_slots for e in self.engines.values()), 1
-            )
+            self.max_concurrent = _capacity(self.engines)
             try:
                 await self.register()  # advertise the reduced model set
             except Exception as reg_err:

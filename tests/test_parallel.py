@@ -23,9 +23,10 @@ CFG = get_config("tiny-llama")
 
 
 def test_mesh_config_resolve():
-    assert MeshConfig(tp=-1).resolve(8) == (1, 1, 8, 1)
-    assert MeshConfig(dp=2, tp=-1).resolve(8) == (2, 1, 4, 1)
-    assert MeshConfig(dp=2, ep=2, tp=2, sp=1).resolve(8) == (2, 2, 2, 1)
+    assert MeshConfig(tp=-1).resolve(8) == (1, 1, 1, 8, 1)
+    assert MeshConfig(dp=2, tp=-1).resolve(8) == (1, 2, 1, 4, 1)
+    assert MeshConfig(dp=2, ep=2, tp=2, sp=1).resolve(8) == (1, 2, 2, 2, 1)
+    assert MeshConfig(pp=2, tp=-1).resolve(8) == (2, 1, 1, 4, 1)
     with pytest.raises(ValueError):
         MeshConfig(dp=3, tp=-1).resolve(8)
     with pytest.raises(ValueError):
@@ -38,9 +39,9 @@ def test_param_shardings_layout():
     mesh = build_mesh(MeshConfig(dp=4, tp=2))  # tp=2 divides KVH=2 and heads=4
     params = llama.init_params(CFG, jax.random.PRNGKey(0), dtype=jnp.float32)
     sh = param_shardings(params, mesh)
-    assert sh["layers"]["wq"].spec == P(None, None, "tp")
-    assert sh["layers"]["wo"].spec == P(None, "tp", None)
-    assert sh["layers"]["attn_norm"].spec == P(None, None)
+    assert sh["layers"]["wq"].spec == P("pp", None, "tp")
+    assert sh["layers"]["wo"].spec == P("pp", "tp", None)
+    assert sh["layers"]["attn_norm"].spec == P("pp", None)
     assert sh["embed"].spec == P("tp", None)
     # lm_head [E=64, V=256]: both divisible by 2 → vocab sharded
     assert sh["lm_head"].spec == P(None, "tp")
@@ -51,12 +52,12 @@ def test_indivisible_dims_fall_back_to_replicated():
     params = llama.init_params(CFG, jax.random.PRNGKey(0), dtype=jnp.float32)
     sh = param_shardings(params, mesh)
     # wk out dim = KVH*D = 2*16 = 32: divisible by 8 → sharded
-    assert sh["layers"]["wk"].spec == P(None, None, "tp")
+    assert sh["layers"]["wk"].spec == P("pp", None, "tp")
     cache = PagedKVCache.create(CFG.num_layers, 8, 4, CFG.num_kv_heads,
                                 CFG.head_dim_, 2, 4)
     csh = cache_shardings(cache, mesh)
     # KVH=2 not divisible by tp=8 → pool replicated on that dim
-    assert csh.k.spec == P(None, None, None, None, None)
+    assert csh.k.spec == P("pp", None, None, None, None)
 
 
 def test_sharded_forward_matches_single_device():
@@ -119,8 +120,8 @@ def test_ep_sharded_mixtral_matches_single_device():
 
     mesh = build_mesh(MeshConfig(dp=2, ep=2, tp=2))  # X=4 experts / ep=2
     sh = param_shardings(params, mesh)
-    assert sh["layers"]["we_gate"].spec == P(None, "ep", None, "tp")
-    assert sh["layers"]["we_down"].spec == P(None, "ep", "tp", None)
+    assert sh["layers"]["we_gate"].spec == P("pp", "ep", None, "tp")
+    assert sh["layers"]["we_down"].spec == P("pp", "ep", "tp", None)
     sparams = shard_params(params, mesh)
     got = np.asarray(jax.jit(mixtral.forward, static_argnums=1)(sparams, mcfg, tokens))
     np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
