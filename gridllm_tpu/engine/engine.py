@@ -80,6 +80,10 @@ def _model_module(cfg: ModelConfig):
         from gridllm_tpu.models import llava
 
         return llava
+    if cfg.family == "gemma2":
+        from gridllm_tpu.models import gemma
+
+        return gemma
     return llama  # llama, qwen2, qwen3 share the decoder skeleton
 
 
@@ -218,6 +222,9 @@ class InferenceEngine:
             config.tokenizer, self.cfg.vocab_size
         )
         self.mesh = build_mesh(config.mesh) if config.mesh else None
+        # family-specific mesh constraints fail HERE (engine startup), not
+        # at the first request's trace (e.g. gemma2 has no sp variant)
+        getattr(self.mod, "validate_mesh", lambda *_: None)(self.cfg, self.mesh)
         if self.mesh is not None:
             # pallas_call has no GSPMD partitioning rule; under a mesh the
             # jnp attention path shards correctly. Per-engine (on the cfg

@@ -55,6 +55,10 @@ def _name_map(cfg: ModelConfig) -> dict[str, tuple[str, bool]]:
         from gridllm_tpu.models import mixtral
 
         return mixtral.HF_MAP
+    if cfg.family == "gemma2":
+        from gridllm_tpu.models import gemma
+
+        return gemma.hf_map(cfg)
     from gridllm_tpu.models import llama
 
     return llama.hf_map(cfg)

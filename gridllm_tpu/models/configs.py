@@ -67,6 +67,10 @@ class ModelConfig:
     sliding_window: int = 0          # 0 → full attention
     attn_bias: bool = False          # qwen2: bias on q/k/v projections
     qk_norm: bool = False            # qwen3: per-head RMSNorm on q/k pre-rope
+    # gemma2: logits scale by qpas**-0.5 (None → head_dim), lm-head
+    # logits tanh-capped
+    query_pre_attn_scalar: float | None = None
+    final_logit_softcap: float = 0.0
     # embeddings (bert_embed family)
     pooling: str = "mean"            # "mean" | "cls"
     # multimodal: accepts image inputs (the per-model capability gate the
@@ -136,6 +140,18 @@ class ModelConfig:
                 vision_feature_layer=vc.feature_layer,
                 vision_feature_select_strategy="default",
                 projector_hidden_act="gelu",
+            )
+        if self.family == "gemma2":
+            from transformers import Gemma2Config
+
+            return Gemma2Config(
+                head_dim=self.head_dim_,
+                sliding_window=self.sliding_window,
+                attn_logit_softcapping=self.attn_logit_softcap,
+                final_logit_softcapping=self.final_logit_softcap,
+                query_pre_attn_scalar=self.query_pre_attn_scalar
+                or self.head_dim_,
+                **common,
             )
         if self.family == "qwen2":
             from transformers import Qwen2Config
@@ -241,6 +257,29 @@ register(ModelConfig(
     vision=True, vision_cfg=VisionConfig(),
 ))
 
+# gemma2 (public HF configs; Ollama's gemma2 tags)
+register(ModelConfig(
+    name="gemma2:2b", family="gemma2", vocab_size=256_000, hidden_size=2304,
+    intermediate_size=9216, num_layers=26, num_heads=8, num_kv_heads=4,
+    head_dim=256, rope_theta=10_000.0, rms_eps=1e-6, tie_embeddings=True,
+    max_seq_len=8192, sliding_window=4096, attn_logit_softcap=50.0,
+    final_logit_softcap=30.0, query_pre_attn_scalar=256,
+))
+register(ModelConfig(
+    name="gemma2:9b", family="gemma2", vocab_size=256_000, hidden_size=3584,
+    intermediate_size=14_336, num_layers=42, num_heads=16, num_kv_heads=8,
+    head_dim=256, rope_theta=10_000.0, rms_eps=1e-6, tie_embeddings=True,
+    max_seq_len=8192, sliding_window=4096, attn_logit_softcap=50.0,
+    final_logit_softcap=30.0, query_pre_attn_scalar=256,
+))
+register(ModelConfig(
+    name="gemma2:27b", family="gemma2", vocab_size=256_000, hidden_size=4608,
+    intermediate_size=36_864, num_layers=46, num_heads=32, num_kv_heads=16,
+    head_dim=128, rope_theta=10_000.0, rms_eps=1e-6, tie_embeddings=True,
+    max_seq_len=8192, sliding_window=4096, attn_logit_softcap=50.0,
+    final_logit_softcap=30.0, query_pre_attn_scalar=144,
+))
+
 register(ModelConfig(
     name="mixtral:8x7b", family="mixtral", vocab_size=32_000,
     hidden_size=4096, intermediate_size=14_336, num_layers=32,
@@ -289,6 +328,13 @@ register(ModelConfig(
     rms_eps=1e-12, max_seq_len=128,
 ))
 register(ModelConfig(
+    name="tiny-gemma2", family="gemma2", vocab_size=256, hidden_size=64,
+    intermediate_size=128, num_layers=2, num_heads=4, num_kv_heads=2,
+    head_dim=16, rope_theta=10_000.0, rms_eps=1e-6, tie_embeddings=True,
+    max_seq_len=256, sliding_window=8, attn_logit_softcap=50.0,
+    final_logit_softcap=30.0, query_pre_attn_scalar=24,
+))
+register(ModelConfig(
     name="tiny-llava", family="llava", vocab_size=256, hidden_size=64,
     intermediate_size=128, num_layers=2, num_heads=4, num_kv_heads=2,
     head_dim=16, rope_theta=10_000.0, max_seq_len=512,
@@ -313,6 +359,7 @@ _HF_FAMILY = {
     "llama": "llama",
     "qwen2": "qwen2",
     "qwen3": "qwen3",
+    "gemma2": "gemma2",
     "mixtral": "mixtral",
     "bert": "bert_embed",
 }
@@ -403,11 +450,21 @@ def _config_from_hf_dict(name: str, hf: dict, path: str) -> ModelConfig:
         rope_theta=hf.get("rope_theta", 10_000.0),
         rope_scaling=scaling,
         rms_eps=hf.get("rms_norm_eps", 1e-5),
-        tie_embeddings=hf.get("tie_word_embeddings", False),
+        # gemma2 checkpoints tie embeddings without always saying so
+        tie_embeddings=hf.get("tie_word_embeddings", family == "gemma2"),
         max_seq_len=hf.get("max_position_embeddings", 8192),
         num_experts=hf.get("num_local_experts", 0),
         experts_per_token=hf.get("num_experts_per_tok", 2),
-        sliding_window=hf.get("sliding_window") or 0,
+        # qwen2-style configs carry sliding_window with
+        # use_sliding_window=false — honoring it would break the family's
+        # full-attention contract (and trip _check_supported)
+        sliding_window=(
+            (hf.get("sliding_window") or 0)
+            if hf.get("use_sliding_window", True) else 0
+        ),
         attn_bias=family == "qwen2" or bool(hf.get("attention_bias")),
         qk_norm=family == "qwen3",
+        attn_logit_softcap=hf.get("attn_logit_softcapping") or 0.0,
+        final_logit_softcap=hf.get("final_logit_softcapping") or 0.0,
+        query_pre_attn_scalar=hf.get("query_pre_attn_scalar"),
     )

@@ -1,0 +1,408 @@
+"""Gemma-2 family decoder (gemma2:2b/9b/27b).
+
+Same scan-stacked/paged-cache skeleton as models/llama.py, but the gemma2
+block differs in every place that matters for numerics, so the family owns
+its layer body instead of parameterizing llama's:
+
+- RMSNorm multiplies by (1 + w), in fp32 (HF Gemma2RMSNorm);
+- FOUR norms per layer: pre/post attention and pre/post feed-forward,
+  with the post-norms applied to the sublayer OUTPUT before the residual;
+- GeGLU with tanh-approximated gelu (hidden_activation
+  "gelu_pytorch_tanh");
+- embeddings scaled by sqrt(hidden_size) (cast to the activation dtype
+  first, matching HF's normalizer rounding);
+- attention logits tanh-softcapped (attn_logit_softcapping) and scaled by
+  query_pre_attn_scalar**-0.5 instead of head_dim**-0.5 — implemented by
+  pre-scaling q with sqrt(d / qpas) so the shared attention ops keep
+  their 1/sqrt(d) convention;
+- sliding-window attention on EVEN layers (HF: layer_idx % 2 == 0),
+  threaded through the scan as a per-layer window scalar
+  (ops/attention.py jnp paths; kernel variants are future work);
+- final logits tanh-softcapped (final_logit_softcapping).
+
+Weight layout contract: HF Gemma2ForCausalLM (tied embeddings; the four
+per-layer norms under their HF names). The reference served gemma via
+Ollama passthrough (client/src/services/OllamaService.ts); no model code
+to mirror.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from gridllm_tpu.models.configs import ModelConfig
+from gridllm_tpu.models.llama import _precision
+from gridllm_tpu.ops.attention import (
+    attention_prefill,
+    attention_prefix_chunk,
+    paged_attention_decode,
+)
+from gridllm_tpu.ops.kvcache import (
+    PagedKVCache,
+    write_decode_all,
+    write_prefill_all,
+)
+from gridllm_tpu.ops.layers import apply_rope, precompute_rope
+from gridllm_tpu.ops.quant import qdot
+
+Params = dict[str, Any]
+
+
+def _gnorm(x: jnp.ndarray, w: jnp.ndarray, eps: float) -> jnp.ndarray:
+    """Gemma RMSNorm: fp32, multiplies by (1 + w)."""
+    dtype = x.dtype
+    x = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(x), axis=-1, keepdims=True)
+    x = x * jax.lax.rsqrt(var + eps)
+    return (x * (1.0 + w.astype(jnp.float32))).astype(dtype)
+
+
+def validate_mesh(cfg: ModelConfig, mesh) -> None:
+    """Engine-init mesh check (fail at startup, not first request)."""
+    if mesh is not None and mesh.shape.get("sp", 1) > 1:
+        raise ValueError(
+            f"{cfg.name}: ring-attention (sp) prefill has no sliding-window"
+            " variant yet — shape the mesh without sp for gemma2"
+        )
+
+
+def _geglu(lp: Params, x: jnp.ndarray) -> jnp.ndarray:
+    p = _precision(x)
+    gate = qdot(x, lp["w_gate"], precision=p)
+    up = qdot(x, lp["w_up"], precision=p)
+    return qdot(
+        jax.nn.gelu(gate, approximate=True) * up, lp["w_down"], precision=p
+    )
+
+
+def _embed_in(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
+              embeds: jnp.ndarray | None = None) -> jnp.ndarray:
+    x = params["embed"][tokens] if embeds is None else embeds
+    x = x.astype(params["embed"].dtype)
+    # HF casts the sqrt(E) normalizer to the hidden dtype BEFORE the
+    # multiply — mirroring that rounding keeps bf16 goldens bit-tight
+    return x * jnp.asarray(math.sqrt(cfg.hidden_size), x.dtype)
+
+
+def _q_prescale(cfg: ModelConfig, q: jnp.ndarray) -> jnp.ndarray:
+    """Make the ops' 1/sqrt(d) scale equal gemma's 1/sqrt(qpas)."""
+    d = cfg.head_dim_
+    qpas = cfg.query_pre_attn_scalar or d
+    if qpas == d:
+        return q
+    return q * jnp.asarray(math.sqrt(d / qpas), q.dtype)
+
+
+def _qkv(cfg: ModelConfig, lp: Params, x: jnp.ndarray):
+    p = _precision(x)
+    d = cfg.head_dim_
+    q = qdot(x, lp["wq"], precision=p).reshape(*x.shape[:-1], cfg.num_heads, d)
+    k = qdot(x, lp["wk"], precision=p).reshape(*x.shape[:-1], cfg.num_kv_heads, d)
+    v = qdot(x, lp["wv"], precision=p).reshape(*x.shape[:-1], cfg.num_kv_heads, d)
+    return q, k, v
+
+
+def _layer_windows(cfg: ModelConfig) -> jnp.ndarray:
+    """Per-layer sliding window (0 = global): EVEN layers slide."""
+    return jnp.asarray(
+        [cfg.sliding_window if i % 2 == 0 else 0
+         for i in range(cfg.num_layers)],
+        jnp.int32,
+    )
+
+
+def _unembed(cfg: ModelConfig, params: Params, x: jnp.ndarray) -> jnp.ndarray:
+    logits = qdot(
+        x, params["embed"].T, precision=_precision(x),
+        preferred_element_type=jnp.float32,
+    )
+    cap = cfg.final_logit_softcap
+    return cap * jnp.tanh(logits / cap) if cap else logits
+
+
+def init_params(cfg: ModelConfig, key: jax.Array, dtype=jnp.bfloat16) -> Params:
+    e, f, v = cfg.hidden_size, cfg.intermediate_size, cfg.vocab_size
+    h, kvh, d, L = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim_, cfg.num_layers
+    ks = iter(jax.random.split(key, 10))
+
+    def w(k, *shape, scale=None):
+        scale = scale if scale is not None else (shape[-2] ** -0.5)
+        return (jax.random.normal(k, shape, jnp.float32) * scale).astype(dtype)
+
+    return {
+        "embed": w(next(ks), v, e, scale=0.02),
+        "layers": {
+            "attn_norm": jnp.zeros((L, e), dtype),      # (1+w) convention
+            "wq": w(next(ks), L, e, h * d),
+            "wk": w(next(ks), L, e, kvh * d),
+            "wv": w(next(ks), L, e, kvh * d),
+            "wo": w(next(ks), L, h * d, e),
+            "post_attn_norm": jnp.zeros((L, e), dtype),
+            "pre_ffn_norm": jnp.zeros((L, e), dtype),
+            "w_gate": w(next(ks), L, e, f),
+            "w_up": w(next(ks), L, e, f),
+            "w_down": w(next(ks), L, f, e),
+            "post_ffn_norm": jnp.zeros((L, e), dtype),
+        },
+        "final_norm": jnp.zeros((e,), dtype),
+    }
+
+
+def _block(cfg: ModelConfig, lp: Params, x: jnp.ndarray, attn_out: jnp.ndarray,
+           ) -> jnp.ndarray:
+    """Post-attention half of the gemma2 block: post-norm the attention
+    output, add residual, then the normed GeGLU with its own post-norm."""
+    eps = cfg.rms_eps
+    x = x + _gnorm(attn_out, lp["post_attn_norm"], eps)
+    h = _gnorm(x, lp["pre_ffn_norm"], eps)
+    h = _geglu(lp, h)
+    return x + _gnorm(h, lp["post_ffn_norm"], eps)
+
+
+def hidden_states(
+    params: Params,
+    cfg: ModelConfig,
+    tokens: jnp.ndarray,
+    mlp=None,  # family-API uniformity (gemma owns its GeGLU)
+    seq_lens: jnp.ndarray | None = None,
+    attn=None,
+    embeds: jnp.ndarray | None = None,
+) -> jnp.ndarray:
+    del mlp, attn
+    b, t = tokens.shape
+    inv_freq = precompute_rope(cfg.head_dim_, cfg.rope_theta, cfg.rope_scaling)
+    x = _embed_in(params, cfg, tokens, embeds)
+    pos = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32), (b, t))
+    if seq_lens is None:
+        seq_lens = jnp.full((b,), t, jnp.int32)
+    windows = _layer_windows(cfg)
+
+    def layer(x, xs):
+        lp, win = xs
+        hx = _gnorm(x, lp["attn_norm"], cfg.rms_eps)
+        q, k, v = _qkv(cfg, lp, hx)
+        q = _q_prescale(cfg, apply_rope(q, pos, inv_freq))
+        k = apply_rope(k, pos, inv_freq)
+        att = attention_prefill(
+            q, k, v, seq_lens, use_pallas=cfg.use_pallas,
+            logit_softcap=cfg.attn_logit_softcap, window=win,
+        ).reshape(b, t, -1)
+        att = qdot(att, lp["wo"], precision=_precision(x))
+        return _block(cfg, lp, x, att), None
+
+    x, _ = jax.lax.scan(layer, x, (params["layers"], windows))
+    return _gnorm(x, params["final_norm"], cfg.rms_eps)
+
+
+def forward(
+    params: Params, cfg: ModelConfig, tokens: jnp.ndarray, mlp=None,
+    embeds: jnp.ndarray | None = None,
+) -> jnp.ndarray:
+    """Cache-free full forward: tokens [B, T] → logits [B, T, V] fp32
+    (the golden-test oracle vs HF Gemma2ForCausalLM)."""
+    return _unembed(
+        cfg, params, hidden_states(params, cfg, tokens, embeds=embeds)
+    )
+
+
+def prefill(
+    params: Params,
+    cfg: ModelConfig,
+    tokens: jnp.ndarray,
+    length: jnp.ndarray,
+    cache: PagedKVCache,
+    slot: jnp.ndarray,
+    table_row: jnp.ndarray,
+    mlp=None,
+    attn=None,
+    mesh=None,
+    embeds: jnp.ndarray | None = None,
+) -> tuple[jnp.ndarray, PagedKVCache]:
+    """Prefill ONE slot (same contract as llama.prefill)."""
+    del mlp
+    if attn is not None:
+        raise NotImplementedError(
+            f"{cfg.name}: ring-attention (sp) prefill has no sliding-window"
+            " variant yet — shape the mesh without sp for gemma2"
+        )
+    t = tokens.shape[0]
+    inv_freq = precompute_rope(cfg.head_dim_, cfg.rope_theta, cfg.rope_scaling)
+    x = _embed_in(params, cfg, tokens, embeds)[None]  # [1, T, E]
+    pos = jnp.arange(t, dtype=jnp.int32)[None]
+    seq_lens = length[None]
+    windows = _layer_windows(cfg)
+
+    def layer(x, xs):
+        lp, win = xs
+        hx = _gnorm(x, lp["attn_norm"], cfg.rms_eps)
+        q, k, v = _qkv(cfg, lp, hx)
+        q = _q_prescale(cfg, apply_rope(q, pos, inv_freq))
+        k = apply_rope(k, pos, inv_freq)
+        att = attention_prefill(
+            q, k, v, seq_lens, use_pallas=cfg.use_pallas,
+            logit_softcap=cfg.attn_logit_softcap, window=win,
+        ).reshape(1, t, -1)
+        att = qdot(att, lp["wo"], precision=_precision(x))
+        return _block(cfg, lp, x, att), (k[0], v[0])
+
+    x, (k_new, v_new) = jax.lax.scan(layer, x, (params["layers"], windows))
+    x = _gnorm(x, params["final_norm"], cfg.rms_eps)
+    logits = _unembed(cfg, params, x[0, jnp.maximum(length - 1, 0)])
+
+    k_pool, v_pool = write_prefill_all(
+        cache.k, cache.v, k_new, v_new, table_row, jnp.int32(0), length,
+        cache.page_size, use_pallas=cfg.use_pallas,
+    )
+    return logits, PagedKVCache(
+        k=k_pool, v=v_pool,
+        page_table=cache.page_table.at[slot].set(table_row),
+        lengths=cache.lengths.at[slot].set(length),
+        page_size=cache.page_size,
+    )
+
+
+def prefill_chunk(
+    params: Params,
+    cfg: ModelConfig,
+    tokens: jnp.ndarray,
+    start: jnp.ndarray,
+    length: jnp.ndarray,
+    cache: PagedKVCache,
+    slot: jnp.ndarray,
+    table_row: jnp.ndarray,
+    mlp=None,
+    mesh=None,
+    embeds: jnp.ndarray | None = None,
+) -> tuple[jnp.ndarray, PagedKVCache]:
+    """Chunked prefill against the cached prefix (llama.prefill_chunk
+    contract)."""
+    del mlp
+    t = tokens.shape[0]
+    inv_freq = precompute_rope(cfg.head_dim_, cfg.rope_theta, cfg.rope_scaling)
+    x = _embed_in(params, cfg, tokens, embeds)[None]  # [1, C, E]
+    pos = (start + jnp.arange(t, dtype=jnp.int32))[None]
+    total = start + length
+    windows = _layer_windows(cfg)
+
+    def layer(x, xs):
+        lp, win, li = xs
+        hx = _gnorm(x, lp["attn_norm"], cfg.rms_eps)
+        q, k, v = _qkv(cfg, lp, hx)
+        q = _q_prescale(cfg, apply_rope(q, pos, inv_freq))
+        k = apply_rope(k, pos, inv_freq)
+        att = attention_prefix_chunk(
+            q, cache.k, cache.v, table_row, start, total, cache.page_size,
+            k_cur=k[0], v_cur=v[0], layer=li, use_pallas=cfg.use_pallas,
+            logit_softcap=cfg.attn_logit_softcap, window=win,
+        ).reshape(1, t, -1)
+        att = qdot(att, lp["wo"], precision=_precision(x))
+        return _block(cfg, lp, x, att), (k[0], v[0])
+
+    x, (k_new, v_new) = jax.lax.scan(
+        layer, x,
+        (params["layers"], windows,
+         jnp.arange(cfg.num_layers, dtype=jnp.int32)),
+    )
+    x = _gnorm(x, params["final_norm"], cfg.rms_eps)
+    logits = _unembed(cfg, params, x[0, jnp.maximum(length - 1, 0)])
+
+    k_pool, v_pool = write_prefill_all(
+        cache.k, cache.v, k_new, v_new, table_row, start, length,
+        cache.page_size, use_pallas=cfg.use_pallas,
+    )
+    return logits, PagedKVCache(
+        k=k_pool, v=v_pool,
+        page_table=cache.page_table.at[slot].set(table_row),
+        lengths=cache.lengths.at[slot].set(total),
+        page_size=cache.page_size,
+    )
+
+
+def decode_step(
+    params: Params,
+    cfg: ModelConfig,
+    tokens: jnp.ndarray,
+    cache: PagedKVCache,
+    active: jnp.ndarray,
+    mlp=None,
+    mesh=None,
+) -> tuple[jnp.ndarray, PagedKVCache]:
+    """One decode step for ALL slots (llama.decode_step contract)."""
+    del mlp
+    s = tokens.shape[0]
+    inv_freq = precompute_rope(cfg.head_dim_, cfg.rope_theta, cfg.rope_scaling)
+    x = _embed_in(params, cfg, tokens)  # [S, E]
+    positions = cache.lengths
+    new_lengths = jnp.minimum(
+        cache.lengths + active.astype(jnp.int32), cache.max_context
+    )
+    windows = _layer_windows(cfg)
+
+    def layer(x, xs):
+        lp, win, li = xs
+        hx = _gnorm(x, lp["attn_norm"], cfg.rms_eps)
+        q, k, v = _qkv(cfg, lp, hx)
+        q = _q_prescale(
+            cfg, apply_rope(q[:, None], positions[:, None], inv_freq)[:, 0]
+        )
+        k = apply_rope(k[:, None], positions[:, None], inv_freq)[:, 0]
+        att = paged_attention_decode(
+            q, cache.k, cache.v, cache.page_table, positions,
+            cache.page_size, k_cur=k, v_cur=v, layer=li,
+            use_pallas=cfg.use_pallas,
+            logit_softcap=cfg.attn_logit_softcap, window=win,
+        ).reshape(s, -1)
+        att = qdot(att, lp["wo"], precision=_precision(x))
+        return _block(cfg, lp, x, att), (k, v)
+
+    x, (k_new, v_new) = jax.lax.scan(
+        layer, x,
+        (params["layers"], windows,
+         jnp.arange(cfg.num_layers, dtype=jnp.int32)),
+    )
+    x = _gnorm(x, params["final_norm"], cfg.rms_eps)
+    logits = _unembed(cfg, params, x)
+
+    k_pool, v_pool = write_decode_all(
+        cache.k, cache.v, k_new, v_new, cache.page_table, positions, active,
+        cache.page_size, use_pallas=cfg.use_pallas,
+    )
+    return logits, PagedKVCache(
+        k=k_pool, v=v_pool, page_table=cache.page_table,
+        lengths=new_lengths, page_size=cache.page_size,
+    )
+
+
+# ---------------------------------------------------------------------------
+# HF layout (Gemma2ForCausalLM)
+# ---------------------------------------------------------------------------
+
+HF_MAP: dict[str, tuple[str, bool]] = {
+    "attn_norm": ("model.layers.{}.input_layernorm.weight", False),
+    "wq": ("model.layers.{}.self_attn.q_proj.weight", True),
+    "wk": ("model.layers.{}.self_attn.k_proj.weight", True),
+    "wv": ("model.layers.{}.self_attn.v_proj.weight", True),
+    "wo": ("model.layers.{}.self_attn.o_proj.weight", True),
+    "post_attn_norm": ("model.layers.{}.post_attention_layernorm.weight", False),
+    "pre_ffn_norm": ("model.layers.{}.pre_feedforward_layernorm.weight", False),
+    "w_gate": ("model.layers.{}.mlp.gate_proj.weight", True),
+    "w_up": ("model.layers.{}.mlp.up_proj.weight", True),
+    "w_down": ("model.layers.{}.mlp.down_proj.weight", True),
+    "post_ffn_norm": ("model.layers.{}.post_feedforward_layernorm.weight", False),
+}
+
+
+def hf_map(cfg: ModelConfig) -> dict[str, tuple[str, bool]]:
+    return dict(HF_MAP)
+
+
+def convert_hf_state_dict(
+    cfg: ModelConfig, sd: dict[str, Any], dtype=jnp.bfloat16
+) -> Params:
+    from gridllm_tpu.models import llama
+
+    return llama.convert_state_dict(cfg, sd, HF_MAP, dtype)

@@ -45,6 +45,8 @@ def attention_prefill(
     v: jnp.ndarray,
     seq_lens: jnp.ndarray,
     use_pallas: bool | None = None,
+    logit_softcap: float = 0.0,
+    window: jnp.ndarray | int = 0,
 ) -> jnp.ndarray:
     """Causal GQA prefill attention (see attention_prefill_ref for the
     contract). Kernel routing (VERDICT r03 weak #6 / next-round #9):
@@ -57,11 +59,19 @@ def attention_prefill(
       boundary and the output sliced back. Exact: padded dims contribute
       0 to every q·k dot and 0·p to the output; the kernel's internal
       1/sqrt(d_padded) scale is corrected by pre-scaling q.
+
+    `logit_softcap` (gemma2's tanh capping) and `window` (sliding-window
+    attention; 0 = full) route to the jnp reference — kernel variants are
+    future work.
     """
     use, interpret = _pallas_mode(use_pallas)
     t, d = q.shape[1], q.shape[3]
-    if not use or t % min(128, t) != 0:
-        return attention_prefill_ref(q, k, v, seq_lens)
+    has_cap = bool(logit_softcap)
+    has_window = not (isinstance(window, int) and window == 0)
+    if not use or t % min(128, t) != 0 or has_cap or has_window:
+        return attention_prefill_ref(
+            q, k, v, seq_lens, logit_softcap=logit_softcap, window=window
+        )
     from gridllm_tpu.ops import pallas_kernels
 
     dp = -(-d // 128) * 128  # also in interpret mode, so tests cover it
@@ -92,6 +102,8 @@ def paged_attention_decode(
     v_cur: jnp.ndarray | None = None,
     layer: jnp.ndarray | None = None,
     use_pallas: bool | None = None,
+    logit_softcap: float = 0.0,
+    window: jnp.ndarray | int = 0,
 ) -> jnp.ndarray:
     """Paged decode attention (see paged_attention_decode_ref for the
     contract). With k_cur/v_cur ([S, KVH, D]), `lengths` counts the
@@ -104,9 +116,13 @@ def paged_attention_decode(
     kernel when enabled. Mosaic requires 128-lane-aligned page slices, so
     head_dim must be a multiple of 128 on real TPU (d=64 models fall back
     to the jnp gather path; packing two heads per lane tile is future
-    kernel work)."""
+    kernel work). `logit_softcap`/`window` (gemma2) route to the jnp
+    path — kernel variants are future work."""
     use, interpret = _pallas_mode(use_pallas)
-    if use and (interpret or q.shape[-1] % 128 == 0):
+    has_cap = bool(logit_softcap)
+    has_window = not (isinstance(window, int) and window == 0)
+    if (use and (interpret or q.shape[-1] % 128 == 0)
+            and not has_cap and not has_window):
         from gridllm_tpu.ops import pallas_kernels
 
         return pallas_kernels.paged_decode(
@@ -119,7 +135,8 @@ def paged_attention_decode(
         v_pages = jax.lax.dynamic_index_in_dim(v_pages, li, keepdims=False)
     return paged_attention_decode_ref(
         q, k_pages, v_pages, page_table, lengths, page_size,
-        k_cur=k_cur, v_cur=v_cur,
+        k_cur=k_cur, v_cur=v_cur, logit_softcap=logit_softcap,
+        window=window,
     )
 
 
@@ -135,6 +152,8 @@ def attention_prefix_chunk(
     v_cur: jnp.ndarray | None = None,
     layer: jnp.ndarray | None = None,
     use_pallas: bool | None = None,
+    logit_softcap: float = 0.0,
+    window: jnp.ndarray | int = 0,
 ) -> jnp.ndarray:
     """Chunked-prefill attention: one chunk of queries against the slot's
     FULL cached context (prefix + this chunk), read from the page pool.
@@ -190,12 +209,18 @@ def attention_prefix_chunk(
     # causal over absolute positions covers both the prefix (k_pos < start
     # <= q_pos) and intra-chunk causality; total_len guards stale data in
     # owned-but-not-yet-valid page tails for padded q rows
-    mask = (k_pos[None, :] <= q_pos[:, None]) & (k_pos[None, :] < total_len)
+    w = jnp.asarray(window, jnp.int32)
+    dist = q_pos[:, None] - k_pos[None, :]
+    mask = (
+        (dist >= 0) & ((w <= 0) | (dist < w))
+        & (k_pos[None, :] < total_len)
+    )
 
     logits = jnp.einsum(
         "tkgd,nkd->kgtn", qf, ks.astype(jnp.float32),
         precision=jax.lax.Precision.HIGHEST,
     ) * scale
+    logits = _softcap(logits, logit_softcap)
     logits = jnp.where(mask[None, None], logits, _NEG_INF)
     probs = jnp.exp(logits - logits.max(axis=-1, keepdims=True))
     probs = probs / probs.sum(axis=-1, keepdims=True)
@@ -206,11 +231,19 @@ def attention_prefix_chunk(
     return out.reshape(1, t, h, d).astype(q.dtype)
 
 
+def _softcap(logits: jnp.ndarray, cap: float) -> jnp.ndarray:
+    """gemma2's attn_logit_softcapping: cap * tanh(logits / cap), applied
+    BEFORE masking (HF Gemma2Attention order)."""
+    return cap * jnp.tanh(logits / cap) if cap else logits
+
+
 def attention_prefill_ref(
     q: jnp.ndarray,
     k: jnp.ndarray,
     v: jnp.ndarray,
     seq_lens: jnp.ndarray,
+    logit_softcap: float = 0.0,
+    window: jnp.ndarray | int = 0,
 ) -> jnp.ndarray:
     """Causal self-attention over one self-contained chunk (whole prompt).
 
@@ -218,6 +251,10 @@ def attention_prefill_ref(
     (padding keys masked out). Chunked prefill against an existing cached
     prefix is NOT handled here — that variant must read prefix K/V from the
     page pool and will land with the Pallas kernels. Returns [B, T, H, D].
+
+    `logit_softcap`: tanh capping of attention logits (gemma2).
+    `window`: sliding-window attention — a query attends keys at distance
+    < window only (0 = full causal; may be a traced per-layer scalar).
     """
     b, t, h, d = q.shape
     kvh = k.shape[2]
@@ -230,12 +267,15 @@ def attention_prefill_ref(
 
     # [B, KVH, G, Tq, Tk]
     logits = jnp.einsum("btkgd,bskd->bkgts", qf, kf, precision=jax.lax.Precision.HIGHEST) * scale
+    logits = _softcap(logits, logit_softcap)
 
     q_pos = jnp.arange(t)[:, None]  # [Tq, 1]
     k_pos = jnp.arange(t)[None, :]  # [1, Tk]
     causal = q_pos >= k_pos
+    w = jnp.asarray(window, jnp.int32)
+    in_window = (w <= 0) | (q_pos - k_pos < w)
     valid = k_pos < seq_lens[:, None, None, None, None]
-    mask = causal[None, None, None] & valid
+    mask = (causal & in_window)[None, None, None] & valid
     logits = jnp.where(mask, logits, _NEG_INF)
 
     probs = jnp.exp(logits - logits.max(axis=-1, keepdims=True))
@@ -253,6 +293,8 @@ def paged_attention_decode_ref(
     page_size: int,
     k_cur: jnp.ndarray | None = None,
     v_cur: jnp.ndarray | None = None,
+    logit_softcap: float = 0.0,
+    window: jnp.ndarray | int = 0,
 ) -> jnp.ndarray:
     """One-token-per-slot decode attention against the paged cache.
 
@@ -264,6 +306,10 @@ def paged_attention_decode_ref(
     prefix only and the current token is overlaid at position lengths[s]
     before attending (pool writes deferred — see paged_attention_decode).
     Returns [S, H, D].
+
+    `logit_softcap`/`window` as in attention_prefill_ref (the current
+    token sits at position total-1; keys at distance >= window from it
+    are masked).
 
     Reference implementation: materializes each slot's max context via
     gather. The Pallas kernel (ops/pallas_kernels.py) streams only valid
@@ -277,6 +323,7 @@ def paged_attention_decode_ref(
     if not merge_cur:
         k_cur = jnp.zeros((s, kvh, d), k_pages.dtype)
         v_cur = jnp.zeros((s, kvh, d), v_pages.dtype)
+    w = jnp.asarray(window, jnp.int32)
 
     def one_slot(qi, row, ln, kc, vc):
         ks, vs = gather_kv(k_pages, v_pages, row, page_size)  # [N, KVH, D]
@@ -290,7 +337,10 @@ def paged_attention_decode_ref(
             total = ln + 1
         qf = qi.astype(jnp.float32).reshape(kvh, g, d)
         logits = jnp.einsum("kgd,nkd->kgn", qf, ks.astype(jnp.float32), precision=jax.lax.Precision.HIGHEST) * scale
-        valid = jnp.arange(ks.shape[0]) < total
+        logits = _softcap(logits, logit_softcap)
+        k_pos = jnp.arange(ks.shape[0])
+        valid = k_pos < total
+        valid &= (w <= 0) | ((total - 1) - k_pos < w)
         logits = jnp.where(valid[None, None, :], logits, _NEG_INF)
         probs = jnp.exp(logits - logits.max(axis=-1, keepdims=True))
         probs = probs / probs.sum(axis=-1, keepdims=True)

@@ -56,6 +56,10 @@ _LAYER_SPECS: dict[str, tuple[str | None, ...]] = {
     "w_down": ("pp", "tp", None),
     "attn_norm": ("pp", None),
     "mlp_norm": ("pp", None),
+    # gemma2's four-norm block
+    "post_attn_norm": ("pp", None),
+    "pre_ffn_norm": ("pp", None),
+    "post_ffn_norm": ("pp", None),
     # qwen2 qkv bias: [L, out] shards with its projection's out dim
     "bq": ("pp", "tp"),
     "bk": ("pp", "tp"),

@@ -1,0 +1,148 @@
+"""Gemma-2 family: goldens vs HF Gemma2ForCausalLM + engine paths.
+
+Covers the conventions that differ from the llama skeleton (SURVEY.md §4
+golden-test strategy): (1+w) RMSNorm, four norms per block, GeGLU,
+sqrt(E)-scaled embeddings, query_pre_attn_scalar logits scale, attn/final
+logit softcapping, and EVEN-layer sliding-window attention.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gridllm_tpu.models import gemma
+from gridllm_tpu.models.configs import get_config
+from gridllm_tpu.ops.kvcache import PagedKVCache, PageAllocator
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+CFG = get_config("tiny-gemma2")
+
+
+@pytest.fixture(scope="module")
+def twin():
+    hf_cfg = CFG.hf_config()
+    torch.manual_seed(0)
+    with torch.no_grad():
+        model = transformers.Gemma2ForCausalLM(hf_cfg).eval()
+    params = gemma.convert_hf_state_dict(CFG, model.state_dict(), jnp.float32)
+    return params, model
+
+
+def test_hf_config_roundtrip():
+    hf = CFG.hf_config()
+    assert hf.model_type == "gemma2"
+    assert hf.sliding_window == CFG.sliding_window
+    assert hf.attn_logit_softcapping == CFG.attn_logit_softcap
+    assert hf.final_logit_softcapping == CFG.final_logit_softcap
+    assert hf.query_pre_attn_scalar == CFG.query_pre_attn_scalar
+    assert hf.tie_word_embeddings
+
+
+def test_forward_matches_hf(twin):
+    """Long enough (24 > window=8) that the sliding-window mask on even
+    layers actually truncates context — a full-attention bug would show."""
+    params, model = twin
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(0, CFG.vocab_size, size=(2, 24))
+    ours = np.asarray(gemma.forward(params, CFG, jnp.asarray(tokens)))
+    with torch.no_grad():
+        theirs = model(
+            input_ids=torch.from_numpy(tokens.astype(np.int64))
+        ).logits.float().numpy()
+    np.testing.assert_allclose(ours, theirs, rtol=2e-4, atol=2e-4)
+
+
+def test_prefill_decode_match_forward(twin):
+    """Paged prefill + N decode steps == cache-free forward on the same
+    growing sequence (greedy argmax chain)."""
+    params, _ = twin
+    prompt = list(range(3, 15))  # 12 tokens > window 8
+    cache = PagedKVCache.create(
+        CFG.num_layers, num_pages=16, page_size=8,
+        num_kv_heads=CFG.num_kv_heads, head_dim=CFG.head_dim_,
+        max_slots=2, max_pages_per_slot=8, dtype=jnp.float32,
+    )
+    alloc = PageAllocator(16, 8, 8)
+    alloc.alloc(0, 32)
+    row = jnp.asarray(alloc.table_row(0), jnp.int32)
+
+    padded = jnp.asarray(prompt + [0] * (16 - len(prompt)), jnp.int32)
+    logits, cache = gemma.prefill(
+        params, CFG, padded, jnp.int32(len(prompt)), cache, jnp.int32(0), row)
+
+    seq = list(prompt)
+    for _ in range(4):
+        ref = np.asarray(gemma.forward(
+            params, CFG, jnp.asarray([seq], jnp.int32)))[0, -1]
+        np.testing.assert_allclose(
+            np.asarray(logits), ref, rtol=2e-4, atol=2e-4)
+        nxt = int(np.argmax(ref))
+        seq.append(nxt)
+        tok = jnp.zeros((2,), jnp.int32).at[0].set(nxt)
+        active = jnp.zeros((2,), bool).at[0].set(True)
+        dec, cache = gemma.decode_step(params, CFG, tok, cache, active)
+        logits = dec[0]
+
+
+def test_chunked_prefill_matches_whole(twin):
+    params, _ = twin
+    ids = list(range(2, 26))  # 24 tokens, 3 chunks of 8
+
+    def fresh():
+        return PagedKVCache.create(
+            CFG.num_layers, num_pages=16, page_size=8,
+            num_kv_heads=CFG.num_kv_heads, head_dim=CFG.head_dim_,
+            max_slots=2, max_pages_per_slot=8, dtype=jnp.float32,
+        )
+
+    alloc = PageAllocator(16, 8, 8)
+    alloc.alloc(0, 32)
+    row = jnp.asarray(alloc.table_row(0), jnp.int32)
+
+    whole, _ = gemma.prefill(
+        params, CFG, jnp.asarray(ids, jnp.int32), jnp.int32(len(ids)),
+        fresh(), jnp.int32(0), row)
+
+    cache = fresh()
+    for s0 in (0, 8, 16):
+        chunked, cache = gemma.prefill_chunk(
+            params, CFG, jnp.asarray(ids[s0:s0 + 8], jnp.int32),
+            jnp.int32(s0), jnp.int32(8), cache, jnp.int32(0), row)
+    np.testing.assert_allclose(
+        np.asarray(chunked), np.asarray(whole), rtol=2e-4, atol=2e-4)
+
+
+def test_engine_serves_gemma2():
+    from gridllm_tpu.engine import EngineConfig, InferenceEngine
+    from gridllm_tpu.engine.engine import GenerationRequest
+
+    eng = InferenceEngine(EngineConfig(
+        model="tiny-gemma2", max_slots=2, page_size=8, num_pages=32,
+        max_pages_per_slot=8, prefill_buckets=(16, 32),
+    ))
+    res = eng.generate(GenerationRequest(
+        id="g1", prompt="hello gemma",
+        options={"temperature": 0, "num_predict": 5, "seed": 3},
+    ))
+    assert res.done_reason in ("stop", "length")
+    assert res.eval_count >= 1
+    res2 = eng.generate(GenerationRequest(
+        id="g2", prompt="hello gemma",
+        options={"temperature": 0, "num_predict": 5, "seed": 3},
+    ))
+    assert res2.token_ids == res.token_ids
+
+
+def test_sp_mesh_rejected_at_engine_init():
+    from gridllm_tpu.engine import EngineConfig, InferenceEngine
+    from gridllm_tpu.parallel.mesh import MeshConfig
+
+    with pytest.raises(ValueError, match="sp"):
+        InferenceEngine(EngineConfig(
+            model="tiny-gemma2", max_slots=2, page_size=8, num_pages=32,
+            max_pages_per_slot=8, prefill_buckets=(16, 32),
+            mesh=MeshConfig(sp=2, tp=4),
+        ))
