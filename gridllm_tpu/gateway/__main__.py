@@ -1,0 +1,6 @@
+"""``python -m gridllm_tpu.gateway`` — same as the ``gridllm-server``
+console script, for PYTHONPATH-only (uninstalled) deployments."""
+
+from gridllm_tpu.gateway.app import main
+
+main()

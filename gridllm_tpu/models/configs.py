@@ -162,6 +162,15 @@ class ModelConfig:
             from transformers import Qwen3Config
 
             return Qwen3Config(head_dim=self.head_dim_, **common)
+        if self.sliding_window:  # windowed llama skeleton = mistral v0.1
+            from transformers import MistralConfig
+
+            common.pop("attention_bias")
+            return MistralConfig(
+                sliding_window=self.sliding_window,
+                head_dim=self.head_dim_,
+                **common,
+            )
         from transformers import LlamaConfig
 
         if self.rope_scaling is not None:
@@ -257,6 +266,19 @@ register(ModelConfig(
     vision=True, vision_cfg=VisionConfig(),
 ))
 
+# mistral (llama skeleton; v0.3 dropped the sliding window, v0.1-class
+# checkpoints with one are supported via ModelConfig.sliding_window)
+register(ModelConfig(
+    name="mistral:7b", vocab_size=32_768, hidden_size=4096,
+    intermediate_size=14_336, num_layers=32, num_heads=32, num_kv_heads=8,
+    rope_theta=1_000_000.0, max_seq_len=32_768, rms_eps=1e-5,
+))
+register(ModelConfig(
+    name="mistral-nemo:12b", vocab_size=131_072, hidden_size=5120,
+    intermediate_size=14_336, num_layers=40, num_heads=32, num_kv_heads=8,
+    head_dim=128, rope_theta=1_000_000.0, max_seq_len=131_072, rms_eps=1e-5,
+))
+
 # gemma2 (public HF configs; Ollama's gemma2 tags)
 register(ModelConfig(
     name="gemma2:2b", family="gemma2", vocab_size=256_000, hidden_size=2304,
@@ -328,6 +350,11 @@ register(ModelConfig(
     rms_eps=1e-12, max_seq_len=128,
 ))
 register(ModelConfig(
+    name="tiny-mistral", vocab_size=256, hidden_size=64,
+    intermediate_size=128, num_layers=2, num_heads=4, num_kv_heads=2,
+    head_dim=16, rope_theta=10_000.0, max_seq_len=256, sliding_window=8,
+))
+register(ModelConfig(
     name="tiny-gemma2", family="gemma2", vocab_size=256, hidden_size=64,
     intermediate_size=128, num_layers=2, num_heads=4, num_kv_heads=2,
     head_dim=16, rope_theta=10_000.0, rms_eps=1e-6, tie_embeddings=True,
@@ -357,6 +384,7 @@ def get_config(name: str) -> ModelConfig:
 
 _HF_FAMILY = {
     "llama": "llama",
+    "mistral": "llama",  # llama skeleton (+ optional sliding window)
     "qwen2": "qwen2",
     "qwen3": "qwen3",
     "gemma2": "gemma2",

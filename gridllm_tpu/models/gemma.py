@@ -35,7 +35,10 @@ import jax
 import jax.numpy as jnp
 
 from gridllm_tpu.models.configs import ModelConfig
-from gridllm_tpu.models.llama import _precision
+# _precision: the families' shared dtype→matmul-precision policy;
+# validate_mesh: gemma2 always has sliding windows, so llama's window×sp
+# engine-init guard is exactly the needed rule — one copy, no drift
+from gridllm_tpu.models.llama import _precision, validate_mesh  # noqa: F401
 from gridllm_tpu.ops.attention import (
     attention_prefill,
     attention_prefix_chunk,
@@ -61,13 +64,6 @@ def _gnorm(x: jnp.ndarray, w: jnp.ndarray, eps: float) -> jnp.ndarray:
     return (x * (1.0 + w.astype(jnp.float32))).astype(dtype)
 
 
-def validate_mesh(cfg: ModelConfig, mesh) -> None:
-    """Engine-init mesh check (fail at startup, not first request)."""
-    if mesh is not None and mesh.shape.get("sp", 1) > 1:
-        raise ValueError(
-            f"{cfg.name}: ring-attention (sp) prefill has no sliding-window"
-            " variant yet — shape the mesh without sp for gemma2"
-        )
 
 
 def _geglu(lp: Params, x: jnp.ndarray) -> jnp.ndarray:
@@ -163,6 +159,37 @@ def _block(cfg: ModelConfig, lp: Params, x: jnp.ndarray, attn_out: jnp.ndarray,
     return x + _gnorm(h, lp["post_ffn_norm"], eps)
 
 
+def _scan_layers(params: Params, cfg: ModelConfig, x: jnp.ndarray,
+                 pos: jnp.ndarray, attn_fn):
+    """The ONE gemma2 layer scan all four entry points share.
+
+    x: [B, T, E]; pos: [B, T] absolute positions;
+    attn_fn(q, k, v, win, li) -> attended [B, T, H*D] (q/k post-rope,
+    q pre-scaled; win = this layer's sliding window, li = layer index).
+    Returns (x, k_ys [L, B, T, KVH, D], v_ys) — pool writes are the
+    caller's.
+    """
+    inv_freq = precompute_rope(cfg.head_dim_, cfg.rope_theta, cfg.rope_scaling)
+    windows = _layer_windows(cfg)
+
+    def layer(x, xs):
+        lp, win, li = xs
+        hx = _gnorm(x, lp["attn_norm"], cfg.rms_eps)
+        q, k, v = _qkv(cfg, lp, hx)
+        q = _q_prescale(cfg, apply_rope(q, pos, inv_freq))
+        k = apply_rope(k, pos, inv_freq)
+        att = qdot(attn_fn(q, k, v, win, li), lp["wo"],
+                   precision=_precision(x))
+        return _block(cfg, lp, x, att), (k, v)
+
+    x, (k_ys, v_ys) = jax.lax.scan(
+        layer, x,
+        (params["layers"], windows,
+         jnp.arange(cfg.num_layers, dtype=jnp.int32)),
+    )
+    return x, k_ys, v_ys
+
+
 def hidden_states(
     params: Params,
     cfg: ModelConfig,
@@ -174,27 +201,18 @@ def hidden_states(
 ) -> jnp.ndarray:
     del mlp, attn
     b, t = tokens.shape
-    inv_freq = precompute_rope(cfg.head_dim_, cfg.rope_theta, cfg.rope_scaling)
     x = _embed_in(params, cfg, tokens, embeds)
     pos = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32), (b, t))
     if seq_lens is None:
         seq_lens = jnp.full((b,), t, jnp.int32)
-    windows = _layer_windows(cfg)
 
-    def layer(x, xs):
-        lp, win = xs
-        hx = _gnorm(x, lp["attn_norm"], cfg.rms_eps)
-        q, k, v = _qkv(cfg, lp, hx)
-        q = _q_prescale(cfg, apply_rope(q, pos, inv_freq))
-        k = apply_rope(k, pos, inv_freq)
-        att = attention_prefill(
+    def attn_fn(q, k, v, win, li):
+        return attention_prefill(
             q, k, v, seq_lens, use_pallas=cfg.use_pallas,
             logit_softcap=cfg.attn_logit_softcap, window=win,
         ).reshape(b, t, -1)
-        att = qdot(att, lp["wo"], precision=_precision(x))
-        return _block(cfg, lp, x, att), None
 
-    x, _ = jax.lax.scan(layer, x, (params["layers"], windows))
+    x, _, _ = _scan_layers(params, cfg, x, pos, attn_fn)
     return _gnorm(x, params["final_norm"], cfg.rms_eps)
 
 
@@ -226,30 +244,22 @@ def prefill(
     del mlp
     if attn is not None:
         raise NotImplementedError(
-            f"{cfg.name}: ring-attention (sp) prefill has no sliding-window"
-            " variant yet — shape the mesh without sp for gemma2"
+            f"{cfg.name}: custom prefill attention (sp ring) is not "
+            "supported — validate_mesh rejects such meshes at engine init"
         )
     t = tokens.shape[0]
-    inv_freq = precompute_rope(cfg.head_dim_, cfg.rope_theta, cfg.rope_scaling)
     x = _embed_in(params, cfg, tokens, embeds)[None]  # [1, T, E]
     pos = jnp.arange(t, dtype=jnp.int32)[None]
     seq_lens = length[None]
-    windows = _layer_windows(cfg)
 
-    def layer(x, xs):
-        lp, win = xs
-        hx = _gnorm(x, lp["attn_norm"], cfg.rms_eps)
-        q, k, v = _qkv(cfg, lp, hx)
-        q = _q_prescale(cfg, apply_rope(q, pos, inv_freq))
-        k = apply_rope(k, pos, inv_freq)
-        att = attention_prefill(
+    def attn_fn(q, k, v, win, li):
+        return attention_prefill(
             q, k, v, seq_lens, use_pallas=cfg.use_pallas,
             logit_softcap=cfg.attn_logit_softcap, window=win,
         ).reshape(1, t, -1)
-        att = qdot(att, lp["wo"], precision=_precision(x))
-        return _block(cfg, lp, x, att), (k[0], v[0])
 
-    x, (k_new, v_new) = jax.lax.scan(layer, x, (params["layers"], windows))
+    x, k_ys, v_ys = _scan_layers(params, cfg, x, pos, attn_fn)
+    k_new, v_new = k_ys[:, 0], v_ys[:, 0]  # [L, T, KVH, D]
     x = _gnorm(x, params["final_norm"], cfg.rms_eps)
     logits = _unembed(cfg, params, x[0, jnp.maximum(length - 1, 0)])
 
@@ -282,31 +292,19 @@ def prefill_chunk(
     contract)."""
     del mlp
     t = tokens.shape[0]
-    inv_freq = precompute_rope(cfg.head_dim_, cfg.rope_theta, cfg.rope_scaling)
     x = _embed_in(params, cfg, tokens, embeds)[None]  # [1, C, E]
     pos = (start + jnp.arange(t, dtype=jnp.int32))[None]
     total = start + length
-    windows = _layer_windows(cfg)
 
-    def layer(x, xs):
-        lp, win, li = xs
-        hx = _gnorm(x, lp["attn_norm"], cfg.rms_eps)
-        q, k, v = _qkv(cfg, lp, hx)
-        q = _q_prescale(cfg, apply_rope(q, pos, inv_freq))
-        k = apply_rope(k, pos, inv_freq)
-        att = attention_prefix_chunk(
+    def attn_fn(q, k, v, win, li):
+        return attention_prefix_chunk(
             q, cache.k, cache.v, table_row, start, total, cache.page_size,
             k_cur=k[0], v_cur=v[0], layer=li, use_pallas=cfg.use_pallas,
             logit_softcap=cfg.attn_logit_softcap, window=win,
         ).reshape(1, t, -1)
-        att = qdot(att, lp["wo"], precision=_precision(x))
-        return _block(cfg, lp, x, att), (k[0], v[0])
 
-    x, (k_new, v_new) = jax.lax.scan(
-        layer, x,
-        (params["layers"], windows,
-         jnp.arange(cfg.num_layers, dtype=jnp.int32)),
-    )
+    x, k_ys, v_ys = _scan_layers(params, cfg, x, pos, attn_fn)
+    k_new, v_new = k_ys[:, 0], v_ys[:, 0]  # [L, C, KVH, D]
     x = _gnorm(x, params["final_norm"], cfg.rms_eps)
     logits = _unembed(cfg, params, x[0, jnp.maximum(length - 1, 0)])
 
@@ -334,37 +332,27 @@ def decode_step(
     """One decode step for ALL slots (llama.decode_step contract)."""
     del mlp
     s = tokens.shape[0]
-    inv_freq = precompute_rope(cfg.head_dim_, cfg.rope_theta, cfg.rope_scaling)
-    x = _embed_in(params, cfg, tokens)  # [S, E]
+    # the decode token is a length-1 "sequence" per slot: [S, 1, E] with
+    # per-slot positions, so the shared scan body applies unchanged
+    x = _embed_in(params, cfg, tokens)[:, None]  # [S, 1, E]
     positions = cache.lengths
     new_lengths = jnp.minimum(
         cache.lengths + active.astype(jnp.int32), cache.max_context
     )
-    windows = _layer_windows(cfg)
 
-    def layer(x, xs):
-        lp, win, li = xs
-        hx = _gnorm(x, lp["attn_norm"], cfg.rms_eps)
-        q, k, v = _qkv(cfg, lp, hx)
-        q = _q_prescale(
-            cfg, apply_rope(q[:, None], positions[:, None], inv_freq)[:, 0]
-        )
-        k = apply_rope(k[:, None], positions[:, None], inv_freq)[:, 0]
-        att = paged_attention_decode(
-            q, cache.k, cache.v, cache.page_table, positions,
-            cache.page_size, k_cur=k, v_cur=v, layer=li,
+    def attn_fn(q, k, v, win, li):
+        return paged_attention_decode(
+            q[:, 0], cache.k, cache.v, cache.page_table, positions,
+            cache.page_size, k_cur=k[:, 0], v_cur=v[:, 0], layer=li,
             use_pallas=cfg.use_pallas,
             logit_softcap=cfg.attn_logit_softcap, window=win,
-        ).reshape(s, -1)
-        att = qdot(att, lp["wo"], precision=_precision(x))
-        return _block(cfg, lp, x, att), (k, v)
+        ).reshape(s, 1, -1)
 
-    x, (k_new, v_new) = jax.lax.scan(
-        layer, x,
-        (params["layers"], windows,
-         jnp.arange(cfg.num_layers, dtype=jnp.int32)),
+    x, k_ys, v_ys = _scan_layers(
+        params, cfg, x, positions[:, None], attn_fn
     )
-    x = _gnorm(x, params["final_norm"], cfg.rms_eps)
+    k_new, v_new = k_ys[:, :, 0], v_ys[:, :, 0]  # [L, S, KVH, D]
+    x = _gnorm(x[:, 0], params["final_norm"], cfg.rms_eps)
     logits = _unembed(cfg, params, x)
 
     k_pool, v_pool = write_decode_all(

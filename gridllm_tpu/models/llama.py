@@ -55,7 +55,10 @@ AttnFn = Callable[
 
 def _default_attn(cfg: ModelConfig) -> AttnFn:
     def attn(q, k, v, seq_lens):
-        return attention_prefill(q, k, v, seq_lens, use_pallas=cfg.use_pallas)
+        return attention_prefill(
+            q, k, v, seq_lens, use_pallas=cfg.use_pallas,
+            window=cfg.sliding_window,
+        )
 
     return attn
 
@@ -66,12 +69,22 @@ def _precision(x: jnp.ndarray):
 
 
 def _check_supported(cfg: ModelConfig) -> None:
-    # Loud failure beats silently-wrong attention for knobs the ops layer
-    # doesn't implement yet (ModelConfig carries them for future families).
+    # Loud failure beats silently-wrong attention for knobs this skeleton
+    # doesn't route (gemma2 owns softcapping in models/gemma.py; uniform
+    # sliding windows — mistral-v0.1-class — thread through the attention
+    # calls here).
     if cfg.attn_logit_softcap:
         raise NotImplementedError(f"{cfg.name}: attn_logit_softcap")
-    if cfg.sliding_window:
-        raise NotImplementedError(f"{cfg.name}: sliding_window")
+
+
+def validate_mesh(cfg: ModelConfig, mesh) -> None:
+    """Engine-init mesh check: ring-attention (sp) prefill has no
+    sliding-window variant."""
+    if cfg.sliding_window and mesh is not None and mesh.shape.get("sp", 1) > 1:
+        raise ValueError(
+            f"{cfg.name}: sliding-window attention cannot combine with sp "
+            "(ring-attention prefill) yet — shape the mesh without sp"
+        )
 
 
 def init_params(
@@ -394,6 +407,7 @@ def prefill_chunk_layers(
         att = attention_prefix_chunk(
             q, k_pool, v_pool, table_row, start, total, page_size,
             k_cur=k[0], v_cur=v[0], layer=li, use_pallas=cfg.use_pallas,
+            window=cfg.sliding_window,
         ).reshape(1, t, -1)
         x = x + qdot(att, lp["wo"], precision=_precision(x))
         hx = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
@@ -443,7 +457,7 @@ def decode_layers(
         attn = paged_attention_decode(
             q, k_pool, v_pool, page_table, positions,
             page_size, k_cur=k, v_cur=v, layer=li,
-            use_pallas=cfg.use_pallas,
+            use_pallas=cfg.use_pallas, window=cfg.sliding_window,
         ).reshape(s, -1)
         x = x + qdot(attn, lp["wo"], precision=_precision(x))
         hx = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)

@@ -1,0 +1,6 @@
+"""``python -m gridllm_tpu.worker`` — same as the ``gridllm-worker``
+console script, for PYTHONPATH-only (uninstalled) deployments."""
+
+from gridllm_tpu.worker.main import main
+
+main()

@@ -323,6 +323,18 @@ async def run(config: Config | None = None) -> None:
 
 
 def main() -> None:  # pragma: no cover
+    # Make the JAX_PLATFORMS env var authoritative: environment plugins
+    # (e.g. a TPU-relay sitecustomize) may force jax.config's platform
+    # list at interpreter start, which would make an explicit
+    # JAX_PLATFORMS=cpu worker still try (and possibly hang on) the
+    # accelerator backend. Backend init is lazy, so pinning here — before
+    # the first jax.devices() in engine build — restores the documented
+    # env-var semantics.
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat:
+        import jax
+
+        jax.config.update("jax_platforms", plat)
     asyncio.run(run())
 
 

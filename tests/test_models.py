@@ -172,3 +172,61 @@ def test_two_slot_isolation(params_fp32):
         outs[mode] = gen
     assert outs["together"][0] == outs["alone0"][0]
     assert outs["together"][3] == outs["alone3"][3]
+
+
+def test_mistral_sliding_window_matches_hf():
+    """Uniform sliding-window llama skeleton (mistral v0.1 class) vs HF
+    MistralForCausalLM, sequence longer than the window so the mask
+    actually truncates; plus prefill+decode chain parity."""
+    import numpy as np
+    import pytest
+    import torch
+    import transformers
+
+    from gridllm_tpu.models import llama
+    from gridllm_tpu.models.configs import get_config
+    from gridllm_tpu.ops.kvcache import PagedKVCache, PageAllocator
+
+    cfg = get_config("tiny-mistral")
+    assert cfg.sliding_window == 8
+    hf_cfg = cfg.hf_config()
+    assert hf_cfg.model_type == "mistral"
+    assert hf_cfg.sliding_window == 8
+    torch.manual_seed(0)
+    with torch.no_grad():
+        model = transformers.MistralForCausalLM(hf_cfg).eval()
+    params = llama.convert_hf_state_dict(
+        cfg, model.state_dict(), jnp.float32)
+
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(0, cfg.vocab_size, size=(2, 24))
+    ours = np.asarray(llama.forward(params, cfg, jnp.asarray(tokens)))
+    with torch.no_grad():
+        theirs = model(
+            input_ids=torch.from_numpy(tokens.astype(np.int64))
+        ).logits.float().numpy()
+    np.testing.assert_allclose(ours, theirs, rtol=2e-4, atol=2e-4)
+
+    # paged prefill + decode chain must agree with forward past the window
+    prompt = [int(t) for t in tokens[0][:12]]
+    cache = PagedKVCache.create(
+        cfg.num_layers, num_pages=16, page_size=8,
+        num_kv_heads=cfg.num_kv_heads, head_dim=cfg.head_dim_,
+        max_slots=2, max_pages_per_slot=8, dtype=jnp.float32)
+    alloc = PageAllocator(16, 8, 8)
+    alloc.alloc(0, 32)
+    row = jnp.asarray(alloc.table_row(0), jnp.int32)
+    logits, cache = llama.prefill(
+        params, cfg, jnp.asarray(prompt, jnp.int32), jnp.int32(len(prompt)),
+        cache, jnp.int32(0), row)
+    seq = list(prompt)
+    for _ in range(3):
+        ref = np.asarray(llama.forward(
+            params, cfg, jnp.asarray([seq], jnp.int32)))[0, -1]
+        np.testing.assert_allclose(np.asarray(logits), ref, rtol=2e-4, atol=2e-4)
+        nxt = int(np.argmax(ref))
+        seq.append(nxt)
+        tok = jnp.zeros((2,), jnp.int32).at[0].set(nxt)
+        active = jnp.zeros((2,), bool).at[0].set(True)
+        dec, cache = llama.decode_step(params, cfg, tok, cache, active)
+        logits = dec[0]
