@@ -181,7 +181,9 @@ class ModelConfig:
                 "high_freq_factor": self.rope_scaling.high_freq_factor,
                 "original_max_position_embeddings": self.rope_scaling.original_max_position_embeddings,
             }
-        return LlamaConfig(**common)
+        # explicit head_dim: models like mistral-nemo:12b have
+        # head_dim != hidden_size // num_heads
+        return LlamaConfig(head_dim=self.head_dim_, **common)
 
 
 _LLAMA3_SCALING = RopeScaling(
@@ -375,10 +377,15 @@ register(ModelConfig(
 def get_config(name: str) -> ModelConfig:
     if name in REGISTRY:
         return REGISTRY[name]
-    # Ollama-style tag normalization: "llama3.2:3b-instruct-fp16" → "llama3.2:3b"
-    base = name.split("-")[0]
-    if base in REGISTRY:
-        return REGISTRY[base]
+    # Ollama-style tag normalization: suffixes live in the TAG, after the
+    # colon — "llama3.2:3b-instruct-fp16" → "llama3.2:3b". Splitting the
+    # whole name at '-' would break hyphenated model names
+    # ("mistral-nemo:12b-instruct" must not become "mistral").
+    if ":" in name:
+        model, tag = name.split(":", 1)
+        base = f"{model}:{tag.split('-')[0]}"
+        if base in REGISTRY:
+            return REGISTRY[base]
     raise KeyError(f"unknown model: {name!r} (known: {sorted(REGISTRY)})")
 
 
