@@ -8,7 +8,16 @@ PUNSUBSCRIBE, AUTH/SELECT (accepted, no-op). Real Redis remains fully
 compatible (RespBus speaks standard RESP2); this broker exists so a
 multi-process cluster can run with zero external dependencies.
 
-Run: ``python -m gridllm_tpu.bus.broker --port 6379``
+``--aof PATH`` enables append-only persistence (the reference ran Redis
+with ``--appendonly yes``, SURVEY.md §5.4 — the scheduler's crash-reload
+of `workers`/`active_jobs`/`job_queue:*` state only survives a BROKER
+restart if the broker persists). Mutating KV/hash commands append one
+JSON line, flushed per write and fsync'd at most once per second
+(Redis's `everysec` durability); on start the log is replayed (expiries
+stored as absolute wall deadlines, already-expired keys dropped) and
+compacted to a snapshot. Pub/sub is not persisted — same as Redis.
+
+Run: ``python -m gridllm_tpu.bus.broker --port 6379 [--aof bus.aof]``
 """
 
 from __future__ import annotations
@@ -16,6 +25,8 @@ from __future__ import annotations
 import argparse
 import asyncio
 import fnmatch
+import json
+import os
 import time
 
 from gridllm_tpu.utils.logging import get_logger
@@ -43,7 +54,7 @@ PONG = b"+PONG\r\n"
 
 
 class GridBusBroker:
-    def __init__(self) -> None:
+    def __init__(self, aof_path: str | None = None) -> None:
         self._kv: dict[str, str] = {}
         self._expiry: dict[str, float] = {}
         self._hashes: dict[str, dict[str, str]] = {}
@@ -52,6 +63,9 @@ class GridBusBroker:
         self._psubs: dict[str, set[asyncio.StreamWriter]] = {}
         self._clients: set[asyncio.StreamWriter] = {*()}
         self._server: asyncio.AbstractServer | None = None
+        self._aof_path = aof_path
+        self._aof = None  # open append handle when persistence is on
+        self._last_fsync = 0.0
 
     # -- kv helpers ---------------------------------------------------------
     def _expired(self, key: str) -> bool:
@@ -62,8 +76,114 @@ class GridBusBroker:
             return True
         return False
 
+    # -- persistence (AOF) --------------------------------------------------
+    def _wall_deadline(self, key: str) -> float | None:
+        """Monotonic expiry → absolute wall time for the log."""
+        dl = self._expiry.get(key)
+        return None if dl is None else time.time() + (dl - time.monotonic())
+
+    def _log(self, rec: dict) -> None:
+        if self._aof is None:
+            return
+        self._aof.write(json.dumps(rec, separators=(",", ":")) + "\n")
+        self._aof.flush()
+        now = time.monotonic()
+        if now - self._last_fsync >= 1.0:  # Redis `everysec`
+            os.fsync(self._aof.fileno())
+            self._last_fsync = now
+
+    def _apply(self, rec: dict) -> None:
+        op = rec["op"]
+        if op == "set":
+            self._kv[rec["k"]] = rec["v"]
+            self._expiry.pop(rec["k"], None)
+            exp = rec.get("exp")
+            if exp is not None:
+                remaining = exp - time.time()
+                if remaining <= 0:
+                    self._kv.pop(rec["k"], None)
+                else:
+                    self._expiry[rec["k"]] = time.monotonic() + remaining
+        elif op == "del":
+            for k in rec["ks"]:
+                self._kv.pop(k, None)
+                self._expiry.pop(k, None)
+                self._hashes.pop(k, None)
+        elif op == "hset":
+            self._hashes.setdefault(rec["k"], {}).update(rec["fv"])
+        elif op == "hdel":
+            h = self._hashes.get(rec["k"], {})
+            for f in rec["fs"]:
+                h.pop(f, None)
+
+    def _replay_and_compact(self) -> None:
+        path = self._aof_path
+        assert path is not None
+        n = 0
+        if os.path.exists(path):
+            with open(path) as f:
+                lines = [ln.strip() for ln in f if ln.strip()]
+            records = []
+            bad_at = None
+            for i, line in enumerate(lines):
+                try:
+                    records.append(json.loads(line))
+                except json.JSONDecodeError:
+                    bad_at = i
+                    break
+            if bad_at is not None and bad_at != len(lines) - 1:
+                # Redis's aof-load-truncated policy: a torn FINAL line
+                # (crash mid-append) is expected and dropped; corruption
+                # in the middle means the file is damaged and replaying a
+                # prefix (then compacting over the original!) would
+                # silently destroy every good record after it. Refuse.
+                raise RuntimeError(
+                    f"aof: corrupt record {bad_at + 1}/{len(lines)} in "
+                    f"{path} (not a torn tail) — refusing to start; "
+                    "repair or remove the file"
+                )
+            if bad_at is not None:
+                log.warning("aof: dropping torn final record", path=path)
+            for rec in records:
+                try:
+                    self._apply(rec)
+                    n += 1
+                except KeyError:
+                    raise RuntimeError(
+                        f"aof: malformed record in {path} — refusing to "
+                        "start; repair or remove the file"
+                    ) from None
+            # the original survives as .bak until the NEXT successful
+            # compaction — the snapshot rewrite below must never be the
+            # only copy of state it was derived from
+            os.replace(path, path + ".bak")
+        # compact: current state as a fresh log (atomic replace)
+        tmp = path + ".compact"
+        with open(tmp, "w") as f:
+            for k, v in list(self._kv.items()):  # _expired() pops from _kv
+                if self._expired(k):
+                    continue
+                rec = {"op": "set", "k": k, "v": v}
+                exp = self._wall_deadline(k)
+                if exp is not None:
+                    rec["exp"] = exp
+                f.write(json.dumps(rec, separators=(",", ":")) + "\n")
+            for k, h in self._hashes.items():
+                if h:
+                    f.write(json.dumps(
+                        {"op": "hset", "k": k, "fv": h},
+                        separators=(",", ":")) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        self._aof = open(path, "a")
+        log.info("aof: replayed and compacted", path=path, records=n,
+                 keys=len(self._kv), hashes=len(self._hashes))
+
     # -- server -------------------------------------------------------------
     async def start(self, host: str = "127.0.0.1", port: int = 6379) -> None:
+        if self._aof_path:
+            self._replay_and_compact()
         self._server = await asyncio.start_server(self._client, host, port)
         log.info("gridbus broker listening", host=host, port=port)
 
@@ -84,6 +204,13 @@ class GridBusBroker:
                     pass
             await self._server.wait_closed()
             self._server = None
+        if self._aof is not None:
+            try:
+                self._aof.flush()
+                os.fsync(self._aof.fileno())
+            finally:
+                self._aof.close()
+                self._aof = None
 
     async def serve_forever(self) -> None:
         assert self._server is not None
@@ -176,10 +303,18 @@ class GridBusBroker:
                     i += 2
                 else:
                     i += 1
+            if self._aof is not None:  # skip record+deadline math when off
+                rec = {"op": "set", "k": key, "v": val}
+                exp = self._wall_deadline(key)
+                if exp is not None:
+                    rec["exp"] = exp
+                self._log(rec)
             return OK
         if cmd == "SETEX":
             self._kv[a[0]] = a[2]
             self._expiry[a[0]] = time.monotonic() + int(a[1])
+            self._log({"op": "set", "k": a[0], "v": a[2],
+                       "exp": time.time() + int(a[1])})
             return OK
         if cmd == "DEL":
             n = 0
@@ -189,6 +324,8 @@ class GridBusBroker:
                 self._kv.pop(key, None)
                 self._expiry.pop(key, None)
                 self._hashes.pop(key, None)
+            if n:
+                self._log({"op": "del", "ks": list(a)})
             return _int(n)
         if cmd == "TTL":
             key = a[0]
@@ -203,10 +340,13 @@ class GridBusBroker:
         if cmd == "HSET":
             h = self._hashes.setdefault(a[0], {})
             added = 0
+            fv: dict[str, str] = {}
             for i in range(1, len(a) - 1, 2):
                 if a[i] not in h:
                     added += 1
                 h[a[i]] = a[i + 1]
+                fv[a[i]] = a[i + 1]
+            self._log({"op": "hset", "k": a[0], "fv": fv})
             return _int(added)
         if cmd == "HGETALL":
             h = self._hashes.get(a[0], {})
@@ -222,6 +362,8 @@ class GridBusBroker:
                 if f in h:
                     h.pop(f)
                     n += 1
+            if n:
+                self._log({"op": "hdel", "k": a[0], "fs": list(a[1:])})
             return _int(n)
         if cmd == "PUBLISH":
             return _int(self._publish(a[0], a[1]))
@@ -294,10 +436,15 @@ def main() -> None:  # pragma: no cover
     ap = argparse.ArgumentParser(description="gridbus RESP broker")
     ap.add_argument("--host", default="0.0.0.0")
     ap.add_argument("--port", type=int, default=6379)
+    ap.add_argument("--aof", default=os.environ.get("GRIDBUS_AOF") or None,
+                    metavar="PATH",
+                    help="append-only persistence file (scheduler state "
+                         "survives broker restarts; Redis --appendonly "
+                         "equivalent)")
     ns = ap.parse_args()
 
     async def run() -> None:
-        broker = GridBusBroker()
+        broker = GridBusBroker(aof_path=ns.aof)
         await broker.start(ns.host, ns.port)
         await broker.serve_forever()
 

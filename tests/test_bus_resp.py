@@ -127,3 +127,109 @@ async def test_wire_ordering():
         assert got == [str(i) for i in range(10)]
     finally:
         await _teardown(broker, bus, pub)
+
+
+async def test_aof_state_survives_broker_restart(tmp_path):
+    """SURVEY §5.4: the reference's Redis ran --appendonly yes so scheduler
+    state (workers hash, active_jobs, queue keys) survives broker
+    restarts; gridbus --aof must give the same guarantee. Expired keys
+    must NOT resurrect."""
+    aof = str(tmp_path / "bus.aof")
+
+    broker = GridBusBroker(aof_path=aof)
+    await broker.start("127.0.0.1", 0)
+    bus = RespBus(host="127.0.0.1", port=broker.port, key_prefix="T:")
+    await bus.connect()
+    await bus.set("plain", "v1")
+    await bus.set_with_expiry("short", "gone", ttl_s=0.2)
+    await bus.set_with_expiry("long", "kept", ttl_s=60.0)
+    await bus.hset("h", "f1", "a")
+    await bus.hset("h", "f2", "b")
+    await bus.hdel("h", "f2")
+    await bus.set("deleted", "x")
+    await bus.delete("deleted")
+    await bus.disconnect()
+    await broker.stop()
+
+    await asyncio.sleep(0.25)  # "short" expires while the broker is down
+
+    broker2 = GridBusBroker(aof_path=aof)
+    await broker2.start("127.0.0.1", 0)
+    bus2 = RespBus(host="127.0.0.1", port=broker2.port, key_prefix="T:")
+    await bus2.connect()
+    try:
+        assert await bus2.get("plain") == "v1"
+        assert await bus2.get("short") is None
+        assert await bus2.get("long") == "kept"
+        assert await bus2.hgetall("h") == {"f1": "a"}
+        assert await bus2.get("deleted") is None
+    finally:
+        await bus2.disconnect()
+        await broker2.stop()
+
+
+async def test_aof_tolerates_torn_tail(tmp_path):
+    """A crash mid-append leaves a torn last line; replay must stop there
+    and keep everything before it."""
+    aof = str(tmp_path / "bus.aof")
+    broker = GridBusBroker(aof_path=aof)
+    await broker.start("127.0.0.1", 0)
+    bus = RespBus(host="127.0.0.1", port=broker.port, key_prefix="T:")
+    await bus.connect()
+    await bus.set("a", "1")
+    await bus.set("b", "2")
+    await bus.disconnect()
+    await broker.stop()
+
+    with open(aof, "a") as f:
+        f.write('{"op":"set","k":"T:c","v":"tor')  # torn write
+
+    broker2 = GridBusBroker(aof_path=aof)
+    await broker2.start("127.0.0.1", 0)
+    bus2 = RespBus(host="127.0.0.1", port=broker2.port, key_prefix="T:")
+    await bus2.connect()
+    try:
+        assert await bus2.get("a") == "1"
+        assert await bus2.get("b") == "2"
+        assert await bus2.get("c") is None
+    finally:
+        await bus2.disconnect()
+        await broker2.stop()
+
+
+async def test_aof_refuses_midfile_corruption(tmp_path):
+    """Corruption NOT at the tail means the file is damaged; replaying a
+    prefix and compacting over the original would silently destroy every
+    good record after the corruption — the broker must refuse to start."""
+    import json
+
+    import pytest
+
+    aof = str(tmp_path / "bus.aof")
+    with open(aof, "w") as f:
+        f.write(json.dumps({"op": "set", "k": "T:a", "v": "1"}) + "\n")
+        f.write("GARBAGE-NOT-JSON\n")
+        f.write(json.dumps({"op": "set", "k": "T:b", "v": "2"}) + "\n")
+    broker = GridBusBroker(aof_path=aof)
+    with pytest.raises(RuntimeError, match="corrupt record 2/3"):
+        await broker.start("127.0.0.1", 0)
+
+
+async def test_aof_keeps_bak_of_previous_log(tmp_path):
+    """The pre-compaction log survives as .bak — the snapshot must never
+    be the only copy of the state it was derived from."""
+    import os
+
+    aof = str(tmp_path / "bus.aof")
+    broker = GridBusBroker(aof_path=aof)
+    await broker.start("127.0.0.1", 0)
+    bus = RespBus(host="127.0.0.1", port=broker.port, key_prefix="T:")
+    await bus.connect()
+    await bus.set("x", "1")
+    await bus.disconnect()
+    await broker.stop()
+
+    broker2 = GridBusBroker(aof_path=aof)
+    await broker2.start("127.0.0.1", 0)
+    await broker2.stop()
+    assert os.path.exists(aof + ".bak")
