@@ -86,7 +86,7 @@ async def test_worker_sigkill_mid_job_recovers_on_second_worker():
     second: WorkerService | None = None
     try:
         # wait for the victim to register (engine build takes a while)
-        for _ in range(600):
+        for _ in range(1200):
             if registry.get_workers_with_model("tiny-llama"):
                 break
             await asyncio.sleep(0.1)

@@ -64,7 +64,9 @@ async def test_slice_failure_fails_logical_worker():
 
     try:
         # wait for the logical worker to register (one entry, liaison-owned)
-        for _ in range(600):
+        # (generous: under full-suite CPU contention the 2-process jax
+        # group init + first compiles can take well over a minute)
+        for _ in range(1200):
             if await bus.hget("workers", worker_id):
                 break
             await asyncio.sleep(0.1)
@@ -77,7 +79,7 @@ async def test_slice_failure_fails_logical_worker():
 
         # kill the follower abruptly — no clean shutdown, TTL must expire
         follower.send_signal(signal.SIGKILL)
-        await asyncio.wait_for(disconnected.wait(), timeout=30)
+        await asyncio.wait_for(disconnected.wait(), timeout=60)
         assert payloads and payloads[0]["workerId"] == worker_id
         assert "slice members lost" in payloads[0]["reason"]
         # registry entry gone → scheduler orphan path takes over from here
