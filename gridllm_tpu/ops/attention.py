@@ -60,15 +60,14 @@ def attention_prefill(
       0 to every q·k dot and 0·p to the output; the kernel's internal
       1/sqrt(d_padded) scale is corrected by pre-scaling q.
 
-    `logit_softcap` (gemma2's tanh capping) and `window` (sliding-window
-    attention; 0 = full) route to the jnp reference — kernel variants are
-    future work.
+    `logit_softcap` (gemma2's tanh capping, static) and `window`
+    (sliding-window attention; 0 = full; may be a traced per-layer scalar)
+    are handled INSIDE the kernels — windowed buckets also skip the key
+    blocks below each q block's window.
     """
     use, interpret = _pallas_mode(use_pallas)
     t, d = q.shape[1], q.shape[3]
-    has_cap = bool(logit_softcap)
-    has_window = not (isinstance(window, int) and window == 0)
-    if not use or t % min(128, t) != 0 or has_cap or has_window:
+    if not use or t % min(128, t) != 0:
         return attention_prefill_ref(
             q, k, v, seq_lens, logit_softcap=logit_softcap, window=window
         )
@@ -87,7 +86,8 @@ def attention_prefill(
         if kv_bytes <= _FLASH_KV_VMEM_CAP
         else pallas_kernels.flash_prefill_streamed
     )
-    out = fn(q, k, v, seq_lens, interpret=interpret)
+    out = fn(q, k, v, seq_lens, interpret=interpret,
+             softcap=float(logit_softcap), window=window)
     return out[..., :d] if dp != d else out
 
 
@@ -116,18 +116,17 @@ def paged_attention_decode(
     kernel when enabled. Mosaic requires 128-lane-aligned page slices, so
     head_dim must be a multiple of 128 on real TPU (d=64 models fall back
     to the jnp gather path; packing two heads per lane tile is future
-    kernel work). `logit_softcap`/`window` (gemma2) route to the jnp
-    path — kernel variants are future work."""
+    kernel work). `logit_softcap` (static) and `window` (may be traced,
+    gemma2 alternates per layer) are handled inside the kernel — windowed
+    decode never DMAs pages below the window."""
     use, interpret = _pallas_mode(use_pallas)
-    has_cap = bool(logit_softcap)
-    has_window = not (isinstance(window, int) and window == 0)
-    if (use and (interpret or q.shape[-1] % 128 == 0)
-            and not has_cap and not has_window):
+    if use and (interpret or q.shape[-1] % 128 == 0):
         from gridllm_tpu.ops import pallas_kernels
 
         return pallas_kernels.paged_decode(
             q, k_pages, v_pages, page_table, lengths, page_size,
             k_cur=k_cur, v_cur=v_cur, layer=layer, interpret=interpret,
+            softcap=float(logit_softcap), window=window,
         )
     if k_pages.ndim == 5:  # fallback: materialize the layer slice
         li = jnp.int32(0) if layer is None else layer

@@ -48,15 +48,16 @@ from jax.experimental.pallas import tpu as pltpu
 # ---------------------------------------------------------------------------
 
 def _flash_prefill_kernel(
-    seqlen_ref,  # SMEM (1, 1): valid tokens
+    seqlen_ref,  # SMEM (1, 2): [valid tokens, sliding window (0 = full)]
     q_ref,       # VMEM (BQ, 1, G, D) — this q block, this kv head
     k_ref,       # VMEM (1, T, D)     — all keys for this kv head
     v_ref,       # VMEM (1, T, D)
     o_ref,       # VMEM (BQ, 1, G, D)
-    *, bq: int, bk: int, t: int,
+    *, bq: int, bk: int, t: int, softcap: float,
 ):
     qi = pl.program_id(1)
     seq_len = seqlen_ref[0, 0]
+    window = seqlen_ref[0, 1]
     g, d = q_ref.shape[2], q_ref.shape[3]
     scale = jax.lax.rsqrt(jnp.float32(d))
 
@@ -66,9 +67,13 @@ def _flash_prefill_kernel(
     cols = jax.lax.broadcasted_iota(jnp.int32, (bq * g, bk), 1)
 
     # key blocks that can contribute to this q block: causal upper bound,
-    # tightened by the actual sequence length
+    # tightened by the actual sequence length; with a sliding window the
+    # blocks fully BELOW the window are skipped too
     nk = jnp.minimum(
         pl.cdiv((qi + 1) * bq, bk), pl.cdiv(jnp.maximum(seq_len, 1), bk)
+    )
+    kb0 = jnp.where(
+        window > 0, jnp.maximum(qi * bq - window + 1, 0) // bk, 0
     )
 
     def body(kb, carry):
@@ -79,8 +84,13 @@ def _flash_prefill_kernel(
             q, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )  # [BQ*G, BK]
+        if softcap:  # gemma2: tanh capping BEFORE masking
+            logits = softcap * jnp.tanh(logits / softcap)
         k_pos = kb * bk + cols
-        mask = (q_pos >= k_pos) & (k_pos < seq_len)
+        dist = q_pos - k_pos
+        mask = (dist >= 0) & (k_pos < seq_len) & (
+            (window <= 0) | (dist < window)
+        )
         logits = jnp.where(mask, logits, -1e30)
 
         m_new = jnp.maximum(m, logits.max(axis=1, keepdims=True))
@@ -96,24 +106,29 @@ def _flash_prefill_kernel(
     m0 = jnp.full((bq * g, 1), -1e30, jnp.float32)
     l0 = jnp.zeros((bq * g, 1), jnp.float32)
     acc0 = jnp.zeros((bq * g, d), jnp.float32)
-    _, l, acc = jax.lax.fori_loop(0, nk, body, (m0, l0, acc0))
+    _, l, acc = jax.lax.fori_loop(kb0, nk, body, (m0, l0, acc0))
 
     out = acc / jnp.maximum(l, 1e-30)
     o_ref[:, 0] = out.reshape(bq, g, d).astype(o_ref.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
+@functools.partial(jax.jit, static_argnames=("interpret", "softcap"))
 def flash_prefill(
     q: jnp.ndarray,
     k: jnp.ndarray,
     v: jnp.ndarray,
     seq_lens: jnp.ndarray,
     interpret: bool = False,
+    softcap: float = 0.0,
+    window: jnp.ndarray | int = 0,
 ) -> jnp.ndarray:
     """Causal GQA flash attention. Same contract as
     ops.attention.attention_prefill: q [B, T, H, D], k/v [B, T, KVH, D],
     seq_lens [B] → [B, T, H, D]. T must divide by the q block size
     (min(128, T)); the dispatch layer guarantees this for prefill buckets.
+    `softcap` (static): gemma2 tanh logit capping. `window` (scalar, may
+    be traced — gemma2 alternates per layer): sliding-window attention,
+    0 = full; key blocks fully below a q block's window are skipped.
     """
     b, t, h, d = q.shape
     kvh = k.shape[2]
@@ -122,14 +137,17 @@ def flash_prefill(
     bk = min(128, t)
     assert t % bq == 0 and t % bk == 0, (t, bq, bk)
 
-    kernel = functools.partial(_flash_prefill_kernel, bq=bq, bk=bk, t=t)
+    kernel = functools.partial(
+        _flash_prefill_kernel, bq=bq, bk=bk, t=t, softcap=softcap
+    )
+    win = jnp.broadcast_to(jnp.asarray(window, jnp.int32), (b,))
 
-    def one(qb, kb, vb, ln):
+    def one(qb, kb, vb, ln, wn):
         return pl.pallas_call(
             kernel,
             grid=(kvh, t // bq),
             in_specs=[
-                pl.BlockSpec((1, 1), lambda kh, i: (0, 0),
+                pl.BlockSpec((1, 2), lambda kh, i: (0, 0),
                              memory_space=pltpu.SMEM),
                 pl.BlockSpec((bq, 1, g, d), lambda kh, i: (i, kh, 0, 0),
                              memory_space=pltpu.VMEM),
@@ -149,10 +167,10 @@ def flash_prefill(
                 bytes_accessed=(t * h * d + 2 * t * kvh * d) * q.dtype.itemsize,
                 transcendentals=t * t * h,
             ),
-        )(ln.reshape(1, 1), qb.reshape(t, kvh, g, d),
+        )(jnp.stack([ln, wn]).reshape(1, 2), qb.reshape(t, kvh, g, d),
           kb.transpose(1, 0, 2), vb.transpose(1, 0, 2))
 
-    out = jax.vmap(one)(q, k, v, seq_lens.astype(jnp.int32))
+    out = jax.vmap(one)(q, k, v, seq_lens.astype(jnp.int32), win)
     return out.reshape(b, t, h, d)
 
 
@@ -165,7 +183,7 @@ def _flash_prefill_stream_kernel(
     m_scr,       # VMEM (BQ*G, 1) f32 — online-softmax carry across k blocks
     l_scr,       # VMEM (BQ*G, 1) f32
     acc_scr,     # VMEM (BQ*G, D) f32
-    *, bq: int, bk: int,
+    *, bq: int, bk: int, softcap: float,
 ):
     """Streaming variant of _flash_prefill_kernel: the k-block loop is a
     GRID dimension, so K/V blocks are DMA'd HBM→VMEM per step instead of
@@ -178,6 +196,7 @@ def _flash_prefill_stream_kernel(
     kb = pl.program_id(2)
     nk = pl.num_programs(2)
     seq_len = seqlen_ref[0, 0]
+    window = seqlen_ref[0, 1]
     g, d = q_ref.shape[2], q_ref.shape[3]
     scale = jax.lax.rsqrt(jnp.float32(d))
 
@@ -188,9 +207,13 @@ def _flash_prefill_stream_kernel(
         acc_scr[...] = jnp.zeros_like(acc_scr)
 
     # causal: a k block strictly past this q block's last row contributes
-    # nothing — skip its math (the DMA already happened; index-map-level
-    # skipping would revisit blocks and is not worth the complexity here)
-    @pl.when((kb * bk <= qi * bq + bq - 1) & (kb * bk < seq_len))
+    # nothing — skip its math, as do blocks fully below the sliding window
+    # (the DMA already happened; index-map-level skipping would revisit
+    # blocks and is not worth the complexity here)
+    @pl.when(
+        (kb * bk <= qi * bq + bq - 1) & (kb * bk < seq_len)
+        & ((window <= 0) | ((kb + 1) * bk > qi * bq - window + 1))
+    )
     def _():
         q = q_ref[:, 0].reshape(bq * g, d).astype(jnp.float32) * scale
         rows = jax.lax.broadcasted_iota(jnp.int32, (bq * g, bk), 0)
@@ -202,8 +225,13 @@ def _flash_prefill_stream_kernel(
             q, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
+        if softcap:  # gemma2: tanh capping BEFORE masking
+            logits = softcap * jnp.tanh(logits / softcap)
         k_pos = kb * bk + cols
-        mask = (q_pos >= k_pos) & (k_pos < seq_len)
+        dist = q_pos - k_pos
+        mask = (dist >= 0) & (k_pos < seq_len) & (
+            (window <= 0) | (dist < window)
+        )
         logits = jnp.where(mask, logits, -1e30)
 
         m, l, acc = m_scr[...], l_scr[...], acc_scr[...]
@@ -223,17 +251,20 @@ def _flash_prefill_stream_kernel(
         o_ref[:, 0] = out.reshape(bq, g, d).astype(o_ref.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
+@functools.partial(jax.jit, static_argnames=("interpret", "softcap"))
 def flash_prefill_streamed(
     q: jnp.ndarray,
     k: jnp.ndarray,
     v: jnp.ndarray,
     seq_lens: jnp.ndarray,
     interpret: bool = False,
+    softcap: float = 0.0,
+    window: jnp.ndarray | int = 0,
 ) -> jnp.ndarray:
-    """Same contract as flash_prefill; K/V stream from HBM block-by-block
-    (VMEM holds one (BQ q, BK k) tile pair per step) — use for prefill
-    buckets whose per-head K+V exceed the VMEM budget."""
+    """Same contract as flash_prefill (incl. softcap/window); K/V stream
+    from HBM block-by-block (VMEM holds one (BQ q, BK k) tile pair per
+    step) — use for prefill buckets whose per-head K+V exceed the VMEM
+    budget."""
     b, t, h, d = q.shape
     kvh = k.shape[2]
     g = h // kvh
@@ -241,14 +272,17 @@ def flash_prefill_streamed(
     bk = min(128, t)
     assert t % bq == 0 and t % bk == 0, (t, bq, bk)
 
-    kernel = functools.partial(_flash_prefill_stream_kernel, bq=bq, bk=bk)
+    kernel = functools.partial(
+        _flash_prefill_stream_kernel, bq=bq, bk=bk, softcap=softcap
+    )
+    win = jnp.broadcast_to(jnp.asarray(window, jnp.int32), (b,))
 
-    def one(qb, kb_, vb, ln):
+    def one(qb, kb_, vb, ln, wn):
         return pl.pallas_call(
             kernel,
             grid=(kvh, t // bq, t // bk),
             in_specs=[
-                pl.BlockSpec((1, 1), lambda kh, i, kb: (0, 0),
+                pl.BlockSpec((1, 2), lambda kh, i, kb: (0, 0),
                              memory_space=pltpu.SMEM),
                 pl.BlockSpec((bq, 1, g, d), lambda kh, i, kb: (i, kh, 0, 0),
                              memory_space=pltpu.VMEM),
@@ -269,10 +303,10 @@ def flash_prefill_streamed(
             compiler_params=pltpu.CompilerParams(
                 dimension_semantics=("parallel", "parallel", "arbitrary"),
             ),
-        )(ln.reshape(1, 1), qb.reshape(t, kvh, g, d),
+        )(jnp.stack([ln, wn]).reshape(1, 2), qb.reshape(t, kvh, g, d),
           kb_.transpose(1, 0, 2), vb.transpose(1, 0, 2))
 
-    out = jax.vmap(one)(q, k, v, seq_lens.astype(jnp.int32))
+    out = jax.vmap(one)(q, k, v, seq_lens.astype(jnp.int32), win)
     return out.reshape(b, t, h, d)
 
 
@@ -281,7 +315,7 @@ def flash_prefill_streamed(
 # ---------------------------------------------------------------------------
 
 def _paged_decode_kernel(
-    layer_ref,   # SMEM prefetch: [1] which layer of the pool to read
+    layer_ref,   # SMEM prefetch: [2] [layer to read, sliding window (0=full)]
     table_ref,   # SMEM prefetch: [S, maxp] page ids
     len_ref,     # SMEM prefetch: [S] lengths (see paged_decode docstring)
     q_ref,       # VMEM (1, H, D) — this slot's query
@@ -293,11 +327,16 @@ def _paged_decode_kernel(
     k_scr,       # VMEM (2, ps, KVH, D) double buffer
     v_scr,
     sems,        # DMA sems (2, 2): [buffer, k/v]
-    *, ps: int, kvh: int, g: int, d: int, merge_cur: bool,
+    *, ps: int, kvh: int, g: int, d: int, merge_cur: bool, softcap: float,
 ):
     s = pl.program_id(0)
     layer = layer_ref[0]
+    window = layer_ref[1]
     length = len_ref[s]
+    # the query's absolute position: prefix-only lengths put the current
+    # token AT `length` (merge_cur); otherwise it is already in the pool
+    # at length-1
+    qpos = length if merge_cur else length - 1
     # clamp to the table width: pipelined decode blocks can push a
     # finished slot's device-side length past its capacity (host finishes
     # the slot while in-flight blocks still count it active); the page_no
@@ -332,19 +371,25 @@ def _paged_decode_kernel(
     # mode completes copies synchronously and never sees this; real
     # Mosaic dies with an opaque device error (round-4 TPU bench crash).
     n_eff = jnp.where(length > 0, n_pages, 0) if merge_cur else n_pages
+    # sliding window: pages whose every row is out of the window are never
+    # visited — loop (and DMA) start at the window's first page
+    p0 = jnp.where(
+        window > 0, jnp.maximum(qpos - window + 1, 0) // ps, 0
+    )
+    p0 = jnp.minimum(p0, n_eff)  # degenerate slots: keep bounds sane
 
-    @pl.when(n_eff > 0)
+    @pl.when(n_eff > p0)
     def _():
-        k_dma(0, 0).start()
-        v_dma(0, 0).start()
+        k_dma(0, p0).start()
+        v_dma(0, p0).start()
 
     def body(p, carry):
         m, l, acc = carry
-        slot = jax.lax.rem(p, 2)
+        slot = jax.lax.rem(p - p0, 2)
 
-        @pl.when(p + 1 < n_pages)
+        @pl.when(p + 1 < n_eff)
         def _():
-            nxt = jax.lax.rem(p + 1, 2)
+            nxt = jax.lax.rem(p + 1 - p0, 2)
             k_dma(nxt, p + 1).start()
             v_dma(nxt, p + 1).start()
 
@@ -364,8 +409,13 @@ def _paged_decode_kernel(
             )
             for h in range(kvh)
         ])  # [KVH, G, ps]
+        if softcap:  # gemma2: tanh capping BEFORE masking
+            logits = softcap * jnp.tanh(logits / softcap)
         pos = p * ps + jax.lax.broadcasted_iota(jnp.int32, (kvh, g, ps), 2)
-        logits = jnp.where(pos < length, logits, -1e30)
+        valid = (pos < length) & (
+            (window <= 0) | (qpos - pos < window)
+        )
+        logits = jnp.where(valid, logits, -1e30)
 
         m_new = jnp.maximum(m, logits.max(axis=2, keepdims=True))
         alpha = jnp.exp(m - m_new)
@@ -390,13 +440,15 @@ def _paged_decode_kernel(
         # layers at once after the layer scan). length == 0 (fresh slot
         # with empty pool) skips the page loop entirely (n_eff == 0; the
         # initial DMA start above is guarded by the same bound).
-        m, l, acc = jax.lax.fori_loop(0, n_eff, body, (m0, l0, acc0))
+        m, l, acc = jax.lax.fori_loop(p0, n_eff, body, (m0, l0, acc0))
         # online-softmax merge of the single current-token column. The
         # current token's K is scaled along with q (q already carries
         # 1/sqrt(d)), matching the in-pool keys.
         kc = kc_ref[0].astype(jnp.float32)              # [KVH, D]
         vc = vc_ref[0].astype(jnp.float32)
         logit_c = (q * kc[:, None, :]).sum(-1, keepdims=True)  # [KVH, G, 1]
+        if softcap:  # same capping as the in-pool columns (oracle parity)
+            logit_c = softcap * jnp.tanh(logit_c / softcap)
         m_new = jnp.maximum(m, logit_c)
         alpha = jnp.exp(m - m_new)
         p_c = jnp.exp(logit_c - m_new)
@@ -404,12 +456,13 @@ def _paged_decode_kernel(
         acc = acc * alpha + p_c * vc[:, None, :]
         out = acc / jnp.maximum(l, 1e-30)
     else:
-        _, l, acc = jax.lax.fori_loop(0, n_pages, body, (m0, l0, acc0))
+        _, l, acc = jax.lax.fori_loop(p0, n_pages, body, (m0, l0, acc0))
         out = acc / jnp.maximum(l, 1e-30)
     o_ref[0] = out.reshape(kvh * g, d).astype(o_ref.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("page_size", "interpret"))
+@functools.partial(jax.jit,
+                   static_argnames=("page_size", "interpret", "softcap"))
 def paged_decode(
     q: jnp.ndarray,
     k_pages: jnp.ndarray,
@@ -421,8 +474,11 @@ def paged_decode(
     v_cur: jnp.ndarray | None = None,
     layer: jnp.ndarray | None = None,
     interpret: bool = False,
+    softcap: float = 0.0,
+    window: jnp.ndarray | int = 0,
 ) -> jnp.ndarray:
-    """Same contract as ops.attention.paged_attention_decode: q [S, H, D],
+    """Same contract as ops.attention.paged_attention_decode incl.
+    softcap/window (gemma2/mistral): q [S, H, D],
     pools [P, ps, KVH, D] (or [L, P, ps, KVH, D] with `layer` selecting
     which layer to read — pass the FULL pool from inside a layer scan so
     no per-layer pool slice is ever materialized), page_table [S, maxp]
@@ -437,7 +493,9 @@ def paged_decode(
       once per step, after the layer scan — so the pool lags one token).
 
     Slots with length 0 (inactive) compute garbage rows cheaply — callers
-    mask on `active`, matching the oracle.
+    mask on `active`, matching the oracle. With a sliding window, pages
+    fully below the window are never DMA'd — windowed decode reads
+    O(window) context regardless of length.
     """
     s, h, d = q.shape
     if k_pages.ndim == 4:
@@ -454,7 +512,7 @@ def paged_decode(
 
     kernel = functools.partial(
         _paged_decode_kernel, ps=page_size, kvh=kvh, g=g, d=d,
-        merge_cur=merge_cur,
+        merge_cur=merge_cur, softcap=softcap,
     )
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,
@@ -482,7 +540,8 @@ def paged_decode(
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((s, h, d), q.dtype),
         interpret=interpret,
-    )(jnp.asarray(layer, jnp.int32).reshape(1),
+    )(jnp.stack([jnp.asarray(layer, jnp.int32).reshape(()),
+                 jnp.asarray(window, jnp.int32).reshape(())]),
       page_table.astype(jnp.int32), lengths.astype(jnp.int32),
       q, k_pages, v_pages, k_cur, v_cur)
 

@@ -416,3 +416,137 @@ def test_attention_prefill_d64_pads_to_lane_tile(monkeypatch):
         np.asarray(got[0, :100]), np.asarray(want[0, :100]),
         rtol=2e-5, atol=2e-5,
     )
+
+
+@pytest.mark.parametrize("window,softcap", [
+    (8, 0.0),       # window only
+    (0, 30.0),      # softcap only
+    (24, 50.0),     # both (gemma2 shape)
+    (1, 50.0),      # degenerate window: self-attention only
+])
+def test_flash_prefill_softcap_window_matches_ref(window, softcap):
+    t, h, kvh, d = 128, 4, 2, 32
+    lens = [128, 70]
+    b = len(lens)
+    kq, kk, kv = jax.random.split(jax.random.PRNGKey(7), 3)
+    q = jax.random.normal(kq, (b, t, h, d), jnp.float32)
+    k = jax.random.normal(kk, (b, t, kvh, d), jnp.float32)
+    v = jax.random.normal(kv, (b, t, kvh, d), jnp.float32)
+    seq_lens = jnp.asarray(lens, jnp.int32)
+
+    want = attention_prefill_ref(
+        q, k, v, seq_lens, logit_softcap=softcap, window=window)
+    got = flash_prefill(q, k, v, seq_lens, interpret=True,
+                        softcap=softcap, window=window)
+    from gridllm_tpu.ops.pallas_kernels import flash_prefill_streamed
+
+    got_s = flash_prefill_streamed(q, k, v, seq_lens, interpret=True,
+                                   softcap=softcap, window=window)
+    for i, ln in enumerate(lens):
+        np.testing.assert_allclose(
+            np.asarray(got[i, :ln]), np.asarray(want[i, :ln]),
+            rtol=2e-5, atol=2e-5)
+        np.testing.assert_allclose(
+            np.asarray(got_s[i, :ln]), np.asarray(want[i, :ln]),
+            rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("window,softcap,merge", [
+    (16, 0.0, False),
+    (0, 50.0, True),
+    (16, 50.0, True),
+    (1, 0.0, True),      # window 1: only the merged current token attends
+])
+def test_paged_decode_softcap_window_matches_ref(window, softcap, merge):
+    lens = [5, 30, 17]
+    kvh, d, h = 2, 16, 4
+    k_pool, v_pool, table, ps = _fill_pool(jax.random.PRNGKey(11), lens)
+    s = len(lens)
+    q = jax.random.normal(jax.random.PRNGKey(12), (s, h, d), jnp.float32)
+    lengths = jnp.asarray(lens, jnp.int32)
+    kc = vc = None
+    if merge:
+        kc = jax.random.normal(jax.random.PRNGKey(13), (s, kvh, d), jnp.float32)
+        vc = jax.random.normal(jax.random.PRNGKey(14), (s, kvh, d), jnp.float32)
+
+    want = paged_attention_decode_ref(
+        q, k_pool, v_pool, table, lengths, ps, k_cur=kc, v_cur=vc,
+        logit_softcap=softcap, window=window)
+    got = paged_decode(q, k_pool, v_pool, table, lengths, ps,
+                       k_cur=kc, v_cur=vc, interpret=True,
+                       softcap=softcap, window=window)
+    for i in range(s):
+        np.testing.assert_allclose(
+            np.asarray(got[i]), np.asarray(want[i]), rtol=2e-5, atol=2e-5)
+
+
+def test_gemma2_engine_uses_kernels_in_interpret_mode(monkeypatch):
+    """The softcap+window model family must keep the Pallas path: force
+    interpret-mode kernels and check gemma2 generation matches the
+    jnp-path output token-for-token."""
+    from gridllm_tpu.engine import EngineConfig, InferenceEngine
+    from gridllm_tpu.engine.engine import GenerationRequest
+
+    kw = dict(model="tiny-gemma2", max_slots=2, page_size=8, num_pages=32,
+              max_pages_per_slot=8, prefill_buckets=(16, 32))
+    req = dict(prompt="kernel parity check", options={
+        "temperature": 0, "num_predict": 6, "seed": 9})
+
+    monkeypatch.setenv("GRIDLLM_PALLAS", "0")
+    plain = InferenceEngine(EngineConfig(**kw)).generate(
+        GenerationRequest(id="a", **req))
+    monkeypatch.setenv("GRIDLLM_PALLAS", "interpret")
+    kernels = InferenceEngine(EngineConfig(**kw)).generate(
+        GenerationRequest(id="b", **req))
+    assert plain.token_ids == kernels.token_ids
+
+
+@pytest.mark.parametrize("window", [32, 129, 200])
+def test_flash_prefill_window_multiblock(window):
+    """t=256 = two 128-wide k blocks: the below-window block-skip bounds
+    (kb0 in the resident kernel, the pl.when skip in the streamed one)
+    actually fire with kb0 > 0 — a single-block case can't regress them.
+    window=129 straddles a block boundary."""
+    t, h, kvh, d = 256, 4, 2, 32
+    lens = [256, 180]
+    b = len(lens)
+    kq, kk, kv = jax.random.split(jax.random.PRNGKey(21), 3)
+    q = jax.random.normal(kq, (b, t, h, d), jnp.float32)
+    k = jax.random.normal(kk, (b, t, kvh, d), jnp.float32)
+    v = jax.random.normal(kv, (b, t, kvh, d), jnp.float32)
+    seq_lens = jnp.asarray(lens, jnp.int32)
+
+    want = attention_prefill_ref(q, k, v, seq_lens, window=window)
+    got = flash_prefill(q, k, v, seq_lens, interpret=True, window=window)
+    from gridllm_tpu.ops.pallas_kernels import flash_prefill_streamed
+
+    got_s = flash_prefill_streamed(q, k, v, seq_lens, interpret=True,
+                                   window=window)
+    for i, ln in enumerate(lens):
+        np.testing.assert_allclose(
+            np.asarray(got[i, :ln]), np.asarray(want[i, :ln]),
+            rtol=2e-5, atol=2e-5)
+        np.testing.assert_allclose(
+            np.asarray(got_s[i, :ln]), np.asarray(want[i, :ln]),
+            rtol=2e-5, atol=2e-5)
+
+
+def test_paged_decode_window_skips_pages_multipage():
+    """Slot long enough (60 tokens, 8/page) that a 16-token window makes
+    p0 > 0 — the below-window pages are skipped entirely and the result
+    still matches the full-gather oracle."""
+    lens = [60]
+    kvh, d, h = 2, 16, 4
+    k_pool, v_pool, table, ps = _fill_pool(jax.random.PRNGKey(31), lens)
+    q = jax.random.normal(jax.random.PRNGKey(32), (1, h, d), jnp.float32)
+    kc = jax.random.normal(jax.random.PRNGKey(33), (1, kvh, d), jnp.float32)
+    vc = jax.random.normal(jax.random.PRNGKey(34), (1, kvh, d), jnp.float32)
+    lengths = jnp.asarray(lens, jnp.int32)
+    for window in (16, 17, 8, 3):
+        want = paged_attention_decode_ref(
+            q, k_pool, v_pool, table, lengths, ps, k_cur=kc, v_cur=vc,
+            window=window)
+        got = paged_decode(q, k_pool, v_pool, table, lengths, ps,
+                           k_cur=kc, v_cur=vc, interpret=True, window=window)
+        np.testing.assert_allclose(
+            np.asarray(got[0]), np.asarray(want[0]), rtol=2e-5, atol=2e-5)
