@@ -1053,6 +1053,17 @@ class InferenceEngine:
         discarded."""
         from gridllm_tpu.models.bert_embed import pool
 
+        if (self.mesh is not None and self.mesh.shape.get("pp", 1) > 1
+                and not self.embedding_only):
+            # hidden_states has no pp schedule; GSPMD would gather the
+            # pp-sharded layer stack onto every stage (the memory blow-up
+            # pp exists to avoid). Loud failure > silent OOM.
+            raise RuntimeError(
+                f"{self.cfg.name}: decoder-model embeddings are not "
+                "supported under pipeline parallelism — serve embeddings "
+                "from a non-pp engine"
+            )
+
         enc = [
             self.tokenizer.encode_for_embedding(t, self.max_context)
             for t in texts

@@ -124,3 +124,15 @@ def test_engine_serves_over_pp_mesh():
     base = run(None)
     pp = run(MeshConfig(pp=2, dp=2, tp=2))
     assert base == pp
+
+
+def test_pp_engine_rejects_decoder_embeddings():
+    from gridllm_tpu.engine import EngineConfig, InferenceEngine
+
+    eng = InferenceEngine(EngineConfig(
+        model="tiny-llama", max_slots=2, page_size=8, num_pages=32,
+        max_pages_per_slot=4, prefill_buckets=(16, 32),
+        mesh=MeshConfig(pp=2, dp=2, tp=2),
+    ))
+    with pytest.raises(RuntimeError, match="pipeline"):
+        eng.embed(["hello"])
