@@ -22,12 +22,16 @@ servable. Compute is a token-passing schedule inside ONE jitted program:
     tens of KB, cheap enough to ride DCN, which is why "pp" is the
     outermost mesh axis.
 
-This is the sequential schedule: one microbatch, so per-step utilization
-is 1/pp and PP here buys MEMORY, not throughput. Microbatched
-slot-interleaving (fill the pipe with S/pp slot groups) drops into the
-same structure as a future upgrade; BASELINE's serving configs are all
-within-slice, where tp is the right axis anyway — pp is for the models
-that do not fit.
+Two schedules share this structure. Prefill (one slot at a time by
+construction) and the fallback decode use the SEQUENTIAL schedule — one
+live activation, 1/pp utilization. The decode hot path is MICROBATCHED
+(GPipe-style): slots split into pp groups; at tick t stage p runs
+microbatch t-p. Each stage does pp ticks of work in a 2pp-1-tick step,
+so utilization is pp/(2pp-1) ≈ 50% (the classic GPipe bubble; more
+microbatches than stages would push it higher). Either way PP's main
+buy here is MEMORY — BASELINE's serving configs are all within-slice,
+where tp is the right axis; pp is for the models that do not fit one
+slice.
 
 The reference has no analogue (single-GPU Ollama nodes); the design
 follows the public GPipe/shard_map pattern (PAPERS.md — pattern
@@ -133,12 +137,22 @@ def decode_step(
     mlp=llama._mlp,
     mesh=None,
 ) -> tuple[jnp.ndarray, PagedKVCache]:
-    """PP decode step — same contract as llama.decode_step."""
+    """PP decode step — same contract as llama.decode_step.
+
+    Slots are split into pp MICROBATCHES and pipelined GPipe-style: at
+    tick t, stage p runs its layer block on microbatch t-p — pp ticks of
+    work per stage in a 2pp-1-tick step (≈50% utilization vs the
+    sequential schedule's 1/pp; the fill/drain bubble is the classic
+    GPipe cost of matching microbatch count to stage count). Falls back
+    to the sequential schedule when S % pp != 0.
+    """
     pp = pp_size(mesh)
+    s = tokens.shape[0]
     positions = cache.lengths
     new_lengths = jnp.minimum(
         cache.lengths + active.astype(jnp.int32), cache.max_context
     )
+    microbatched = s % pp == 0 and s >= pp
 
     @partial(
         jax.shard_map,
@@ -173,7 +187,85 @@ def decode_step(
         logits = llama._unembed(cfg, params, x)
         return logits, k_pool, v_pool
 
-    logits, k_pool, v_pool = jax.jit(run)(
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        axis_names={"pp"},
+        in_specs=(_stage_specs(params), P(), P("pp"), P("pp"), P(), P(), P()),
+        out_specs=(P(), P("pp"), P("pp")),
+        check_vma=False,
+    )
+    def run_mb(params, tokens, k_pool, v_pool, page_table, positions,
+               active):
+        p = jax.lax.axis_index("pp")
+        m_sz = s // pp
+        e = params["embed"].shape[1]
+        n_local = jax.tree.leaves(params["layers"])[0].shape[0]
+        kvh, d = k_pool.shape[-2], k_pool.shape[-1]
+        x_all = params["embed"][tokens]          # [S, E] — cheap, replicated
+        buf = jnp.zeros((m_sz, e), x_all.dtype)  # activation arriving from p-1
+        outs = jnp.zeros((pp, m_sz, e), x_all.dtype)  # last-stage results
+        k_acc = jnp.zeros((pp, n_local, m_sz, kvh, d), k_pool.dtype)
+        v_acc = jnp.zeros_like(k_acc)
+
+        def stage_mb(x_in, m):
+            """This stage's layer block on microbatch m's slots."""
+            off = m * m_sz
+            pt = jax.lax.dynamic_slice_in_dim(page_table, off, m_sz)
+            pos = jax.lax.dynamic_slice_in_dim(positions, off, m_sz)
+            return llama.decode_layers(
+                params["layers"], cfg, x_in, k_pool, v_pool, pt, pos,
+                cache.page_size, mlp,
+            )
+
+        for t in range(2 * pp - 1):  # static unroll: pipeline schedule
+            m = t - p                # this tick's microbatch for this stage
+            mc = jnp.clip(m, 0, pp - 1)
+            busy = (m >= 0) & (m < pp)
+            # stage 0 picks up fresh embeddings; later stages continue the
+            # activation handed over by the previous stage last tick
+            fresh = jax.lax.dynamic_slice_in_dim(x_all, mc * m_sz, m_sz)
+            x_in = jnp.where(p == 0, fresh, buf)
+
+            def work(args):
+                x_in, k_acc, v_acc = args
+                x_out, k_new, v_new = stage_mb(x_in, mc)
+                k_acc = jax.lax.dynamic_update_slice_in_dim(
+                    k_acc, k_new[None], mc, axis=0)
+                v_acc = jax.lax.dynamic_update_slice_in_dim(
+                    v_acc, v_new[None], mc, axis=0)
+                return x_out, k_acc, v_acc
+
+            x_out, k_acc, v_acc = jax.lax.cond(
+                busy, work, lambda args: args, (x_in, k_acc, v_acc)
+            )
+            outs = jnp.where(
+                busy & (p == pp - 1),
+                jax.lax.dynamic_update_slice_in_dim(outs, x_out[None], mc,
+                                                    axis=0),
+                outs,
+            )
+            if t < 2 * pp - 2:
+                buf = jax.lax.ppermute(x_out, "pp", _ring(pp))
+
+        # every device wrote its own layer block's K/V for ALL microbatches
+        # (accumulated per tick) — one deferred pool write, as elsewhere
+        k_new_all = k_acc.transpose(1, 0, 2, 3, 4).reshape(
+            n_local, s, kvh, d)
+        v_new_all = v_acc.transpose(1, 0, 2, 3, 4).reshape(
+            n_local, s, kvh, d)
+        k_pool, v_pool = write_decode_all(
+            k_pool, v_pool, k_new_all, v_new_all, page_table, positions,
+            active, cache.page_size, use_pallas=False,
+        )
+        # final-stage activations → everyone, for the replicated tail
+        x = _bcast_from_last(outs.reshape(s, e), p, pp)
+        x = rms_norm(x, params["final_norm"], cfg.rms_eps)
+        logits = llama._unembed(cfg, params, x)
+        return logits, k_pool, v_pool
+
+    fn = run_mb if microbatched else run
+    logits, k_pool, v_pool = jax.jit(fn)(
         params, tokens, cache.k, cache.v, cache.page_table, positions, active
     )
     return logits, PagedKVCache(

@@ -136,3 +136,40 @@ def test_pp_engine_rejects_decoder_embeddings():
     ))
     with pytest.raises(RuntimeError, match="pipeline"):
         eng.embed(["hello"])
+
+
+@pytest.mark.parametrize("n_slots", [4, 3])  # 4 → microbatched, 3 → fallback
+def test_pp_decode_schedules_match_single_device(pp_mesh, n_slots):
+    """Both decode schedules (GPipe microbatched when S % pp == 0, the
+    sequential fallback otherwise) must match the unsharded decode for
+    MULTIPLE active slots with ragged lengths."""
+    params = llama.init_params(CFG, jax.random.PRNGKey(5), dtype=jnp.float32)
+    cache = PagedKVCache.create(
+        CFG.num_layers, num_pages=16, page_size=8,
+        num_kv_heads=CFG.num_kv_heads, head_dim=CFG.head_dim_,
+        max_slots=n_slots, max_pages_per_slot=4, dtype=jnp.float32)
+    alloc = PageAllocator(16, 8, 4)
+    # ragged prefixes in every slot
+    ref_cache = cache
+    for slot, ln in enumerate([5, 9, 2, 7][:n_slots]):
+        alloc.alloc(slot, 16)
+        row = jnp.asarray(alloc.table_row(slot), jnp.int32)
+        ids = jnp.asarray(list(range(2, 2 + 16)), jnp.int32)
+        _, ref_cache = llama.prefill(
+            params, CFG, ids, jnp.int32(ln), ref_cache, jnp.int32(slot), row)
+
+    tok = jnp.asarray(list(range(40, 40 + n_slots)), jnp.int32)
+    act = jnp.ones((n_slots,), bool)
+    ref_dec, ref_after = llama.decode_step(params, CFG, tok, ref_cache, act)
+
+    sp_params = shard_params(params, pp_mesh)
+    pp_cache = shard_cache(ref_cache, pp_mesh)
+    pp_dec, pp_after = pipeline.decode_step(
+        sp_params, CFG, tok, pp_cache, act, mesh=pp_mesh)
+    np.testing.assert_allclose(
+        np.asarray(pp_dec), np.asarray(ref_dec), rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(
+        np.asarray(pp_after.k), np.asarray(ref_after.k),
+        rtol=2e-4, atol=2e-4)
+    np.testing.assert_array_equal(
+        np.asarray(pp_after.lengths), np.asarray(ref_after.lengths))
