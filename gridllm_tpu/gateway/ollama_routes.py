@@ -21,6 +21,7 @@ model required.
 
 from __future__ import annotations
 
+import asyncio
 import re
 import time
 import uuid
@@ -45,10 +46,15 @@ log = get_logger("gateway.ollama")
 MAX_PROMPT = 100 * 1024  # Joi max (ollama.ts:19)
 
 
-def _require_model(body: dict, registry: WorkerRegistry) -> str:
+def _require_model_name(body: dict) -> str:
     model = body.get("model")
     if not model or not isinstance(model, str):
         raise ApiError("Validation error: \"model\" is required", 400)
+    return model
+
+
+def _require_model(body: dict, registry: WorkerRegistry) -> str:
+    model = _require_model_name(body)
     if not registry.get_workers_with_model(model):
         raise ApiError(
             f"Model '{model}' is not available on any worker", 404, "MODEL_NOT_FOUND")
@@ -99,31 +105,100 @@ def build_routes(registry: WorkerRegistry, scheduler: JobScheduler,
                  version: str, default_timeout_ms: int = 300_000) -> list[web.RouteDef]:
     routes: list[web.RouteDef] = []
     DEFAULT_TIMEOUT_MS = default_timeout_ms
-    # keep_alive bookkeeping: engines stay HBM-resident (a TPU worker's
-    # weights are provisioned at startup — reloading a 3-70B checkpoint
-    # per request would dwarf any serving win), so keep_alive is honored
-    # as ADVERTISED residency: /api/ps reports expires_at from the last
-    # request's keep_alive, and keep_alive=0 + empty prompt returns the
-    # unload shape (Ollama clients use both to manage memory).
+    # keep_alive bookkeeping: /api/ps reports expires_at from the last
+    # request's keep_alive; keep_alive=0 + empty prompt REALLY unloads
+    # (worker admin broadcast) and the next request for the model
+    # auto-loads it back (_require_servable) — full Ollama residency
+    # semantics. Workers without management (multi-host slices) decline
+    # unloads and stay resident.
     model_expiry: dict[str, float | None] = {}
 
     def _touch_keep_alive(model: str, keep_alive: Any) -> None:
         sec = _parse_keep_alive(keep_alive)
         model_expiry[model] = None if sec is None else time.time() + sec
 
+    def _servable_now(model: str) -> bool:
+        """Alias-aware registry check: workers resolve the ':latest' tag
+        both ways (worker/service.py _resolve_name), so the gateway
+        lookup must too or alias-named requests could never observe the
+        load they just triggered."""
+        if registry.get_workers_with_model(model):
+            return True
+        if model.endswith(":latest") and registry.get_workers_with_model(
+            model[: -len(":latest")]
+        ):
+            return True
+        return (":" not in model
+                and bool(registry.get_workers_with_model(f"{model}:latest")))
+
+    # in-flight load-on-demand broadcasts, coalesced per model: N
+    # concurrent requests for a cold model must not fire N cluster
+    # broadcasts + N propagation polls
+    load_futs: dict[str, asyncio.Future] = {}
+
+    async def _require_servable(body: dict) -> str:
+        """Ollama load-on-demand semantics: a request for a model no
+        worker currently serves first asks the cluster to load it (the
+        other half of keep_alive=0 actually unloading — Ollama reloads
+        transparently on the next request). 404 only when no worker can."""
+        model = _require_model_name(body)
+        if _servable_now(model):
+            return model
+        if registry.get_online_workers():
+            fut = load_futs.get(model)
+            if fut is None:
+                fut = asyncio.get_running_loop().create_future()
+                load_futs[model] = fut
+                try:
+                    results = await _admin_broadcast(
+                        "load_model", {"model": model},
+                        DEFAULT_TIMEOUT_MS / 1000.0)
+                    if any(r.get("ok") for r in results):
+                        for _ in range(100):  # registration propagation
+                            if _servable_now(model):
+                                break
+                            await asyncio.sleep(0.1)
+                    fut.set_result(None)
+                except BaseException as e:
+                    fut.set_exception(e)
+                    raise
+                finally:
+                    load_futs.pop(model, None)
+            else:
+                await asyncio.shield(fut)
+            if _servable_now(model):
+                return model
+        raise ApiError(
+            f"Model '{model}' is not available on any worker", 404,
+            "MODEL_NOT_FOUND")
+
     # ---------------- /api/generate ----------------
     async def generate(request: web.Request) -> web.StreamResponse:
         body = await request.json()
-        model = _require_model(body, registry)
         prompt = _validate_prompt(body)
         stream = body.get("stream", True)  # Ollama default (ollama.ts:51)
 
         # empty prompt → load/unload semantics (ollama.ts:177-214)
         if not prompt or not prompt.strip():
-            payload: dict[str, Any] = {
-                "model": model, "created_at": iso_now(), "response": "", "done": True}
-            if body.get("keep_alive") == 0:
-                payload["done_reason"] = "unload"
+            ka = body.get("keep_alive")
+            # NOT isinstance bool: JSON false == 0 in Python, and a client
+            # sending keep_alive:false must not nuke the weights
+            if ka == 0 and not isinstance(ka, bool):
+                # REAL unload (Ollama drops the weights on keep_alive=0);
+                # must NOT go through load-on-demand first — unloading an
+                # unloaded model is a no-op, not a load. Workers without
+                # management (multi-host groups) decline and stay loaded.
+                model = _require_model_name(body)
+                await _admin_broadcast("unload_model", {"model": model}, 30.0)
+                payload: dict[str, Any] = {
+                    "model": model, "created_at": iso_now(), "response": "",
+                    "done": True, "done_reason": "unload"}
+            else:
+                # load/warmup semantics: an empty prompt loads the model
+                model = await _require_servable(body)
+                payload = {
+                    "model": model, "created_at": iso_now(), "response": "",
+                    "done": True}
             if stream:
                 resp = await start_ndjson(request)
                 await write_ndjson(resp, payload)
@@ -131,6 +206,7 @@ def build_routes(registry: WorkerRegistry, scheduler: JobScheduler,
                 return resp
             return web.json_response(payload)
 
+        model = await _require_servable(body)
         req = InferenceRequest(
             id=str(uuid.uuid4()), model=model, prompt=prompt, stream=stream,
             options=body.get("options") or {},
@@ -181,7 +257,7 @@ def build_routes(registry: WorkerRegistry, scheduler: JobScheduler,
     # ---------------- /api/chat ----------------
     async def chat(request: web.Request) -> web.StreamResponse:
         body = await request.json()
-        model = _require_model(body, registry)
+        model = await _require_servable(body)
         messages = body.get("messages")
         if not isinstance(messages, list) or not messages:
             raise ApiError("Validation error: \"messages\" is required", 400)
@@ -263,7 +339,7 @@ def build_routes(registry: WorkerRegistry, scheduler: JobScheduler,
     # ---------------- /api/embed (+ legacy /api/embeddings) ----------------
     async def embed(request: web.Request) -> web.Response:
         body = await request.json()
-        model = _require_model(body, registry)
+        model = await _require_servable(body)
         input_val = body.get("input")
         if input_val is None or (isinstance(input_val, list) and not input_val):
             raise ApiError("Validation error: \"input\" is required", 400)
@@ -288,7 +364,7 @@ def build_routes(registry: WorkerRegistry, scheduler: JobScheduler,
     async def embeddings_legacy(request: web.Request) -> web.Response:
         """Single-embedding legacy shape (ollama.ts:646-711)."""
         body = await request.json()
-        model = _require_model(body, registry)
+        model = await _require_servable(body)
         prompt = body.get("prompt")
         if prompt is None:
             raise ApiError("Validation error: \"prompt\" is required", 400)
@@ -371,17 +447,23 @@ def build_routes(registry: WorkerRegistry, scheduler: JobScheduler,
         op: str, payload: dict, timeout_s: float,
         on_result=None,
     ) -> list[dict]:
-        import asyncio
         import json as _json
 
         bus = registry.bus
         rid = uuid.uuid4().hex
         expect = max(len(registry.get_online_workers()), 1)
         results: list[dict] = []
+        acks = 0
         done = asyncio.Event()
 
         async def handler(_ch: str, raw: str) -> None:
+            nonlocal acks
             rec = _json.loads(raw)
+            if rec.get("ack"):
+                # workers ack instantly, then work (possibly minutes for a
+                # big checkpoint); acks gate the early-bail below
+                acks += 1
+                return
             results.append(rec)
             # count/done BEFORE the progress callback: a raising on_result
             # (e.g. streamed-pull client disconnect mid-write) must not
@@ -396,9 +478,17 @@ def build_routes(registry: WorkerRegistry, scheduler: JobScheduler,
         await bus.publish("worker:admin",
                           _json.dumps({"op": op, "id": rid, **payload}))
         try:
-            await asyncio.wait_for(done.wait(), timeout_s)
+            # bail fast when NOBODY speaks the admin protocol (legacy or
+            # stub workers): no ack and no result within the grace window
+            # means waiting longer cannot help
+            await asyncio.wait_for(done.wait(), min(5.0, timeout_s))
         except asyncio.TimeoutError:
-            pass
+            if acks or results:
+                try:
+                    await asyncio.wait_for(done.wait(),
+                                           max(timeout_s - 5.0, 0.0))
+                except asyncio.TimeoutError:
+                    pass
         await sub.unsubscribe()
         return results
 

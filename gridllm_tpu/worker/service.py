@@ -96,6 +96,8 @@ class WorkerService(EventEmitter):
         # liaison's HBM, orphan the followers' copies, and leave the
         # slice asymmetric with no way to reload (worker/main.py).
         self.admin_ops_enabled = True
+        self._admin_lock = asyncio.Lock()
+        self._admin_tasks: set[asyncio.Task] = set()
         self._running = False
         self._subs: list[Subscription] = []
         self._tasks: list[asyncio.Task] = []
@@ -183,7 +185,18 @@ class WorkerService(EventEmitter):
         op, rid = msg.get("op"), msg.get("id")
         if not op or not rid:
             return
-        ok, detail = False, ""
+        # immediate ack BEFORE doing the (possibly minutes-long) work:
+        # lets the gateway distinguish "loading a 70B checkpoint" from
+        # "no worker speaks the admin protocol" and bail fast on the
+        # latter instead of waiting out the whole op timeout. The op
+        # itself runs in a SPAWNED task — the bus pump serializes handler
+        # calls, and an op queued behind a long load would otherwise get
+        # no ack within the gateway's grace window and be spuriously
+        # failed. Ops still execute one at a time (self._admin_lock) so
+        # concurrent loads of the same model cannot double-build.
+        await self.bus.publish(f"admin:result:{rid}", json.dumps({
+            "workerId": self.worker_id, "op": op, "ack": True,
+        }))
         if not self.admin_ops_enabled:
             await self.bus.publish(f"admin:result:{rid}", json.dumps({
                 "workerId": self.worker_id, "op": op, "ok": False,
@@ -191,22 +204,31 @@ class WorkerService(EventEmitter):
                           "worker groups",
             }))
             return
-        try:
-            if op == "load_model":
-                ok, detail = await self._admin_load(msg["model"])
-            elif op == "unload_model":
-                ok, detail = await self._admin_unload(msg["model"])
-            elif op == "copy_model":
-                ok, detail = await self._admin_copy(
-                    msg["source"], msg["destination"]
-                )
-            else:
-                detail = f"unknown admin op {op!r}"
-        except Exception as e:  # noqa: BLE001 — always answer the gateway
-            detail = str(e)
-        await self.bus.publish(f"admin:result:{rid}", json.dumps({
-            "workerId": self.worker_id, "op": op, "ok": ok, "detail": detail,
-        }))
+
+        async def run_op() -> None:
+            ok, detail = False, ""
+            try:
+                async with self._admin_lock:
+                    if op == "load_model":
+                        ok, detail = await self._admin_load(msg["model"])
+                    elif op == "unload_model":
+                        ok, detail = await self._admin_unload(msg["model"])
+                    elif op == "copy_model":
+                        ok, detail = await self._admin_copy(
+                            msg["source"], msg["destination"]
+                        )
+                    else:
+                        detail = f"unknown admin op {op!r}"
+            except Exception as e:  # noqa: BLE001 — always answer the gateway
+                detail = str(e)
+            await self.bus.publish(f"admin:result:{rid}", json.dumps({
+                "workerId": self.worker_id, "op": op, "ok": ok,
+                "detail": detail,
+            }))
+
+        task = asyncio.create_task(run_op())
+        self._admin_tasks.add(task)  # strong ref until done (GC hazard)
+        task.add_done_callback(self._admin_tasks.discard)
 
     async def _admin_load(self, model: str) -> tuple[bool, str]:
         if self._resolve_engine(model) is not None:

@@ -64,9 +64,10 @@ async def _teardown(registry, scheduler, worker, client, bus):
 async def test_pull_loads_model_and_serves_it():
     bus, registry, scheduler, worker, client = await _stack(_tiny_factory)
     try:
-        # not served yet
+        # a model no worker can build still 404s (fast: workers ACK the
+        # admin broadcast, attempt the load, and reply not-ok)
         r = await client.post("/ollama/api/generate", json={
-            "model": "tiny-qwen2", "prompt": "x", "stream": False})
+            "model": "no-such-model", "prompt": "x", "stream": False})
         assert r.status == 404
 
         r = await client.post("/ollama/api/pull", json={
@@ -129,5 +130,29 @@ async def test_copy_aliases_and_delete_unloads():
         r = await client.delete("/ollama/api/delete",
                                 json={"model": "never-existed"})
         assert r.status == 404
+    finally:
+        await _teardown(registry, scheduler, worker, client, bus)
+
+
+async def test_keep_alive_zero_unloads_and_next_request_reloads():
+    """Full Ollama residency semantics: empty prompt + keep_alive=0
+    REALLY unloads the weights; the next generate for the model
+    auto-loads it back (load-on-demand), no explicit pull needed."""
+    bus, registry, scheduler, worker, client = await _stack(_tiny_factory)
+    try:
+        r = await client.post("/ollama/api/generate", json={
+            "model": "tiny-llama", "prompt": "", "keep_alive": 0,
+            "stream": False})
+        body = await r.json()
+        assert r.status == 200 and body["done_reason"] == "unload", body
+        assert "tiny-llama" not in worker.engines  # weights actually gone
+
+        await asyncio.sleep(0.1)
+        r = await client.post("/ollama/api/generate", json={
+            "model": "tiny-llama", "prompt": "back again", "stream": False,
+            "options": {"temperature": 0, "num_predict": 3}})
+        body = await r.json()
+        assert r.status == 200 and body["done"], body
+        assert "tiny-llama" in worker.engines  # auto-reloaded
     finally:
         await _teardown(registry, scheduler, worker, client, bus)
