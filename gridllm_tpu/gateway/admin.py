@@ -1,0 +1,127 @@
+"""Cluster model administration from the gateway: the admin broadcast
+protocol (worker/service.py _on_admin) and Ollama residency semantics
+(load-on-demand), shared by every API surface (ollama/openai routes).
+
+One instance per app (gateway/app.py) so concurrent cold-model requests
+coalesce across surfaces."""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import uuid
+from typing import Callable
+
+from gridllm_tpu.scheduler import WorkerRegistry
+
+
+class ModelAdmin:
+    def __init__(self, registry: WorkerRegistry,
+                 default_timeout_ms: int = 300_000) -> None:
+        self.registry = registry
+        self.default_timeout_s = default_timeout_ms / 1000.0
+        # in-flight load-on-demand broadcasts, coalesced per model: N
+        # concurrent requests for a cold model must not fire N cluster
+        # broadcasts + N propagation polls
+        self._load_futs: dict[str, asyncio.Future] = {}
+
+    def servable_now(self, model: str) -> bool:
+        """Alias-aware registry check: workers resolve the ':latest' tag
+        both ways (worker/service.py _resolve_name), so the gateway
+        lookup must too or alias-named requests could never observe the
+        load they just triggered."""
+        reg = self.registry
+        if reg.get_workers_with_model(model):
+            return True
+        if model.endswith(":latest") and reg.get_workers_with_model(
+            model[: -len(":latest")]
+        ):
+            return True
+        return (":" not in model
+                and bool(reg.get_workers_with_model(f"{model}:latest")))
+
+    async def broadcast(
+        self, op: str, payload: dict, timeout_s: float,
+        on_result: Callable | None = None,
+    ) -> list[dict]:
+        """One admin op to every worker; collects their results. Workers
+        ack instantly then work (worker/service.py), so a missing ack
+        within the grace window means nobody speaks the protocol."""
+        bus = self.registry.bus
+        rid = uuid.uuid4().hex
+        expect = max(len(self.registry.get_online_workers()), 1)
+        results: list[dict] = []
+        acks = 0
+        done = asyncio.Event()
+
+        async def handler(_ch: str, raw: str) -> None:
+            nonlocal acks
+            rec = json.loads(raw)
+            if rec.get("ack"):
+                acks += 1
+                return
+            results.append(rec)
+            # count/done BEFORE the progress callback: a raising on_result
+            # (e.g. streamed-pull client disconnect mid-write) must not
+            # leave the broadcast waiting out its whole timeout
+            if len(results) >= expect:
+                done.set()
+            if on_result is not None:
+                await on_result(rec)
+
+        sub = await bus.subscribe(f"admin:result:{rid}", handler)
+        await asyncio.sleep(0.05)  # pub/sub delivery is async (broker)
+        await bus.publish("worker:admin",
+                          json.dumps({"op": op, "id": rid, **payload}))
+        try:
+            await asyncio.wait_for(done.wait(), min(5.0, timeout_s))
+        except asyncio.TimeoutError:
+            if acks or results:
+                try:
+                    await asyncio.wait_for(done.wait(),
+                                           max(timeout_s - 5.0, 0.0))
+                except asyncio.TimeoutError:
+                    pass
+        await sub.unsubscribe()
+        return results
+
+    async def ensure_servable(self, model: str) -> bool:
+        """Ollama load-on-demand: if no worker serves `model`, ask the
+        cluster to load it (the other half of keep_alive=0 actually
+        unloading — Ollama reloads transparently on the next request).
+        Returns whether the model is servable afterwards."""
+        if self.servable_now(model):
+            return True
+        if not self.registry.get_online_workers():
+            return False
+        fut = self._load_futs.get(model)
+        if fut is None:
+            fut = asyncio.get_running_loop().create_future()
+            self._load_futs[model] = fut
+            try:
+                results = await self.broadcast(
+                    "load_model", {"model": model}, self.default_timeout_s)
+                if any(r.get("ok") for r in results):
+                    for _ in range(100):  # registration propagation
+                        if self.servable_now(model):
+                            break
+                        await asyncio.sleep(0.1)
+                fut.set_result(None)
+            except BaseException as e:
+                fut.set_exception(e)
+                raise
+            finally:
+                self._load_futs.pop(model, None)
+        else:
+            await asyncio.shield(fut)
+        return self.servable_now(model)
+
+
+def get_admin(registry: WorkerRegistry, admin: "ModelAdmin | None",
+              default_timeout_ms: int) -> ModelAdmin:
+    """build_routes helper: use the app-shared instance when provided."""
+    return admin if admin is not None else ModelAdmin(
+        registry, default_timeout_ms)
+
+
+__all__ = ["ModelAdmin", "get_admin"]

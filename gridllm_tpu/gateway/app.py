@@ -46,11 +46,18 @@ def create_app(bus: MessageBus, registry: WorkerRegistry, scheduler: JobSchedule
     # the same handlers are also mounted bare at /api/* so native Ollama
     # SDKs pointed straight at the gateway work unchanged.
     timeout_ms = config.gateway.default_request_timeout_ms
-    ollama = ollama_routes.build_routes(registry, scheduler, version, timeout_ms)
+    # ONE ModelAdmin across surfaces: concurrent cold-model requests from
+    # the Ollama and OpenAI APIs coalesce behind the same load broadcast
+    from gridllm_tpu.gateway.admin import ModelAdmin
+
+    admin = ModelAdmin(registry, timeout_ms)
+    ollama = ollama_routes.build_routes(registry, scheduler, version,
+                                        timeout_ms, admin=admin)
     app.add_routes([web.RouteDef(r.method, f"/ollama{r.path}", r.handler, r.kwargs)
                     for r in ollama])
     app.add_routes(ollama)
-    app.add_routes(openai_routes.build_routes(registry, scheduler, timeout_ms))
+    app.add_routes(openai_routes.build_routes(registry, scheduler, timeout_ms,
+                                              admin=admin))
     app.add_routes(inference_routes.build_routes(registry, scheduler))
     app.add_routes(health_routes.build_routes(bus, registry, scheduler, version))
 

@@ -102,9 +102,13 @@ def _parse_keep_alive(v: Any) -> float | None:
 
 
 def build_routes(registry: WorkerRegistry, scheduler: JobScheduler,
-                 version: str, default_timeout_ms: int = 300_000) -> list[web.RouteDef]:
+                 version: str, default_timeout_ms: int = 300_000,
+                 admin=None) -> list[web.RouteDef]:
+    from gridllm_tpu.gateway.admin import get_admin
+
     routes: list[web.RouteDef] = []
     DEFAULT_TIMEOUT_MS = default_timeout_ms
+    madmin = get_admin(registry, admin, default_timeout_ms)
     # keep_alive bookkeeping: /api/ps reports expires_at from the last
     # request's keep_alive; keep_alive=0 + empty prompt REALLY unloads
     # (worker admin broadcast) and the next request for the model
@@ -117,57 +121,12 @@ def build_routes(registry: WorkerRegistry, scheduler: JobScheduler,
         sec = _parse_keep_alive(keep_alive)
         model_expiry[model] = None if sec is None else time.time() + sec
 
-    def _servable_now(model: str) -> bool:
-        """Alias-aware registry check: workers resolve the ':latest' tag
-        both ways (worker/service.py _resolve_name), so the gateway
-        lookup must too or alias-named requests could never observe the
-        load they just triggered."""
-        if registry.get_workers_with_model(model):
-            return True
-        if model.endswith(":latest") and registry.get_workers_with_model(
-            model[: -len(":latest")]
-        ):
-            return True
-        return (":" not in model
-                and bool(registry.get_workers_with_model(f"{model}:latest")))
-
-    # in-flight load-on-demand broadcasts, coalesced per model: N
-    # concurrent requests for a cold model must not fire N cluster
-    # broadcasts + N propagation polls
-    load_futs: dict[str, asyncio.Future] = {}
-
     async def _require_servable(body: dict) -> str:
-        """Ollama load-on-demand semantics: a request for a model no
-        worker currently serves first asks the cluster to load it (the
-        other half of keep_alive=0 actually unloading — Ollama reloads
-        transparently on the next request). 404 only when no worker can."""
+        """Ollama load-on-demand (gateway/admin.py): load the model on
+        request when no worker serves it; 404 only when none can."""
         model = _require_model_name(body)
-        if _servable_now(model):
+        if await madmin.ensure_servable(model):
             return model
-        if registry.get_online_workers():
-            fut = load_futs.get(model)
-            if fut is None:
-                fut = asyncio.get_running_loop().create_future()
-                load_futs[model] = fut
-                try:
-                    results = await _admin_broadcast(
-                        "load_model", {"model": model},
-                        DEFAULT_TIMEOUT_MS / 1000.0)
-                    if any(r.get("ok") for r in results):
-                        for _ in range(100):  # registration propagation
-                            if _servable_now(model):
-                                break
-                            await asyncio.sleep(0.1)
-                    fut.set_result(None)
-                except BaseException as e:
-                    fut.set_exception(e)
-                    raise
-                finally:
-                    load_futs.pop(model, None)
-            else:
-                await asyncio.shield(fut)
-            if _servable_now(model):
-                return model
         raise ApiError(
             f"Model '{model}' is not available on any worker", 404,
             "MODEL_NOT_FOUND")
@@ -447,50 +406,7 @@ def build_routes(registry: WorkerRegistry, scheduler: JobScheduler,
         op: str, payload: dict, timeout_s: float,
         on_result=None,
     ) -> list[dict]:
-        import json as _json
-
-        bus = registry.bus
-        rid = uuid.uuid4().hex
-        expect = max(len(registry.get_online_workers()), 1)
-        results: list[dict] = []
-        acks = 0
-        done = asyncio.Event()
-
-        async def handler(_ch: str, raw: str) -> None:
-            nonlocal acks
-            rec = _json.loads(raw)
-            if rec.get("ack"):
-                # workers ack instantly, then work (possibly minutes for a
-                # big checkpoint); acks gate the early-bail below
-                acks += 1
-                return
-            results.append(rec)
-            # count/done BEFORE the progress callback: a raising on_result
-            # (e.g. streamed-pull client disconnect mid-write) must not
-            # leave the broadcast waiting out its whole timeout
-            if len(results) >= expect:
-                done.set()
-            if on_result is not None:
-                await on_result(rec)
-
-        sub = await bus.subscribe(f"admin:result:{rid}", handler)
-        await asyncio.sleep(0.05)  # pub/sub delivery is async (broker)
-        await bus.publish("worker:admin",
-                          _json.dumps({"op": op, "id": rid, **payload}))
-        try:
-            # bail fast when NOBODY speaks the admin protocol (legacy or
-            # stub workers): no ack and no result within the grace window
-            # means waiting longer cannot help
-            await asyncio.wait_for(done.wait(), min(5.0, timeout_s))
-        except asyncio.TimeoutError:
-            if acks or results:
-                try:
-                    await asyncio.wait_for(done.wait(),
-                                           max(timeout_s - 5.0, 0.0))
-                except asyncio.TimeoutError:
-                    pass
-        await sub.unsubscribe()
-        return results
+        return await madmin.broadcast(op, payload, timeout_s, on_result)
 
     def _mgmt_model(body: dict) -> str:
         model = body.get("model") or body.get("name")

@@ -95,15 +95,21 @@ def map_options(body: dict[str, Any]) -> dict[str, Any]:
 
 
 def build_routes(registry: WorkerRegistry, scheduler: JobScheduler,
-                 default_timeout_ms: int = 300_000) -> list[web.RouteDef]:
-    DEFAULT_TIMEOUT_MS = default_timeout_ms
+                 default_timeout_ms: int = 300_000,
+                 admin=None) -> list[web.RouteDef]:
+    from gridllm_tpu.gateway.admin import get_admin
 
-    def _require_model(body: dict) -> str:
+    DEFAULT_TIMEOUT_MS = default_timeout_ms
+    madmin = get_admin(registry, admin, default_timeout_ms)
+
+    async def _require_model(body: dict) -> str:
         model = body.get("model")
         if not model or not isinstance(model, str):
             raise OpenAIApiError("you must provide a model parameter", 400,
                                  "invalid_request_error")
-        if not registry.get_workers_with_model(model):
+        # same load-on-demand residency semantics as the Ollama surface
+        # (gateway/admin.py): a cold model gets a cluster load before 404
+        if not await madmin.ensure_servable(model):
             raise OpenAIApiError(
                 f"The model '{model}' does not exist or is not available",
                 404, "invalid_request_error", "model_not_found")
@@ -112,7 +118,7 @@ def build_routes(registry: WorkerRegistry, scheduler: JobScheduler,
     # ---------------- /v1/chat/completions ----------------
     async def chat_completions(request: web.Request) -> web.StreamResponse:
         body = await request.json()
-        model = _require_model(body)
+        model = await _require_model(body)
         messages = body.get("messages")
         if not isinstance(messages, list) or not messages:
             raise OpenAIApiError("'messages' is a required property", 400,
@@ -206,7 +212,7 @@ def build_routes(registry: WorkerRegistry, scheduler: JobScheduler,
     # ---------------- /v1/completions ----------------
     async def completions(request: web.Request) -> web.StreamResponse:
         body = await request.json()
-        model = _require_model(body)
+        model = await _require_model(body)
         prompt = body.get("prompt")
         if isinstance(prompt, list):
             prompt = "".join(str(p) for p in prompt)

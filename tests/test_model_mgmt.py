@@ -156,3 +156,19 @@ async def test_keep_alive_zero_unloads_and_next_request_reloads():
         assert "tiny-llama" in worker.engines  # auto-reloaded
     finally:
         await _teardown(registry, scheduler, worker, client, bus)
+
+
+async def test_openai_surface_loads_on_demand():
+    """The OpenAI surface shares the same residency semantics (ONE
+    ModelAdmin per app): a cold model is loaded on request."""
+    bus, registry, scheduler, worker, client = await _stack(_tiny_factory)
+    try:
+        r = await client.post("/v1/chat/completions", json={
+            "model": "tiny-qwen2", "max_tokens": 4, "temperature": 0,
+            "messages": [{"role": "user", "content": "hi"}]})
+        body = await r.json()
+        assert r.status == 200, body
+        assert body["choices"][0]["message"]["role"] == "assistant", body
+        assert "tiny-qwen2" in worker.engines  # loaded on demand
+    finally:
+        await _teardown(registry, scheduler, worker, client, bus)
