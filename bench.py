@@ -104,6 +104,7 @@ async def run_bench(model: str, n_requests: int, n_tokens: int,
     assert warm.status == 200, await warm.text()
 
     ttfts: list[float] = []
+    itls: list[float] = []  # per-stream mean inter-token latency
     tokens_out = [0]
 
     if profile_dir:
@@ -116,7 +117,7 @@ async def run_bench(model: str, n_requests: int, n_tokens: int,
 
     async def one(i: int) -> None:
         t0 = time.perf_counter()
-        first = True
+        t_first = t_last = None
         async with client.post("/ollama/api/generate", json={
             "model": model, "prompt": f"[{i}] {prompt}",
             "options": {"temperature": 0.7, "seed": i, "num_predict": n_tokens},
@@ -125,12 +126,21 @@ async def run_bench(model: str, n_requests: int, n_tokens: int,
             async for line in resp.content:
                 if not line.strip():
                     continue
-                if first:
-                    ttfts.append(time.perf_counter() - t0)
-                    first = False
+                now = time.perf_counter()
+                if t_first is None:
+                    t_first = now
+                    ttfts.append(now - t0)
+                t_last = now
                 frame = json.loads(line)
                 if frame.get("done"):
-                    tokens_out[0] += frame.get("eval_count") or 0
+                    n = frame.get("eval_count") or 0
+                    tokens_out[0] += n
+                    if n > 1 and t_first is not None:
+                        # streaming smoothness: a healthy pipeline spreads
+                        # tokens across the window; a burst-at-the-end
+                        # pathology (r03's 13 s TTFT) shows up as itl ≈ 0
+                        # with huge ttft
+                        itls.append((t_last - t_first) / (n - 1) * 1000)
 
     t_start = time.perf_counter()
     try:
@@ -151,6 +161,7 @@ async def run_bench(model: str, n_requests: int, n_tokens: int,
     return {
         "tok_s": tokens_out[0] / wall,
         "p50_ttft_ms": statistics.median(ttfts) * 1000,
+        "p50_itl_ms": statistics.median(itls) if itls else None,
         "tokens": tokens_out[0],
         "wall_s": wall,
         "weights": "real-checkpoint" if ckpt else "random-weights synthetic",
@@ -354,6 +365,8 @@ def main() -> int:
     }
     if not args.embed:
         payload["p50_ttft_ms"] = round(r["p50_ttft_ms"], 1)
+        if r.get("p50_itl_ms") is not None:
+            payload["p50_itl_ms"] = round(r["p50_itl_ms"], 1)
         payload["tokens"] = r["tokens"]
     else:
         payload["texts"] = r["texts"]

@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import asyncio
 import json
+import time
 import uuid
 from typing import Callable
 
@@ -22,8 +23,15 @@ class ModelAdmin:
         self.default_timeout_s = default_timeout_ms / 1000.0
         # in-flight load-on-demand broadcasts, coalesced per model: N
         # concurrent requests for a cold model must not fire N cluster
-        # broadcasts + N propagation polls
-        self._load_futs: dict[str, asyncio.Future] = {}
+        # broadcasts + N propagation polls. DETACHED tasks, not futures
+        # tied to a requesting handler: a leader client disconnecting must
+        # not cancel a load other requests (on either surface) wait on.
+        self._load_tasks: dict[str, asyncio.Task] = {}
+        # short negative cache: a model the cluster just failed to load is
+        # not re-broadcast for every retry (typo storms would otherwise
+        # queue behind real loads on the workers' serialized admin lock)
+        self._fail_at: dict[str, float] = {}
+        self.fail_ttl_s = 30.0
 
     def servable_now(self, model: str) -> bool:
         """Alias-aware registry check: workers resolve the ':latest' tag
@@ -70,20 +78,34 @@ class ModelAdmin:
                 await on_result(rec)
 
         sub = await bus.subscribe(f"admin:result:{rid}", handler)
-        await asyncio.sleep(0.05)  # pub/sub delivery is async (broker)
-        await bus.publish("worker:admin",
-                          json.dumps({"op": op, "id": rid, **payload}))
         try:
-            await asyncio.wait_for(done.wait(), min(5.0, timeout_s))
-        except asyncio.TimeoutError:
-            if acks or results:
-                try:
-                    await asyncio.wait_for(done.wait(),
-                                           max(timeout_s - 5.0, 0.0))
-                except asyncio.TimeoutError:
-                    pass
-        await sub.unsubscribe()
+            await asyncio.sleep(0.05)  # pub/sub delivery is async (broker)
+            await bus.publish("worker:admin",
+                              json.dumps({"op": op, "id": rid, **payload}))
+            try:
+                await asyncio.wait_for(done.wait(), min(5.0, timeout_s))
+            except asyncio.TimeoutError:
+                if acks or results:
+                    try:
+                        await asyncio.wait_for(done.wait(),
+                                               max(timeout_s - 5.0, 0.0))
+                    except asyncio.TimeoutError:
+                        pass
+        finally:
+            # also on cancellation (client disconnect mid-load): the
+            # admin:result subscription must never outlive the broadcast
+            await sub.unsubscribe()
         return results
+
+    async def _load(self, model: str) -> bool:
+        results = await self.broadcast(
+            "load_model", {"model": model}, self.default_timeout_s)
+        if any(r.get("ok") for r in results):
+            for _ in range(100):  # registration propagation
+                if self.servable_now(model):
+                    return True
+                await asyncio.sleep(0.1)
+        return self.servable_now(model)
 
     async def ensure_servable(self, model: str) -> bool:
         """Ollama load-on-demand: if no worker serves `model`, ask the
@@ -94,27 +116,28 @@ class ModelAdmin:
             return True
         if not self.registry.get_online_workers():
             return False
-        fut = self._load_futs.get(model)
-        if fut is None:
-            fut = asyncio.get_running_loop().create_future()
-            self._load_futs[model] = fut
-            try:
-                results = await self.broadcast(
-                    "load_model", {"model": model}, self.default_timeout_s)
-                if any(r.get("ok") for r in results):
-                    for _ in range(100):  # registration propagation
-                        if self.servable_now(model):
-                            break
-                        await asyncio.sleep(0.1)
-                fut.set_result(None)
-            except BaseException as e:
-                fut.set_exception(e)
-                raise
-            finally:
-                self._load_futs.pop(model, None)
-        else:
-            await asyncio.shield(fut)
-        return self.servable_now(model)
+        last_fail = self._fail_at.get(model)
+        if last_fail is not None:
+            if time.monotonic() - last_fail < self.fail_ttl_s:
+                return False
+            self._fail_at.pop(model, None)
+        task = self._load_tasks.get(model)
+        if task is None:
+            task = asyncio.create_task(self._load(model))
+            self._load_tasks[model] = task
+            task.add_done_callback(
+                lambda t, m=model: self._load_tasks.pop(m, None))
+        try:
+            # shield: a waiter's cancellation (client disconnect) must not
+            # cancel the shared load, nor poison the other waiters
+            ok = await asyncio.shield(task)
+        except asyncio.CancelledError:
+            raise  # THIS request was cancelled; the load continues
+        except Exception:
+            ok = False
+        if not ok:
+            self._fail_at[model] = time.monotonic()
+        return ok
 
 
 def get_admin(registry: WorkerRegistry, admin: "ModelAdmin | None",
