@@ -655,8 +655,14 @@ class InferenceEngine:
             # group down — there is no cheap reconciliation for that.)
             self._dispatch_prefill(slot, ids, row_list, upd, images=images)
             if self.plan_sink is not None:
-                rec = {"op": "admit", "slot": slot, "ids": ids,
-                       "row": row_list, "sp": upd}
+                # SNAPSHOT the ids: the list is also _Slot.ids, which
+                # _ingest APPENDS generated tokens to — a by-reference
+                # record serialized after the first ingest would make
+                # followers prefill phantom tokens and silently desync
+                # the slice (caught by the vision replay test comparing
+                # follower state against the liaison's actual pool)
+                rec = {"op": "admit", "slot": slot, "ids": list(ids),
+                       "row": list(row_list), "sp": dict(upd)}
                 if images:
                     # raw base64 payload: followers re-run the
                     # deterministic preprocessing + encode themselves

@@ -32,6 +32,30 @@ class ModelAdmin:
         # queue behind real loads on the workers' serialized admin lock)
         self._fail_at: dict[str, float] = {}
         self.fail_ttl_s = 30.0
+        # advertised residency: model → wall-clock expiry of its last
+        # request's keep_alive window (None = keep forever). /api/ps
+        # reports it; the opt-in sweeper (enforce_keep_alive) REALLY
+        # unloads when it passes — Ollama's idle-unload behavior.
+        self.model_expiry: dict[str, float | None] = {}
+        self._sweeper: asyncio.Task | None = None
+        # set by app.py: () -> set of model names with jobs in flight —
+        # the sweeper must never unload under an active request (the
+        # keep_alive clock measures IDLE time, and gateway handlers
+        # re-touch expiry at completion; this probe is the belt to that
+        # suspender for queued/retrying jobs the gateway can't see)
+        self.active_models = None
+
+    @staticmethod
+    def canonical(model: str) -> str:
+        """The ':latest' alias normalized away — expiry/busy bookkeeping
+        must use ONE name per model, like the workers' _resolve_name."""
+        return model[: -len(":latest")] if model.endswith(":latest") else model
+
+    def touch_keep_alive(self, model: str, seconds: float | None) -> None:
+        """Restart the idle window: None = keep forever."""
+        self.model_expiry[self.canonical(model)] = (
+            None if seconds is None else time.time() + seconds
+        )
 
     def servable_now(self, model: str) -> bool:
         """Alias-aware registry check: workers resolve the ':latest' tag
@@ -138,6 +162,55 @@ class ModelAdmin:
         if not ok:
             self._fail_at[model] = time.monotonic()
         return ok
+
+
+    # -------------------------------------------- keep_alive enforcement
+
+    def start_keep_alive_sweeper(self, interval_s: float = 10.0) -> None:
+        """Opt-in Ollama idle-unload (gateway.enforce_keep_alive): when a
+        model's keep_alive window passes with no new requests, broadcast a
+        real unload. The next request auto-loads it back."""
+        if self._sweeper is None:
+            self._sweeper = asyncio.create_task(
+                self._sweep_loop(interval_s))
+
+    async def stop_keep_alive_sweeper(self) -> None:
+        if self._sweeper is not None:
+            self._sweeper.cancel()
+            try:
+                await self._sweeper
+            except asyncio.CancelledError:
+                pass
+            self._sweeper = None
+
+    async def _sweep_loop(self, interval_s: float) -> None:
+        while True:
+            await asyncio.sleep(interval_s)
+            now = time.time()
+            busy = set()
+            if self.active_models is not None:
+                try:
+                    busy = {self.canonical(m) for m in self.active_models()}
+                except Exception:  # noqa: BLE001
+                    busy = set()
+            for model, exp in list(self.model_expiry.items()):
+                if exp is None or now < exp or self.canonical(model) in busy:
+                    continue
+                try:
+                    # if_idle: the WORKER declines when any request is in
+                    # flight or queued on the engine — closes the window
+                    # between this gateway-side busy check and the unload
+                    # landing (a sweep must never abort work; an explicit
+                    # /api/delete still force-unloads)
+                    results = await self.broadcast(
+                        "unload_model", {"model": model, "if_idle": True},
+                        30.0)
+                except Exception:  # noqa: BLE001 — sweep must keep running
+                    continue
+                if any(r.get("ok") for r in results):
+                    self.model_expiry.pop(model, None)
+                # declined/failed: keep the expiry so /api/ps stays honest
+                # and the next sweep retries
 
 
 def get_admin(registry: WorkerRegistry, admin: "ModelAdmin | None",

@@ -51,6 +51,19 @@ def create_app(bus: MessageBus, registry: WorkerRegistry, scheduler: JobSchedule
     from gridllm_tpu.gateway.admin import ModelAdmin
 
     admin = ModelAdmin(registry, timeout_ms)
+    admin.active_models = lambda: {
+        a.request.model for a in scheduler.get_active_jobs()
+    } | {a.request.model for a in scheduler.job_queue}
+    if config.gateway.enforce_keep_alive:
+        # Ollama-exact idle residency (opt-in; see GatewayConfig)
+        async def _start_sweeper(_app):
+            admin.start_keep_alive_sweeper()
+
+        async def _stop_sweeper(_app):
+            await admin.stop_keep_alive_sweeper()
+
+        app.on_startup.append(_start_sweeper)
+        app.on_cleanup.append(_stop_sweeper)
     ollama = ollama_routes.build_routes(registry, scheduler, version,
                                         timeout_ms, admin=admin)
     app.add_routes([web.RouteDef(r.method, f"/ollama{r.path}", r.handler, r.kwargs)

@@ -115,11 +115,10 @@ def build_routes(registry: WorkerRegistry, scheduler: JobScheduler,
     # auto-loads it back (_require_servable) — full Ollama residency
     # semantics. Workers without management (multi-host slices) decline
     # unloads and stay resident.
-    model_expiry: dict[str, float | None] = {}
+    model_expiry = madmin.model_expiry
 
     def _touch_keep_alive(model: str, keep_alive: Any) -> None:
-        sec = _parse_keep_alive(keep_alive)
-        model_expiry[model] = None if sec is None else time.time() + sec
+        madmin.touch_keep_alive(model, _parse_keep_alive(keep_alive))
 
     async def _require_servable(body: dict) -> str:
         """Ollama load-on-demand (gateway/admin.py): load the model on
@@ -154,7 +153,9 @@ def build_routes(registry: WorkerRegistry, scheduler: JobScheduler,
                     "done": True, "done_reason": "unload"}
             else:
                 # load/warmup semantics: an empty prompt loads the model
+                # and its keep_alive sets the residency window
                 model = await _require_servable(body)
+                _touch_keep_alive(model, body.get("keep_alive"))
                 payload = {
                     "model": model, "created_at": iso_now(), "response": "",
                     "done": True}
@@ -190,6 +191,10 @@ def build_routes(registry: WorkerRegistry, scheduler: JobScheduler,
 
         if not stream:
             result = await submit(req, scheduler)
+            # keep_alive measures IDLE time: restart the window when the
+            # request COMPLETES (the submit-time touch alone would let the
+            # sweeper expire a model mid-generation)
+            _touch_keep_alive(model, body.get("keep_alive"))
             return web.json_response(
                 to_ollama_generate(response_dict(result), model))
 
@@ -201,6 +206,7 @@ def build_routes(registry: WorkerRegistry, scheduler: JobScheduler,
 
         async def run() -> None:
             result = await scheduler.submit_streaming_job(req, on_chunk)
+            _touch_keep_alive(model, body.get("keep_alive"))  # idle clock
             if result.success:
                 await write_ndjson(resp, to_ollama_generate(response_dict(result), model))
             else:
@@ -243,6 +249,7 @@ def build_routes(registry: WorkerRegistry, scheduler: JobScheduler,
 
         if not stream:
             result = await submit(req, scheduler)
+            _touch_keep_alive(model, body.get("keep_alive"))  # idle clock
             return web.json_response(to_ollama_chat(response_dict(result), model))
 
         resp = await start_ndjson(request)
@@ -255,6 +262,7 @@ def build_routes(registry: WorkerRegistry, scheduler: JobScheduler,
 
         async def run() -> None:
             result = await scheduler.submit_streaming_job(req, on_chunk)
+            _touch_keep_alive(model, body.get("keep_alive"))  # idle clock
             if result.success:
                 await write_ndjson(resp, to_ollama_chat(response_dict(result), model))
             else:
@@ -351,8 +359,9 @@ def build_routes(registry: WorkerRegistry, scheduler: JobScheduler,
         seen: dict[str, dict] = {}
         for worker in registry.get_online_workers():
             for m in worker.capabilities.availableModels:
-                if m.name in model_expiry:
-                    exp = model_expiry[m.name]
+                mkey = madmin.canonical(m.name)
+                if mkey in model_expiry:
+                    exp = model_expiry[mkey]
                     expires = (
                         "never" if exp is None else
                         time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime(exp))
@@ -454,7 +463,7 @@ def build_routes(registry: WorkerRegistry, scheduler: JobScheduler,
         model = _mgmt_model(body)
         results = await _admin_broadcast("unload_model", {"model": model}, 30.0)
         if any(r.get("ok") for r in results):
-            model_expiry.pop(model, None)
+            model_expiry.pop(madmin.canonical(model), None)
             return web.json_response({})  # Ollama: 200 empty on success
         raise ApiError(f"Model '{model}' not found", 404, "MODEL_NOT_FOUND")
 

@@ -115,6 +115,18 @@ def build_routes(registry: WorkerRegistry, scheduler: JobScheduler,
                 404, "invalid_request_error", "model_not_found")
         return model
 
+    async def _submit_touch(req, scheduler_, model, **kw):
+        result = await submit(req, scheduler_, **kw)
+        _touch(model)
+        return result
+
+    def _touch(model: str) -> None:
+        # the OpenAI API has no keep_alive knob; Ollama applies its 5m
+        # default per request — requests here must restart the idle clock
+        # too or the cross-surface keep_alive sweeper would unload a model
+        # that only /v1 clients are using
+        madmin.touch_keep_alive(model, 300.0)
+
     # ---------------- /v1/chat/completions ----------------
     async def chat_completions(request: web.Request) -> web.StreamResponse:
         body = await request.json()
@@ -149,7 +161,8 @@ def build_routes(registry: WorkerRegistry, scheduler: JobScheduler,
                 model=model, stream=stream)
 
         if not stream:
-            result = await submit(req, scheduler, timeout_code="server_error",
+            result = await _submit_touch(req, scheduler, model,
+                                         timeout_code="server_error",
                       failure_code="server_error", error_cls=OpenAIApiError)
             return web.json_response(
                 to_openai_chat(response_dict(result), model, req.id))
@@ -181,6 +194,7 @@ def build_routes(registry: WorkerRegistry, scheduler: JobScheduler,
 
         async def run() -> None:
             result = await scheduler.submit_streaming_job(req, on_chunk)
+            _touch(model)
             if not result.success:
                 await on_error(result.error or "Inference failed")
                 return
@@ -236,7 +250,8 @@ def build_routes(registry: WorkerRegistry, scheduler: JobScheduler,
         log.job("openai completions submitted", req.id, model=model, stream=stream)
 
         if not stream:
-            result = await submit(req, scheduler, timeout_code="server_error",
+            result = await _submit_touch(req, scheduler, model,
+                                         timeout_code="server_error",
                       failure_code="server_error", error_cls=OpenAIApiError)
             return web.json_response(to_openai_completion(
                 response_dict(result), model, req.id, prompt, echo))
@@ -261,6 +276,7 @@ def build_routes(registry: WorkerRegistry, scheduler: JobScheduler,
 
         async def run() -> None:
             result = await scheduler.submit_streaming_job(req, on_chunk)
+            _touch(model)
             if not result.success:
                 await on_error(result.error or "Inference failed")
                 return

@@ -78,6 +78,12 @@ class GatewayConfig(BaseModel):
     rate_limit_max_requests: int = 100
     rate_limit_enabled: bool = True
     default_request_timeout_ms: int = 300_000
+    # Ollama-exact idle residency: unload a model when its keep_alive
+    # window passes with no requests (Ollama defaults to 5m). OFF by
+    # default — a TPU reload of a 70B checkpoint costs minutes, so the
+    # default here keeps weights resident and honors keep_alive only as
+    # the advertised /api/ps expiry. GRIDLLM_ENFORCE_KEEP_ALIVE=1 opts in.
+    enforce_keep_alive: bool = False
 
 
 class EngineConfig(BaseModel):
@@ -149,6 +155,7 @@ def load_config() -> Config:
                 rate_limit_window_ms=_env("RATE_LIMIT_WINDOW_MS", 900_000),
                 rate_limit_max_requests=_env("RATE_LIMIT_MAX_REQUESTS", 100),
                 rate_limit_enabled=_env("RATE_LIMIT_ENABLED", True),
+                enforce_keep_alive=_env("GRIDLLM_ENFORCE_KEEP_ALIVE", False),
             ),
             worker=WorkerConfig(
                 worker_id=_env("WORKER_ID", f"worker-{uuid.uuid4().hex[:12]}"),

@@ -212,7 +212,9 @@ class WorkerService(EventEmitter):
                     if op == "load_model":
                         ok, detail = await self._admin_load(msg["model"])
                     elif op == "unload_model":
-                        ok, detail = await self._admin_unload(msg["model"])
+                        ok, detail = await self._admin_unload(
+                            msg["model"], if_idle=bool(msg.get("if_idle"))
+                        )
                     elif op == "copy_model":
                         ok, detail = await self._admin_copy(
                             msg["source"], msg["destination"]
@@ -245,10 +247,23 @@ class WorkerService(EventEmitter):
         log.info("model loaded on demand", model=model, weights=src)
         return True, f"loaded ({src})"
 
-    async def _admin_unload(self, model: str) -> tuple[bool, str]:
+    async def _admin_unload(self, model: str,
+                            if_idle: bool = False) -> tuple[bool, str]:
         name = self._resolve_name(model)
         if name is None:
             return False, "not loaded here"
+        if if_idle:
+            # keep_alive sweeps must never abort work: the worker is the
+            # ground truth for business — a request admitted in the
+            # gateway's check-to-unload window is visible HERE (engine
+            # slots/pending, or a job executing in this service)
+            eng = self.engines[name]
+            busy = self.current_jobs > 0 or (
+                not eng.embedding_only
+                and (bool(eng._slots) or bool(eng._pending))
+            )
+            if busy:
+                return False, "busy (if_idle unload declined)"
         eng = self.engines.pop(name)
         # copies alias the same engine under other names; only stop the
         # runner when the last name referencing it is gone. Abort first:

@@ -242,8 +242,10 @@ def test_context_roundtrip_requires_images(twin):
 def test_plan_replay_reproduces_vision_admission(twin):
     """Multi-host followers replay admit records; a vision admit carries
     the raw base64 payload and the follower must re-run preprocessing +
-    encode + splice to land in the SAME device state as the liaison
-    (deterministic pixel pipeline — engine/images.py)."""
+    encode + splice to land in the SAME device state as the LIAISON.
+    Compared against the liaison's actual pool (prompt rows are written
+    once at prefill and never touched by later decode steps), and against
+    a no-image replay to prove the image actually changed the K/V."""
     import base64
     import io
 
@@ -272,19 +274,27 @@ def test_plan_replay_reproduces_vision_admission(twin):
     assert res.done_reason in ("stop", "length")
     admits = [r for r in records if r["op"] == "admit"]
     assert admits and admits[0].get("images") == [b64]
+    rec = admits[0]
+    n_prompt = len(rec["ids"])
+    ps = kw["page_size"]
+    pages = [p for p in rec["row"] if p >= 0][: -(-n_prompt // ps)]
 
-    # follower replays the admit: its cache must match the liaison's
-    # post-prefill pool for the slot's pages (the prefill wrote the
-    # spliced image embeddings' K/V)
-    follower.apply_plan_op(admits[0])
-    slot = admits[0]["slot"]
-    row = [p for p in admits[0]["row"] if p >= 0]
-    got = np.asarray(follower.cache.k)[:, row]
-    want_cache_holder = InferenceEngine(EngineConfig(**kw))
-    # liaison's pool has advanced past prefill (decode steps); re-derive
-    # the reference by replaying on a THIRD engine and comparing pools —
-    # identical replay must be bit-identical
-    want_cache_holder.apply_plan_op(admits[0])
-    want = np.asarray(want_cache_holder.cache.k)[:, row]
-    np.testing.assert_array_equal(got, want)
-    assert int(np.asarray(follower.cache.lengths)[slot]) > 0
+    def prompt_rows(eng):
+        # positions [0, n_prompt) of the slot, gathered from its pages
+        pool = np.asarray(eng.cache.k)  # [L, P, ps, KVH, D]
+        rows = np.concatenate([pool[:, p] for p in pages], axis=1)
+        return rows[:, :n_prompt]
+
+    want = prompt_rows(liaison)  # decode wrote positions >= n_prompt only
+
+    follower.apply_plan_op(rec)
+    np.testing.assert_array_equal(prompt_rows(follower), want)
+    assert int(np.asarray(follower.cache.lengths)[rec["slot"]]) == n_prompt
+
+    # and the image must MATTER: replaying with the pixels dropped gives
+    # different K/V (guards against a replay path that skips the splice)
+    textonly = InferenceEngine(EngineConfig(**kw))
+    rec_no_img = dict(rec)
+    rec_no_img.pop("images")
+    textonly.apply_plan_op(rec_no_img)
+    assert not np.array_equal(prompt_rows(textonly), want)

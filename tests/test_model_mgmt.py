@@ -172,3 +172,46 @@ async def test_openai_surface_loads_on_demand():
         assert "tiny-qwen2" in worker.engines  # loaded on demand
     finally:
         await _teardown(registry, scheduler, worker, client, bus)
+
+
+async def test_enforce_keep_alive_sweeps_idle_models():
+    """Opt-in Ollama idle residency: when a model's keep_alive window
+    passes without requests, the sweeper REALLY unloads it (and the next
+    request can auto-load it back)."""
+    import time as _time
+
+    from gridllm_tpu.gateway.admin import ModelAdmin
+
+    bus = InMemoryBus()
+    await bus.connect()
+    sched_cfg = fast_config()
+    registry = WorkerRegistry(bus, sched_cfg)
+    await registry.initialize()
+    worker = WorkerService(
+        bus, {"tiny-llama": _tiny_factory("tiny-llama")},
+        WorkerConfig(heartbeat_interval_ms=150,
+                     resource_monitor_interval_ms=500),
+        stream_flush_ms=5, engine_factory=_tiny_factory,
+    )
+    await worker.start()
+    await asyncio.sleep(0.05)
+
+    admin = ModelAdmin(registry, 30_000)
+    admin.model_expiry["tiny-llama"] = _time.time() + 0.2  # expires soon
+    admin.start_keep_alive_sweeper(interval_s=0.1)
+    try:
+        for _ in range(100):
+            if "tiny-llama" not in worker.engines:
+                break
+            await asyncio.sleep(0.1)
+        assert "tiny-llama" not in worker.engines  # really unloaded
+        assert "tiny-llama" not in admin.model_expiry
+
+        # next request path can bring it back (load-on-demand)
+        assert await admin.ensure_servable("tiny-llama")
+        assert "tiny-llama" in worker.engines
+    finally:
+        await admin.stop_keep_alive_sweeper()
+        await worker.stop()
+        await registry.shutdown()
+        await bus.disconnect()
