@@ -120,8 +120,16 @@ class GridBusBroker:
         path = self._aof_path
         assert path is not None
         n = 0
-        if os.path.exists(path):
-            with open(path) as f:
+        src = path
+        if not os.path.exists(path) and os.path.exists(path + ".bak"):
+            # A crash in a previous compaction's window between snapshotting
+            # the log to .bak and publishing the compacted replacement can
+            # leave no file at `path`. The .bak holds the full pre-compaction
+            # state — replay it rather than silently starting empty.
+            log.warning("aof: missing, recovering from .bak", path=path)
+            src = path + ".bak"
+        if os.path.exists(src):
+            with open(src) as f:
                 lines = [ln.strip() for ln in f if ln.strip()]
             records = []
             bad_at = None
@@ -139,25 +147,28 @@ class GridBusBroker:
                 # silently destroy every good record after it. Refuse.
                 raise RuntimeError(
                     f"aof: corrupt record {bad_at + 1}/{len(lines)} in "
-                    f"{path} (not a torn tail) — refusing to start; "
-                    "repair or remove the file"
+                    f"{src} (not a torn tail) — refusing to start; "
+                    "repair or remove the file (remove its .bak too, or "
+                    "startup will recover the pre-compaction state from it)"
                 )
             if bad_at is not None:
-                log.warning("aof: dropping torn final record", path=path)
+                log.warning("aof: dropping torn final record", path=src)
             for rec in records:
                 try:
                     self._apply(rec)
                     n += 1
                 except KeyError:
                     raise RuntimeError(
-                        f"aof: malformed record in {path} — refusing to "
-                        "start; repair or remove the file"
+                        f"aof: malformed record in {src} — refusing to "
+                        "start; repair or remove the file (remove its .bak "
+                        "too, or startup will recover state from it)"
                     ) from None
-            # the original survives as .bak until the NEXT successful
-            # compaction — the snapshot rewrite below must never be the
-            # only copy of state it was derived from
-            os.replace(path, path + ".bak")
-        # compact: current state as a fresh log (atomic replace)
+        # Compact: current state as a fresh log. Ordering matters for crash
+        # safety — the compacted snapshot is fully written + fsync'd BEFORE
+        # the original is touched, so some replayable file exists at every
+        # instant: a crash before the .bak rename leaves `path` intact; a
+        # crash between the two renames leaves .bak (recovered above); the
+        # final os.replace is atomic.
         tmp = path + ".compact"
         with open(tmp, "w") as f:
             for k, v in list(self._kv.items()):  # _expired() pops from _kv
@@ -175,6 +186,11 @@ class GridBusBroker:
                         separators=(",", ":")) + "\n")
             f.flush()
             os.fsync(f.fileno())
+        if src == path and os.path.exists(path):
+            # the pre-compaction log survives as .bak until the NEXT
+            # successful compaction — the snapshot rewrite must never be
+            # the only copy of the state it was derived from
+            os.replace(path, path + ".bak")
         os.replace(tmp, path)
         self._aof = open(path, "a")
         log.info("aof: replayed and compacted", path=path, records=n,

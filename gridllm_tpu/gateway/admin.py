@@ -14,9 +14,16 @@ import uuid
 from typing import Callable
 
 from gridllm_tpu.scheduler import WorkerRegistry
+from gridllm_tpu.utils.logging import get_logger
+
+log = get_logger("gateway.admin")
 
 
 class ModelAdmin:
+    # retry spacing for models whose every sweep reply said "model
+    # management disabled" (multi-host groups can't unload)
+    SWEEP_BACKOFF_S = 300.0
+
     def __init__(self, registry: WorkerRegistry,
                  default_timeout_ms: int = 300_000) -> None:
         self.registry = registry
@@ -209,8 +216,23 @@ class ModelAdmin:
                     continue
                 if any(r.get("ok") for r in results):
                     self.model_expiry.pop(model, None)
-                # declined/failed: keep the expiry so /api/ps stays honest
-                # and the next sweep retries
+                elif results and all(
+                    "model management disabled" in str(r.get("detail", ""))
+                    for r in results
+                ):
+                    # Every REPLYING worker is a multi-host group member
+                    # (admin ops permanently disabled) — back the retry off
+                    # instead of re-broadcasting cluster-wide every sweep.
+                    # Backoff, not permanent disable: the result set can be
+                    # partial (a single-host worker offline or past the
+                    # timeout), so the "non-evictable" conclusion must stay
+                    # revisitable. /api/ps keeps reporting it resident.
+                    log.info("keep_alive: only non-evictable (multi-host "
+                             "group) replies for model, backing off",
+                             model=model, backoff_s=self.SWEEP_BACKOFF_S)
+                    self.model_expiry[model] = now + self.SWEEP_BACKOFF_S
+                # otherwise declined/failed: keep the expiry so /api/ps
+                # stays honest and the next sweep retries
 
 
 def get_admin(registry: WorkerRegistry, admin: "ModelAdmin | None",

@@ -16,8 +16,10 @@ its layer body instead of parameterizing llama's:
   pre-scaling q with sqrt(d / qpas) so the shared attention ops keep
   their 1/sqrt(d) convention;
 - sliding-window attention on EVEN layers (HF: layer_idx % 2 == 0),
-  threaded through the scan as a per-layer window scalar
-  (ops/attention.py jnp paths; kernel variants are future work);
+  threaded through the scan as a per-layer window scalar — handled by
+  both the ops/attention.py jnp paths and the Pallas kernels (softcap +
+  window as traced per-layer scalars, tests/test_pallas.py); dispatch
+  follows cfg.use_pallas;
 - final logits tanh-softcapped (final_logit_softcapping).
 
 Weight layout contract: HF Gemma2ForCausalLM (tied embeddings; the four

@@ -90,6 +90,21 @@ def _human_params(n: int) -> str:
     return f"{n / 1e6:.0f}M"
 
 
+def total_slots(engines: dict) -> int:
+    """Total concurrent slots across UNIQUE engines — /api/copy aliases
+    the same engine under a second name, and counting it per name would
+    over-advertise capacity (the scheduler would over-assign; jobs queue
+    inside the engine instead of being NACKed to other workers). Single
+    source of truth for both the worker's admission gate
+    (worker/service.py) and the advertised maxConcurrentTasks here."""
+    uniq = {id(e): e for e in engines.values()}
+    return max(
+        sum(getattr(getattr(e, "config", None), "max_slots", 1)
+            for e in uniq.values()),
+        1,
+    )
+
+
 def gather_capabilities(
     worker_id: str,
     engines: dict[str, object],
@@ -99,11 +114,10 @@ def gather_capabilities(
     if performance_tier is None:
         performance_tier = "high" if topo.platform == "tpu" else "medium"
     models, layouts = [], []
-    max_slots = 0
+    max_slots = total_slots(engines)
     for name, eng in engines.items():
         c = getattr(eng, "config", None)
         mc = getattr(eng, "cfg", None)
-        max_slots += getattr(c, "max_slots", 1)
         details = None
         if mc is not None:
             family = getattr(mc, "family", "unknown")

@@ -36,7 +36,7 @@ from gridllm_tpu.utils.types import (
     WorkerInfo,
     iso_now,
 )
-from gridllm_tpu.worker.capabilities import gather_capabilities
+from gridllm_tpu.worker.capabilities import gather_capabilities, total_slots
 from gridllm_tpu.worker.chat import collect_images
 from gridllm_tpu.worker.prompting import (
     build_generate_prompt,
@@ -50,13 +50,8 @@ from gridllm_tpu.worker.prompting import (
 log = get_logger("worker")
 
 
-def _capacity(engines: dict) -> int:
-    """Total concurrent slots across UNIQUE engines — /api/copy aliases
-    the same engine under a second name, and counting it per name would
-    over-advertise capacity (jobs queueing inside the engine instead of
-    being NACKed to other workers)."""
-    uniq = {id(e): e for e in engines.values()}
-    return max(sum(e.config.max_slots for e in uniq.values()), 1)
+# single source of truth shared with the advertised maxConcurrentTasks
+_capacity = total_slots
 
 
 class NonRetryableJobError(RuntimeError):

@@ -233,3 +233,36 @@ async def test_aof_keeps_bak_of_previous_log(tmp_path):
     await broker2.start("127.0.0.1", 0)
     await broker2.stop()
     assert os.path.exists(aof + ".bak")
+
+
+async def test_aof_recovers_from_bak_when_log_missing(tmp_path):
+    """Crash window in compaction: if the process dies after the log was
+    renamed to .bak but before the compacted snapshot landed at the log
+    path, the next start must replay .bak — NOT silently begin empty."""
+    import os
+
+    aof = str(tmp_path / "bus.aof")
+    broker = GridBusBroker(aof_path=aof)
+    await broker.start("127.0.0.1", 0)
+    bus = RespBus(host="127.0.0.1", port=broker.port, key_prefix="T:")
+    await bus.connect()
+    await bus.set("x", "survives")
+    await bus.hset("h", "f", "v")
+    await bus.disconnect()
+    await broker.stop()
+
+    # simulate the crash window: log renamed aside, snapshot never landed
+    os.replace(aof, aof + ".bak")
+    assert not os.path.exists(aof)
+
+    broker2 = GridBusBroker(aof_path=aof)
+    await broker2.start("127.0.0.1", 0)
+    bus2 = RespBus(host="127.0.0.1", port=broker2.port, key_prefix="T:")
+    await bus2.connect()
+    try:
+        assert await bus2.get("x") == "survives"
+        assert await bus2.hgetall("h") == {"f": "v"}
+    finally:
+        await bus2.disconnect()
+        await broker2.stop()
+    assert os.path.exists(aof)  # compaction re-published the log
