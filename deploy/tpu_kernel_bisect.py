@@ -1,0 +1,123 @@
+"""Run each Pallas kernel standalone to bisect TPU hangs/crashes.
+
+Why this exists (round-4 war story): interpret-mode tests complete DMA
+copies synchronously, so a class of semaphore/DMA bugs only manifests on
+real hardware — and a crashed kernel can wedge the axon TPU tunnel for
+hours (every later backend init hangs). First hardware contact must
+therefore be one kernel per throwaway process, with a health probe
+between, so a single bad kernel is identified by name and cannot take
+the whole round down. Orchestrated by deploy/tpu_kernel_bisect.sh.
+
+Usage: python deploy/tpu_kernel_bisect.py [flash|streamed|decode|
+       decode64|wdecode|wchunk|chunkatt|all]
+
+Shapes mirror the headline bench (3B-class: H=24, KVH=8, D=128) plus the
+d=64 qwen2.5-class variant.
+"""
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+def log(msg):
+    print(f"[{time.strftime('%H:%M:%S')}] {msg}", flush=True)
+
+
+log(f"devices: {jax.devices()}")
+
+from gridllm_tpu.ops import pallas_kernels as pk  # noqa: E402
+
+which = sys.argv[1] if len(sys.argv) > 1 else "all"
+
+# 3B-ish shapes: H=24, KVH=8, D=128, T=1024
+B, T, H, KVH, D = 1, 1024, 24, 8, 128
+S, PS, NP, MPS = 8, 64, 384, 48
+L = 28
+
+key = jax.random.PRNGKey(0)
+
+
+def _qkv(d):
+    q = jax.random.normal(key, (B, T, H, d), jnp.bfloat16)
+    k = jax.random.normal(key, (B, T, KVH, d), jnp.bfloat16)
+    v = jax.random.normal(key, (B, T, KVH, d), jnp.bfloat16)
+    return q, k, v
+
+
+if which in ("all", "flash"):
+    log("flash_prefill...")
+    q, k, v = _qkv(D)
+    out = pk.flash_prefill(q, k, v, jnp.array([600], jnp.int32))
+    jax.block_until_ready(out)
+    log(f"flash_prefill OK {out.shape} {float(jnp.abs(out).mean()):.4f}")
+
+if which in ("all", "streamed"):
+    log("flash_prefill_streamed...")
+    q, k, v = _qkv(D)
+    out = pk.flash_prefill_streamed(q, k, v, jnp.array([600], jnp.int32))
+    jax.block_until_ready(out)
+    log(f"flash_prefill_streamed OK {out.shape} "
+        f"{float(jnp.abs(out).mean()):.4f}")
+
+if which in ("all", "decode"):
+    log("paged_decode (full-stack pool + layer + k_cur)...")
+    kp = jax.random.normal(key, (L, NP, PS, KVH, D), jnp.bfloat16)
+    vp = jax.random.normal(key, (L, NP, PS, KVH, D), jnp.bfloat16)
+    pt = jnp.tile(jnp.arange(MPS, dtype=jnp.int32)[None], (S, 1))
+    lens = jnp.full((S,), 600, jnp.int32)
+    q = jax.random.normal(key, (S, H, D), jnp.bfloat16)
+    kc = jax.random.normal(key, (S, KVH, D), jnp.bfloat16)
+    vc = jax.random.normal(key, (S, KVH, D), jnp.bfloat16)
+    out = pk.paged_decode(q, kp, vp, pt, lens, PS, k_cur=kc, v_cur=vc,
+                          layer=jnp.int32(3))
+    jax.block_until_ready(out)
+    # the round-4 wedge case: an INACTIVE slot (len 0) must not corrupt
+    # the DMA handshake (pallas_kernels.py merge_cur n_eff guard)
+    lens0 = lens.at[3].set(0)
+    out = pk.paged_decode(q, kp, vp, pt, lens0, PS, k_cur=kc, v_cur=vc,
+                          layer=jnp.int32(3))
+    jax.block_until_ready(out)
+    log(f"paged_decode OK {out.shape} {float(jnp.abs(out).mean()):.4f}")
+
+if which in ("all", "decode64"):
+    log("paged_decode d=64 (qwen2.5-class)...")
+    d64 = 64
+    kp = jax.random.normal(key, (L, NP, PS, KVH, d64), jnp.bfloat16)
+    vp = jax.random.normal(key, (L, NP, PS, KVH, d64), jnp.bfloat16)
+    pt = jnp.tile(jnp.arange(MPS, dtype=jnp.int32)[None], (S, 1))
+    lens = jnp.full((S,), 600, jnp.int32)
+    q = jax.random.normal(key, (S, H, d64), jnp.bfloat16)
+    kc = jax.random.normal(key, (S, KVH, d64), jnp.bfloat16)
+    vc = jax.random.normal(key, (S, KVH, d64), jnp.bfloat16)
+    out = pk.paged_decode(q, kp, vp, pt, lens, PS, k_cur=kc, v_cur=vc,
+                          layer=jnp.int32(3))
+    jax.block_until_ready(out)
+    log(f"paged_decode d=64 OK {out.shape} {float(jnp.abs(out).mean()):.4f}")
+
+if which in ("all", "wdecode"):
+    log("paged_write_decode...")
+    kp = jnp.zeros((L, NP, PS, KVH, D), jnp.bfloat16)
+    vp = jnp.zeros((L, NP, PS, KVH, D), jnp.bfloat16)
+    lens = jnp.full((S,), 600, jnp.int32)
+    kn = jax.random.normal(key, (L, S, KVH, D), jnp.bfloat16)
+    vn = jax.random.normal(key, (L, S, KVH, D), jnp.bfloat16)
+    page_idx = jnp.arange(S, dtype=jnp.int32)
+    o1, o2 = pk.paged_write_decode(kp, vp, kn, vn, page_idx, lens % PS)
+    jax.block_until_ready((o1, o2))
+    log(f"paged_write_decode OK {o1.shape} {float(jnp.abs(o1).mean()):.6f}")
+
+if which in ("all", "wchunk"):
+    log("paged_write_chunk...")
+    kp = jnp.zeros((L, NP, PS, KVH, D), jnp.bfloat16)
+    vp = jnp.zeros((L, NP, PS, KVH, D), jnp.bfloat16)
+    row = jnp.arange(MPS, dtype=jnp.int32)
+    kn = jax.random.normal(key, (L, T, KVH, D), jnp.bfloat16)
+    vn = jax.random.normal(key, (L, T, KVH, D), jnp.bfloat16)
+    o1, o2 = pk.paged_write_chunk(kp, vp, kn, vn, row, jnp.int32(0),
+                                  jnp.int32(600), PS)
+    jax.block_until_ready((o1, o2))
+    log(f"paged_write_chunk OK {o1.shape} {float(jnp.abs(o1).mean()):.6f}")
+
+log("ALL DONE")

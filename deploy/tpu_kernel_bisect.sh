@@ -1,0 +1,71 @@
+#!/bin/bash
+# First-hardware-contact harness for the Pallas kernels.
+#
+# A device-side kernel crash (bad DMA/semaphore state) can wedge a
+# remote-TPU tunnel so badly that every later backend init hangs —
+# round 4 lost its whole benchmarking window to exactly that. So the
+# first thing to touch real hardware each round is THIS script, never
+# the full bench:
+#   1. cheap health probe (matmul) — is the device reachable at all?
+#   2. each Pallas kernel in its own throwaway subprocess (bounded by
+#      `timeout`), with a fresh health probe after each — a kernel that
+#      crashes or wedges is identified BY NAME and the script stops
+#      before the next one compounds the damage;
+#   3. only if every kernel passes: optionally run the bench
+#      (--then-bench), the expensive step that is now safe to attempt.
+#
+# Usage: deploy/tpu_kernel_bisect.sh [--then-bench] [logdir]
+# Exit codes: 0 all kernels healthy; 2 device unreachable; 3 a kernel
+# failed or wedged the tunnel (see $logdir/bisect_<kernel>.log).
+set -u
+cd "$(dirname "$0")/.."
+
+THEN_BENCH=0
+[[ "${1:-}" == "--then-bench" ]] && { THEN_BENCH=1; shift; }
+LOGDIR="${1:-/tmp/tpu_bisect}"
+mkdir -p "$LOGDIR"
+
+PY=${PYTHON:-python}
+PROBE_TIMEOUT=${PROBE_TIMEOUT:-120}
+KERNEL_TIMEOUT=${KERNEL_TIMEOUT:-420}
+
+say() { echo "[$(date -u +%H:%M:%S)] $*" | tee -a "$LOGDIR/bisect.log"; }
+
+probe() {
+  timeout "$PROBE_TIMEOUT" "$PY" -c "
+import jax, jax.numpy as jnp
+x = jnp.ones((256, 256), jnp.bfloat16)
+print('HEALTH-OK', float((x @ x).sum()), jax.devices())
+" 2>&1 | tail -1
+}
+
+h=$(probe)
+say "initial probe: $h"
+if [[ "$h" != HEALTH-OK* ]]; then
+  say "device unreachable — not attempting kernels"
+  exit 2
+fi
+
+# NOTE: add `decode64` to the list once the d=64 decode-kernel path lands
+# (ops/attention.py requires head_dim % 128 == 0 on hardware today).
+for k in flash streamed wdecode wchunk decode; do
+  say "kernel $k ..."
+  timeout "$KERNEL_TIMEOUT" "$PY" deploy/tpu_kernel_bisect.py "$k" \
+    > "$LOGDIR/bisect_$k.log" 2>&1
+  rc=$?
+  say "kernel $k rc=$rc ($(tail -1 "$LOGDIR/bisect_$k.log" | head -c 120))"
+  h=$(probe)
+  say "post-$k health: $h"
+  if [[ $rc -ne 0 || "$h" != HEALTH-OK* ]]; then
+    say "kernel $k FAILED or wedged the tunnel — stopping bisect"
+    exit 3
+  fi
+done
+say "all kernels healthy"
+
+if [[ $THEN_BENCH -eq 1 ]]; then
+  say "running bench ..."
+  timeout 2400 "$PY" bench.py > "$LOGDIR/bench.json" 2> "$LOGDIR/bench.err"
+  say "bench rc=$? -> $LOGDIR/bench.json"
+  tail -1 "$LOGDIR/bench.json"
+fi

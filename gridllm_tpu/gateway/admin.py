@@ -216,17 +216,28 @@ class ModelAdmin:
                     continue
                 if any(r.get("ok") for r in results):
                     self.model_expiry.pop(model, None)
-                elif results and all(
-                    "model management disabled" in str(r.get("detail", ""))
-                    for r in results
+                elif (
+                    results
+                    and any("model management disabled"
+                            in str(r.get("detail", "")) for r in results)
+                    and all(
+                        "model management disabled" in str(r.get("detail", ""))
+                        or "not loaded" in str(r.get("detail", ""))
+                        for r in results
+                    )
+                    # don't clobber a keep_alive touch (possibly None =
+                    # keep forever) that landed during the 30s broadcast
+                    and self.model_expiry.get(model) == exp
                 ):
-                    # Every REPLYING worker is a multi-host group member
-                    # (admin ops permanently disabled) — back the retry off
-                    # instead of re-broadcasting cluster-wide every sweep.
-                    # Backoff, not permanent disable: the result set can be
-                    # partial (a single-host worker offline or past the
-                    # timeout), so the "non-evictable" conclusion must stay
-                    # revisitable. /api/ps keeps reporting it resident.
+                    # Every REPLYING worker that HOLDS the model is a
+                    # multi-host group member (admin ops permanently
+                    # disabled; workers without the model answer "not
+                    # loaded here") — back the retry off instead of
+                    # re-broadcasting cluster-wide every sweep. Backoff,
+                    # not permanent disable: the result set can be partial
+                    # (a single-host worker offline or past the timeout),
+                    # so the conclusion stays revisitable. /api/ps keeps
+                    # reporting it resident.
                     log.info("keep_alive: only non-evictable (multi-host "
                              "group) replies for model, backing off",
                              model=model, backoff_s=self.SWEEP_BACKOFF_S)
