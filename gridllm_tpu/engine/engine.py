@@ -225,10 +225,13 @@ class InferenceEngine:
         # family-specific mesh constraints fail HERE (engine startup), not
         # at the first request's trace (e.g. gemma2 has no sp variant)
         getattr(self.mod, "validate_mesh", lambda *_: None)(self.cfg, self.mesh)
-        if self.mesh is not None:
-            # pallas_call has no GSPMD partitioning rule; under a mesh the
-            # jnp attention path shards correctly. Per-engine (on the cfg
-            # copy) so co-hosted single-device engines keep their kernels.
+        if self.mesh is not None and self.mesh.shape.get("pp", 1) > 1:
+            # tp/dp/ep/sp meshes run the kernels inside a full-manual
+            # shard_map at the kernel boundary (ops.kvcache.kernel_mesh_axis
+            # — kv-heads split over tp, VERDICT r04 #2). The pipeline's
+            # partial-manual pp region is the remaining exception: it pins
+            # the jnp paths. Per-engine (on the cfg copy) so co-hosted
+            # single-device engines keep their kernels.
             self.cfg = dataclasses.replace(self.cfg, use_pallas=False)
         self._rng = random.Random(config.seed)
         self._lock = threading.Lock()
@@ -369,7 +372,7 @@ class InferenceEngine:
         # jit-compiled (one program per (batch-bucket, len-bucket) pair)
         self._embed_fn = jax.jit(
             lambda params, tokens, lens: self.mod.hidden_states(
-                params, mc, tokens, seq_lens=lens
+                params, mc, tokens, seq_lens=lens, mesh=self.mesh
             )
         )
         if self.embedding_only:

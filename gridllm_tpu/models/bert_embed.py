@@ -63,6 +63,7 @@ def hidden_states(
     cfg: ModelConfig,
     tokens: jnp.ndarray,
     seq_lens: jnp.ndarray | None = None,
+    mesh=None,  # family-API uniformity; jnp attention is GSPMD-safe
 ) -> jnp.ndarray:
     """tokens [B, T] → final hidden states [B, T, E]. Bidirectional
     attention; key positions >= seq_lens are masked (padding must not leak

@@ -200,6 +200,7 @@ def hidden_states(
     seq_lens: jnp.ndarray | None = None,
     attn=None,
     embeds: jnp.ndarray | None = None,
+    mesh=None,
 ) -> jnp.ndarray:
     del mlp, attn
     b, t = tokens.shape
@@ -211,7 +212,7 @@ def hidden_states(
     def attn_fn(q, k, v, win, li):
         return attention_prefill(
             q, k, v, seq_lens, use_pallas=cfg.use_pallas,
-            logit_softcap=cfg.attn_logit_softcap, window=win,
+            logit_softcap=cfg.attn_logit_softcap, window=win, mesh=mesh,
         ).reshape(b, t, -1)
 
     x, _, _ = _scan_layers(params, cfg, x, pos, attn_fn)
@@ -257,7 +258,7 @@ def prefill(
     def attn_fn(q, k, v, win, li):
         return attention_prefill(
             q, k, v, seq_lens, use_pallas=cfg.use_pallas,
-            logit_softcap=cfg.attn_logit_softcap, window=win,
+            logit_softcap=cfg.attn_logit_softcap, window=win, mesh=mesh,
         ).reshape(1, t, -1)
 
     x, k_ys, v_ys = _scan_layers(params, cfg, x, pos, attn_fn)
@@ -267,7 +268,7 @@ def prefill(
 
     k_pool, v_pool = write_prefill_all(
         cache.k, cache.v, k_new, v_new, table_row, jnp.int32(0), length,
-        cache.page_size, use_pallas=cfg.use_pallas,
+        cache.page_size, use_pallas=cfg.use_pallas, mesh=mesh,
     )
     return logits, PagedKVCache(
         k=k_pool, v=v_pool,
@@ -302,7 +303,7 @@ def prefill_chunk(
         return attention_prefix_chunk(
             q, cache.k, cache.v, table_row, start, total, cache.page_size,
             k_cur=k[0], v_cur=v[0], layer=li, use_pallas=cfg.use_pallas,
-            logit_softcap=cfg.attn_logit_softcap, window=win,
+            logit_softcap=cfg.attn_logit_softcap, window=win, mesh=mesh,
         ).reshape(1, t, -1)
 
     x, k_ys, v_ys = _scan_layers(params, cfg, x, pos, attn_fn)
@@ -312,7 +313,7 @@ def prefill_chunk(
 
     k_pool, v_pool = write_prefill_all(
         cache.k, cache.v, k_new, v_new, table_row, start, length,
-        cache.page_size, use_pallas=cfg.use_pallas,
+        cache.page_size, use_pallas=cfg.use_pallas, mesh=mesh,
     )
     return logits, PagedKVCache(
         k=k_pool, v=v_pool,
@@ -347,7 +348,7 @@ def decode_step(
             q[:, 0], cache.k, cache.v, cache.page_table, positions,
             cache.page_size, k_cur=k[:, 0], v_cur=v[:, 0], layer=li,
             use_pallas=cfg.use_pallas,
-            logit_softcap=cfg.attn_logit_softcap, window=win,
+            logit_softcap=cfg.attn_logit_softcap, window=win, mesh=mesh,
         ).reshape(s, 1, -1)
 
     x, k_ys, v_ys = _scan_layers(
@@ -359,7 +360,7 @@ def decode_step(
 
     k_pool, v_pool = write_decode_all(
         cache.k, cache.v, k_new, v_new, cache.page_table, positions, active,
-        cache.page_size, use_pallas=cfg.use_pallas,
+        cache.page_size, use_pallas=cfg.use_pallas, mesh=mesh,
     )
     return logits, PagedKVCache(
         k=k_pool, v=v_pool, page_table=cache.page_table,

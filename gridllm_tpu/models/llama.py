@@ -53,11 +53,11 @@ AttnFn = Callable[
 ]
 
 
-def _default_attn(cfg: ModelConfig) -> AttnFn:
+def _default_attn(cfg: ModelConfig, mesh=None) -> AttnFn:
     def attn(q, k, v, seq_lens):
         return attention_prefill(
             q, k, v, seq_lens, use_pallas=cfg.use_pallas,
-            window=cfg.sliding_window,
+            window=cfg.sliding_window, mesh=mesh,
         )
 
     return attn
@@ -178,13 +178,14 @@ def hidden_states(
     seq_lens: jnp.ndarray | None = None,
     attn: AttnFn | None = None,
     embeds: jnp.ndarray | None = None,
+    mesh=None,
 ) -> jnp.ndarray:
     """Final-norm hidden states [B, T, E] (embeddings path; no unembed).
     seq_lens masks padding keys out of attention (None → all valid).
     `embeds` ([B, T, E]) overrides the embedding lookup (vision splice)."""
     _check_supported(cfg)
     if attn is None:
-        attn = _default_attn(cfg)
+        attn = _default_attn(cfg, mesh)
     b, t = tokens.shape
     inv_freq = precompute_rope(cfg.head_dim_, cfg.rope_theta, cfg.rope_scaling)
     x = params["embed"][tokens] if embeds is None else embeds.astype(
@@ -300,7 +301,7 @@ def prefill(
     """
     _check_supported(cfg)
     if attn is None:
-        attn = _default_attn(cfg)
+        attn = _default_attn(cfg, mesh)
     seq_c = _seq_constraint(mesh)
     t = tokens.shape[0]
     x = params["embed"][tokens] if embeds is None else embeds
@@ -316,6 +317,7 @@ def prefill(
     k_pool, v_pool = write_prefill_all(
         cache.k, cache.v, k_new, v_new, table_row,
         jnp.int32(0), length, cache.page_size, use_pallas=cfg.use_pallas,
+        mesh=mesh,
     )
     cache = PagedKVCache(
         k=k_pool, v=v_pool,
@@ -354,7 +356,7 @@ def prefill_chunk(
     x = x.astype(params["embed"].dtype)[None]  # [1, C, E]
     x, k_new, v_new = prefill_chunk_layers(
         params["layers"], cfg, x, cache.k, cache.v, table_row, start,
-        length, cache.page_size, mlp,
+        length, cache.page_size, mlp, mesh=mesh,
     )
     x = rms_norm(x, params["final_norm"], cfg.rms_eps)
     last = x[0, jnp.maximum(length - 1, 0)]
@@ -362,7 +364,7 @@ def prefill_chunk(
 
     k_pool, v_pool = write_prefill_all(
         cache.k, cache.v, k_new, v_new, table_row, start, length,
-        cache.page_size, use_pallas=cfg.use_pallas,
+        cache.page_size, use_pallas=cfg.use_pallas, mesh=mesh,
     )
     cache = PagedKVCache(
         k=k_pool, v=v_pool,
@@ -384,11 +386,14 @@ def prefill_chunk_layers(
     length: jnp.ndarray,
     page_size: int,
     mlp: MlpFn = _mlp,
+    mesh=None,
 ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Chunked-prefill layer scan over an arbitrary stacked block of
     layers against the slot's cached prefix (full stack from
     `prefill_chunk`; per-stage blocks from parallel/pipeline.py).
-    x: [1, C, E] in; returns (x out, k_new [N, C, KVH, D], v_new)."""
+    x: [1, C, E] in; returns (x out, k_new [N, C, KVH, D], v_new).
+    `mesh` is threaded to attention_prefix_chunk for when its kernel
+    variant lands (jnp path today — GSPMD-safe either way)."""
     t = x.shape[1]
     inv_freq = precompute_rope(cfg.head_dim_, cfg.rope_theta, cfg.rope_scaling)
     pos = (start + jnp.arange(t, dtype=jnp.int32))[None]
@@ -407,7 +412,7 @@ def prefill_chunk_layers(
         att = attention_prefix_chunk(
             q, k_pool, v_pool, table_row, start, total, page_size,
             k_cur=k[0], v_cur=v[0], layer=li, use_pallas=cfg.use_pallas,
-            window=cfg.sliding_window,
+            window=cfg.sliding_window, mesh=mesh,
         ).reshape(1, t, -1)
         x = x + qdot(att, lp["wo"], precision=_precision(x))
         hx = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
@@ -429,6 +434,7 @@ def decode_layers(
     positions: jnp.ndarray,
     page_size: int,
     mlp: MlpFn = _mlp,
+    mesh=None,
 ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """The decode layer scan over an arbitrary stacked block of layers.
 
@@ -458,6 +464,7 @@ def decode_layers(
             q, k_pool, v_pool, page_table, positions,
             page_size, k_cur=k, v_cur=v, layer=li,
             use_pallas=cfg.use_pallas, window=cfg.sliding_window,
+            mesh=mesh,
         ).reshape(s, -1)
         x = x + qdot(attn, lp["wo"], precision=_precision(x))
         hx = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
@@ -476,7 +483,7 @@ def decode_step(
     cache: PagedKVCache,
     active: jnp.ndarray,
     mlp: MlpFn = _mlp,
-    mesh=None,  # accepted for family-API uniformity (MoE uses it)
+    mesh=None,  # meshed-kernel dispatch (ops) + MoE EP routing
 ) -> tuple[jnp.ndarray, PagedKVCache]:
     """One decode step for ALL slots. tokens: [S] (last sampled token per
     slot), active: [S] bool. Returns (logits [S, V] fp32, updated cache
@@ -495,14 +502,14 @@ def decode_step(
 
     x, k_new, v_new = decode_layers(
         params["layers"], cfg, x, cache.k, cache.v, cache.page_table,
-        positions, cache.page_size, mlp,
+        positions, cache.page_size, mlp, mesh=mesh,
     )
     x = rms_norm(x, params["final_norm"], cfg.rms_eps)
     logits = _unembed(cfg, params, x)
 
     k_pool, v_pool = write_decode_all(
         cache.k, cache.v, k_new, v_new, cache.page_table, positions, active,
-        cache.page_size, use_pallas=cfg.use_pallas,
+        cache.page_size, use_pallas=cfg.use_pallas, mesh=mesh,
     )
     cache = PagedKVCache(
         k=k_pool, v=v_pool, page_table=cache.page_table,

@@ -250,7 +250,8 @@ def hidden_states(
     mesh=None,
 ) -> jnp.ndarray:
     return llama.hidden_states(
-        params, cfg, tokens, mlp=_mlp_for(cfg, mesh), seq_lens=seq_lens
+        params, cfg, tokens, mlp=_mlp_for(cfg, mesh), seq_lens=seq_lens,
+        mesh=mesh,
     )
 
 
@@ -291,7 +292,7 @@ def prefill_chunk(
 ) -> tuple[jnp.ndarray, PagedKVCache]:
     return llama.prefill_chunk(
         params, cfg, tokens, start, length, cache, slot, table_row,
-        mlp=_mlp_for(cfg, mesh), embeds=embeds,
+        mlp=_mlp_for(cfg, mesh), mesh=mesh, embeds=embeds,
     )
 
 
@@ -304,7 +305,8 @@ def decode_step(
     mesh=None,
 ) -> tuple[jnp.ndarray, PagedKVCache]:
     return llama.decode_step(
-        params, cfg, tokens, cache, active, mlp=_mlp_for(cfg, mesh)
+        params, cfg, tokens, cache, active, mlp=_mlp_for(cfg, mesh),
+        mesh=mesh,
     )
 
 

@@ -18,10 +18,18 @@ materialized repeat).
 
 from __future__ import annotations
 
+from functools import partial
+
 import jax
 import jax.numpy as jnp
 
-from gridllm_tpu.ops.kvcache import _env_mode, _pallas_mode, gather_kv
+from gridllm_tpu.ops.kvcache import (
+    _env_mode,
+    _pallas_mode,
+    _shard_map_kernel,
+    gather_kv,
+    kernel_mesh_axis,
+)
 
 __all__ = [
     "attention_prefill", "paged_attention_decode", "attention_prefix_chunk",
@@ -39,6 +47,30 @@ _NEG_INF = -1e30
 _FLASH_KV_VMEM_CAP = 8 * 1024 * 1024
 
 
+def _prefill_kernel(q, k, v, seq_lens, window, *, interpret, softcap):
+    """The kernel leg of attention_prefill: d-padding + VMEM routing.
+    Shapes may be shard-local (called from inside the meshed shard_map)."""
+    from gridllm_tpu.ops import pallas_kernels
+
+    t, d = q.shape[1], q.shape[3]
+    dp = -(-d // 128) * 128  # also in interpret mode, so tests cover it
+    if dp != d:
+        pad = [(0, 0)] * (q.ndim - 1) + [(0, dp - d)]
+        # correct the kernel's rsqrt(dp) scale back to rsqrt(d)
+        q = jnp.pad(q * jnp.sqrt(jnp.float32(dp) / d).astype(q.dtype), pad)
+        k = jnp.pad(k, pad)
+        v = jnp.pad(v, pad)
+    kv_bytes = 2 * t * dp * q.dtype.itemsize
+    fn = (
+        pallas_kernels.flash_prefill
+        if kv_bytes <= _FLASH_KV_VMEM_CAP
+        else pallas_kernels.flash_prefill_streamed
+    )
+    out = fn(q, k, v, seq_lens, interpret=interpret, softcap=softcap,
+             window=window)
+    return out[..., :d] if dp != d else out
+
+
 def attention_prefill(
     q: jnp.ndarray,
     k: jnp.ndarray,
@@ -47,6 +79,7 @@ def attention_prefill(
     use_pallas: bool | None = None,
     logit_softcap: float = 0.0,
     window: jnp.ndarray | int = 0,
+    mesh=None,
 ) -> jnp.ndarray:
     """Causal GQA prefill attention (see attention_prefill_ref for the
     contract). Kernel routing (VERDICT r03 weak #6 / next-round #9):
@@ -64,6 +97,11 @@ def attention_prefill(
     (sliding-window attention; 0 = full; may be a traced per-layer scalar)
     are handled INSIDE the kernels — windowed buckets also skip the key
     blocks below each q block's window.
+
+    Under `mesh` (VERDICT r04 #2) the kernel runs inside a full-manual
+    shard_map with heads split over tp — attention is embarrassingly
+    parallel over kv-head groups, so each shard runs the kernel on its
+    head slice with no collectives (ops/kvcache.py kernel_mesh_axis).
     """
     use, interpret = _pallas_mode(use_pallas)
     t, d = q.shape[1], q.shape[3]
@@ -71,24 +109,32 @@ def attention_prefill(
         return attention_prefill_ref(
             q, k, v, seq_lens, logit_softcap=logit_softcap, window=window
         )
-    from gridllm_tpu.ops import pallas_kernels
-
-    dp = -(-d // 128) * 128  # also in interpret mode, so tests cover it
-    if dp != d:
-        pad = [(0, 0)] * (q.ndim - 1) + [(0, dp - d)]
-        # correct the kernel's rsqrt(dp) scale back to rsqrt(d)
-        q = jnp.pad(q * jnp.sqrt(jnp.float32(dp) / d).astype(q.dtype), pad)
-        k = jnp.pad(k, pad)
-        v = jnp.pad(v, pad)
-    kv_bytes = 2 * t * dp * q.dtype.itemsize
-    fn = (
-        pallas_kernels.flash_prefill
-        if kv_bytes <= _FLASH_KV_VMEM_CAP
-        else pallas_kernels.flash_prefill_streamed
+    mode, ax = kernel_mesh_axis(mesh, k.shape[2], q.shape[2])
+    if mode == "ref":
+        return attention_prefill_ref(
+            q, k, v, seq_lens, logit_softcap=logit_softcap, window=window
+        )
+    kernel = partial(
+        _prefill_kernel, interpret=interpret, softcap=float(logit_softcap)
     )
-    out = fn(q, k, v, seq_lens, interpret=interpret,
-             softcap=float(logit_softcap), window=window)
-    return out[..., :d] if dp != d else out
+    if mode == "direct":
+        return kernel(q, k, v, seq_lens, window)
+    from jax.sharding import PartitionSpec as P
+
+    # a static-int window (0 = full attention for most families) must stay
+    # static so the kernels specialize it away; only traced per-layer
+    # scalars (gemma2) travel as shard_map operands
+    hs = P(None, None, ax, None)
+    if isinstance(window, (int, float)):
+        sm = _shard_map_kernel(
+            mesh, partial(kernel, window=window),
+            in_specs=(hs, hs, hs, P(None)), out_specs=hs,
+        )
+        return sm(q, k, v, seq_lens)
+    sm = _shard_map_kernel(
+        mesh, kernel, in_specs=(hs, hs, hs, P(None), P()), out_specs=hs,
+    )
+    return sm(q, k, v, seq_lens, window)
 
 
 def paged_attention_decode(
@@ -104,6 +150,7 @@ def paged_attention_decode(
     use_pallas: bool | None = None,
     logit_softcap: float = 0.0,
     window: jnp.ndarray | int = 0,
+    mesh=None,
 ) -> jnp.ndarray:
     """Paged decode attention (see paged_attention_decode_ref for the
     contract). With k_cur/v_cur ([S, KVH, D]), `lengths` counts the
@@ -118,16 +165,56 @@ def paged_attention_decode(
     to the jnp gather path; packing two heads per lane tile is future
     kernel work). `logit_softcap` (static) and `window` (may be traced,
     gemma2 alternates per layer) are handled inside the kernel — windowed
-    decode never DMAs pages below the window."""
+    decode never DMAs pages below the window.
+
+    Under `mesh` (VERDICT r04 #2): full-manual shard_map with heads split
+    over tp — each shard runs the kernel on its kv-head slice of the page
+    pool, no collectives (the wo row-parallel psum that follows stays
+    GSPMD's, outside the wrapper)."""
     use, interpret = _pallas_mode(use_pallas)
-    if use and (interpret or q.shape[-1] % 128 == 0):
+    mode, ax = kernel_mesh_axis(mesh, k_pages.shape[-2], q.shape[1])
+    if use and mode != "ref" and (interpret or q.shape[-1] % 128 == 0):
         from gridllm_tpu.ops import pallas_kernels
 
-        return pallas_kernels.paged_decode(
-            q, k_pages, v_pages, page_table, lengths, page_size,
-            k_cur=k_cur, v_cur=v_cur, layer=layer, interpret=interpret,
-            softcap=float(logit_softcap), window=window,
+        kernel = partial(
+            pallas_kernels.paged_decode, page_size=page_size,
+            interpret=interpret, softcap=float(logit_softcap),
         )
+        if mode == "direct":
+            return kernel(q, k_pages, v_pages, page_table, lengths,
+                          k_cur=k_cur, v_cur=v_cur, layer=layer,
+                          window=window)
+        from jax.sharding import PartitionSpec as P
+
+        pool = P(*((None,) * (k_pages.ndim - 2)), ax, None)
+        hs = P(None, ax, None)
+        # optional/traced operands (k_cur/v_cur, layer, a traced window)
+        # must enter through in_specs — shard_map bodies cannot close over
+        # tracers; a static-int window folds into the body so the kernels
+        # keep specializing window=0 away
+        static_window = isinstance(window, (int, float))
+        opt = {}
+        if k_cur is not None:
+            opt["k_cur"], opt["v_cur"] = (k_cur, hs), (v_cur, hs)
+        if layer is not None:
+            opt["layer"] = (layer, P())
+        if not static_window:
+            opt["window"] = (window, P())
+        names = sorted(opt)
+
+        def sm_body(q, kp, vp, pt, lens, *dyn):
+            kw = dict(zip(names, dyn))
+            if static_window:
+                kw["window"] = window
+            return kernel(q, kp, vp, pt, lens, **kw)
+
+        args = [q, k_pages, v_pages, page_table, lengths]
+        specs = [hs, pool, pool, P(*((None,) * page_table.ndim)), P(None)]
+        args += [opt[n][0] for n in names]
+        specs += [opt[n][1] for n in names]
+        sm = _shard_map_kernel(mesh, sm_body, in_specs=tuple(specs),
+                               out_specs=hs)
+        return sm(*args)
     if k_pages.ndim == 5:  # fallback: materialize the layer slice
         li = jnp.int32(0) if layer is None else layer
         k_pages = jax.lax.dynamic_index_in_dim(k_pages, li, keepdims=False)
@@ -153,6 +240,7 @@ def attention_prefix_chunk(
     use_pallas: bool | None = None,
     logit_softcap: float = 0.0,
     window: jnp.ndarray | int = 0,
+    mesh=None,
 ) -> jnp.ndarray:
     """Chunked-prefill attention: one chunk of queries against the slot's
     FULL cached context (prefix + this chunk), read from the page pool.
@@ -173,7 +261,7 @@ def attention_prefix_chunk(
     (VERDICT.md #4). jnp path only for now: the chunk flash kernel with a
     paged-prefix stream is future kernel work.
     """
-    del use_pallas  # no kernel variant yet — jnp path is mesh/GSPMD-safe
+    del use_pallas, mesh  # no kernel variant yet — jnp is mesh/GSPMD-safe
     _, t, h, d = q.shape
     kvh = k_pages.shape[-2]
     g = h // kvh

@@ -48,15 +48,75 @@ def _env_mode() -> tuple[bool, bool]:
 
 def _pallas_mode(use_pallas: bool | None) -> tuple[bool, bool]:
     """`use_pallas` is the per-call override (threaded from
-    ModelConfig.use_pallas by the model code, e.g. the engine disables
-    kernels for a mesh-sharded engine without affecting single-device
-    engines in the same process — pallas_call has no GSPMD partitioning
-    rule, so inside a sharded jit the kernels would force replication);
-    None defers to the env policy."""
+    ModelConfig.use_pallas by the model code); None defers to the env
+    policy. pallas_call has no GSPMD partitioning rule, so under a mesh
+    the dispatch layers wrap the kernel in a full-manual shard_map
+    (`kernel_mesh_axis` below) instead of letting GSPMD see it."""
     use, interpret = _env_mode()
     if use_pallas is not None:
         use = use_pallas
     return use, interpret
+
+
+def kernel_mesh_axis(mesh, kvh: int, h: int | None = None):
+    """(mode, axis) for running Pallas kernels under `mesh`.
+
+    pallas_call has no GSPMD partitioning rule: inside an auto-partitioned
+    jit it either fails to partition or forces full replication. The fix
+    (VERDICT r04 #2) is a FULL-manual shard_map at the kernel boundary —
+    attention and KV-writes are embarrassingly parallel over kv-heads, so
+    each tp shard runs the existing kernel on its head slice with no
+    collectives. This helper decides the layout:
+
+    - ("direct", None): no mesh — call the kernel directly.
+    - ("wrap", "tp"): kv-heads (and q-heads) divide by the tp axis —
+      shard head dims over "tp", matching parallel/sharding.py's Megatron
+      specs, so the shard_map boundary is a no-op resharding.
+    - ("wrap", None): mesh present but heads don't divide (tiny test
+      configs) — the wrapper still isolates the kernel from GSPMD, with
+      head dims replicated (matches sharding._fit's fallback).
+    - ("ref", None): the wrapper can't express the operands' sharding —
+      pp > 1 shards the pool's layer axis, and a spec that doesn't
+      mention pp would silently all-gather the whole pool. Callers must
+      take their jnp reference path (GSPMD-safe). The pipeline module
+      pins use_pallas=False anyway; this is the belt to that suspender.
+
+    Unmentioned mesh axes (dp/ep/sp) mean "replicated" in a full-manual
+    shard_map — exactly how those axes see attention operands.
+    """
+    if mesh is None:
+        return "direct", None
+    if mesh.shape.get("pp", 1) > 1:
+        return "ref", None
+    tp = mesh.shape.get("tp", 1)
+    if tp > 1 and kvh % tp == 0 and (h is None or h % tp == 0):
+        return "wrap", "tp"
+    return "wrap", None
+
+
+def _shard_map_kernel(mesh, body, in_specs, out_specs):
+    """jax.shard_map for a kernel body: full-manual (all axes), with vma
+    checking off — pallas_call can't annotate how outputs vary across
+    mesh axes, and the bodies here have no collectives to get wrong."""
+    return jax.shard_map(
+        body, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_vma=False,
+    )
+
+
+def _wrap_write_kernel(mesh, ax, kernel, scalar_specs):
+    """Shared meshed wrapper for the two pool-write kernels: pools + new
+    rows split on `ax` over kv-heads, trailing host-computed operands
+    (page_idx/offset or table_row/start/length) per `scalar_specs`."""
+    from jax.sharding import PartitionSpec as P
+
+    pool = P(None, None, None, ax, None)
+    new = P(None, None, ax, None)
+    return _shard_map_kernel(
+        mesh, kernel,
+        in_specs=(pool, pool, new, new, *scalar_specs),
+        out_specs=(pool, pool),
+    )
 
 
 @partial(
@@ -206,13 +266,16 @@ def write_decode_all(
     active: jnp.ndarray,
     page_size: int,
     use_pallas: bool | None = None,
+    mesh=None,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Write one token per slot across ALL layers at once.
 
     k_pages/v_pages: [L, P, ps, KVH, D] (the full pool); k_new/v_new:
     [L, S, KVH, D]. Runs once per decode step at jit top level, where
     donation makes the update truly in place (TPU: DMA kernel; otherwise
-    one batched scatter).
+    one batched scatter). Under `mesh` the kernel runs inside a
+    full-manual shard_map with kv-heads split over tp (writes are
+    shard-local — no collectives; see kernel_mesh_axis).
     """
     s = jnp.arange(page_table.shape[0], dtype=jnp.int32)
     page_idx = _safe_page_idx(
@@ -223,13 +286,17 @@ def write_decode_all(
     use, interpret = _pallas_mode(use_pallas)
     # same Mosaic constraint as the attention kernels: page slices need a
     # 128-lane-aligned minor dim on real TPU; d=64 models take the scatter
-    if use and (interpret or k_pages.shape[-1] % 128 == 0):
+    mode, ax = kernel_mesh_axis(mesh, k_new.shape[2])
+    if use and mode != "ref" and (interpret or k_pages.shape[-1] % 128 == 0):
         from gridllm_tpu.ops.pallas_kernels import paged_write_decode
 
-        return paged_write_decode(
-            k_pages, v_pages, k_new, v_new, page_idx, offset,
-            interpret=interpret,
-        )
+        kernel = partial(paged_write_decode, interpret=interpret)
+        if mode == "wrap":
+            from jax.sharding import PartitionSpec as P
+
+            kernel = _wrap_write_kernel(mesh, ax, kernel,
+                                        (P(None), P(None)))
+        return kernel(k_pages, v_pages, k_new, v_new, page_idx, offset)
     # one scatter over (page, row) applied to every layer: index arrays are
     # adjacent advanced indices after the leading ':' so the result keeps
     # [L, S, KVH, D] — matching k_new's layout
@@ -248,23 +315,32 @@ def write_prefill_all(
     length: jnp.ndarray,
     page_size: int,
     use_pallas: bool | None = None,
+    mesh=None,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Write a prefill chunk for ONE slot across ALL layers at once.
 
     k_pages/v_pages: [L, P, ps, KVH, D]; k_new/v_new: [L, T, KVH, D].
     Kernel path (TPU) requires T % page_size == 0 (static check) and
     page-aligned `start` (engine-guaranteed; see paged_write_chunk).
+    Under `mesh`: full-manual shard_map, kv-heads split over tp.
     """
     use, interpret = _pallas_mode(use_pallas)
-    if use and k_new.shape[1] % page_size == 0 and (
+    mode, ax = kernel_mesh_axis(mesh, k_new.shape[2])
+    if use and mode != "ref" and k_new.shape[1] % page_size == 0 and (
         interpret or k_pages.shape[-1] % 128 == 0
     ):
         from gridllm_tpu.ops.pallas_kernels import paged_write_chunk
 
-        return paged_write_chunk(
-            k_pages, v_pages, k_new, v_new, table_row, start, length,
-            page_size, interpret=interpret,
+        kernel = partial(
+            paged_write_chunk, page_size=page_size, interpret=interpret
         )
+        if mode == "wrap":
+            from jax.sharding import PartitionSpec as P
+
+            kernel = _wrap_write_kernel(mesh, ax, kernel,
+                                        (P(None), P(), P()))
+        return kernel(k_pages, v_pages, k_new, v_new, table_row, start,
+                      length)
     t = jnp.arange(k_new.shape[1], dtype=jnp.int32)
     pos = start + t
     page_idx = _safe_page_idx(

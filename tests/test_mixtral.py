@@ -244,3 +244,40 @@ def test_meshed_moe_selects_ragged_for_prefill(monkeypatch):
         with mesh:
             mixtral._moe_mlp(cfg, mesh, lp, xs)
         assert not spy2.called
+
+
+def test_delegation_threads_mesh_to_llama():
+    """The engine passes mesh=self.mesh to the family module; mixtral's
+    delegation wrappers must forward it to llama or the meshed-kernel
+    dispatch (ops.kvcache.kernel_mesh_axis) silently degrades to bare
+    pallas_call under GSPMD (review finding, round 5)."""
+    from unittest import mock
+
+    from gridllm_tpu.parallel.mesh import MeshConfig, build_mesh
+
+    cfg = get_config("tiny-mixtral")
+    mesh = build_mesh(MeshConfig(tp=2, dp=4))
+    seen = {}
+
+    def spy_decode(params, c, tokens, cache, active, mlp=None, mesh=None):
+        seen["decode"] = mesh
+        raise RuntimeError("stop")
+
+    def spy_chunk(params, c, tokens, start, length, cache, slot, row,
+                  mlp=None, mesh=None, embeds=None):
+        seen["chunk"] = mesh
+        raise RuntimeError("stop")
+
+    with mock.patch.object(mixtral.llama, "decode_step", spy_decode):
+        try:
+            mixtral.decode_step(None, cfg, None, None, None, mesh=mesh)
+        except RuntimeError:
+            pass
+    with mock.patch.object(mixtral.llama, "prefill_chunk", spy_chunk):
+        try:
+            mixtral.prefill_chunk(None, cfg, None, None, None, None, None,
+                                  None, mesh=mesh)
+        except RuntimeError:
+            pass
+    assert seen["decode"] is mesh
+    assert seen["chunk"] is mesh
