@@ -82,19 +82,40 @@ if which in ("all", "decode"):
     log(f"paged_decode OK {out.shape} {float(jnp.abs(out).mean()):.4f}")
 
 if which in ("all", "decode64"):
-    log("paged_decode d=64 (qwen2.5-class)...")
-    d64 = 64
-    kp = jax.random.normal(key, (L, NP, PS, KVH, d64), jnp.bfloat16)
-    vp = jax.random.normal(key, (L, NP, PS, KVH, d64), jnp.bfloat16)
+    # the d=64 serving path: pool allocated lane-padded to 128 (engine
+    # _pool_head_dim), q/k_cur/v_cur padded + out sliced by the dispatch
+    log("paged decode d=64 via lane-padded pool (qwen2.5-class)...")
+    from gridllm_tpu.ops.attention import paged_attention_decode
+
+    d64, dpool = 64, 128
+    kp = jax.random.normal(key, (L, NP, PS, KVH, dpool), jnp.bfloat16)
+    vp = jax.random.normal(key, (L, NP, PS, KVH, dpool), jnp.bfloat16)
     pt = jnp.tile(jnp.arange(MPS, dtype=jnp.int32)[None], (S, 1))
     lens = jnp.full((S,), 600, jnp.int32)
     q = jax.random.normal(key, (S, H, d64), jnp.bfloat16)
     kc = jax.random.normal(key, (S, KVH, d64), jnp.bfloat16)
     vc = jax.random.normal(key, (S, KVH, d64), jnp.bfloat16)
-    out = pk.paged_decode(q, kp, vp, pt, lens, PS, k_cur=kc, v_cur=vc,
+    out = paged_attention_decode(q, kp, vp, pt, lens, PS, k_cur=kc,
+                                 v_cur=vc, layer=jnp.int32(3),
+                                 use_pallas=True)
+    jax.block_until_ready(out)
+    assert out.shape[-1] == d64
+    log(f"paged decode d=64 OK {out.shape} {float(jnp.abs(out).mean()):.4f}")
+
+if which in ("all", "chunkatt"):
+    log("prefix_chunk (chunked-prefill attention vs paged prefix)...")
+    C = 1024
+    kp = jax.random.normal(key, (L, NP, PS, KVH, D), jnp.bfloat16)
+    vp = jax.random.normal(key, (L, NP, PS, KVH, D), jnp.bfloat16)
+    row = jnp.arange(MPS, dtype=jnp.int32)
+    q = jax.random.normal(key, (1, C, H, D), jnp.bfloat16)
+    kc = jax.random.normal(key, (C, KVH, D), jnp.bfloat16)
+    vc = jax.random.normal(key, (C, KVH, D), jnp.bfloat16)
+    out = pk.prefix_chunk(q, kp, vp, row, jnp.int32(1024),
+                          jnp.int32(1024 + 900), PS, k_cur=kc, v_cur=vc,
                           layer=jnp.int32(3))
     jax.block_until_ready(out)
-    log(f"paged_decode d=64 OK {out.shape} {float(jnp.abs(out).mean()):.4f}")
+    log(f"prefix_chunk OK {out.shape} {float(jnp.abs(out).mean()):.4f}")
 
 if which in ("all", "wdecode"):
     log("paged_write_decode...")

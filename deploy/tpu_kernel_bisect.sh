@@ -46,9 +46,7 @@ if [[ "$h" != HEALTH-OK* ]]; then
   exit 2
 fi
 
-# NOTE: add `decode64` to the list once the d=64 decode-kernel path lands
-# (ops/attention.py requires head_dim % 128 == 0 on hardware today).
-for k in flash streamed wdecode wchunk decode; do
+for k in flash streamed wdecode wchunk decode decode64 chunkatt; do
   say "kernel $k ..."
   timeout "$KERNEL_TIMEOUT" "$PY" deploy/tpu_kernel_bisect.py "$k" \
     > "$LOGDIR/bisect_$k.log" 2>&1

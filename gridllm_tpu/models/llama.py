@@ -392,8 +392,9 @@ def prefill_chunk_layers(
     layers against the slot's cached prefix (full stack from
     `prefill_chunk`; per-stage blocks from parallel/pipeline.py).
     x: [1, C, E] in; returns (x out, k_new [N, C, KVH, D], v_new).
-    `mesh` is threaded to attention_prefix_chunk for when its kernel
-    variant lands (jnp path today — GSPMD-safe either way)."""
+    Attention dispatches to pallas_kernels.prefix_chunk (paged-prefix
+    streaming flash) when kernels are on — `mesh` threads through for the
+    meshed shard_map wrapper."""
     t = x.shape[1]
     inv_freq = precompute_rope(cfg.head_dim_, cfg.rope_theta, cfg.rope_scaling)
     pos = (start + jnp.arange(t, dtype=jnp.int32))[None]

@@ -47,13 +47,31 @@ _NEG_INF = -1e30
 _FLASH_KV_VMEM_CAP = 8 * 1024 * 1024
 
 
+def _lane_pad_qkv(q, k_cur, v_cur, dpool):
+    """Pad query + current K/V to a lane-padded pool's head dim (engine
+    allocates D=128 pages for d=64 models so qwen2.5-class paths keep the
+    kernels — VERDICT r04 #5). q is pre-scaled so the downstream
+    rsqrt(dpool) equals rsqrt(d); callers slice outputs back to d. Exact:
+    padded k lanes meet zero q lanes in every dot; padded v lanes produce
+    zeros that are sliced away."""
+    d = q.shape[-1]
+    pad = [(0, 0)] * (q.ndim - 1) + [(0, dpool - d)]
+    q = jnp.pad(q * jnp.sqrt(jnp.float32(dpool) / d).astype(q.dtype), pad)
+    if k_cur is not None:
+        cpad = [(0, 0)] * (k_cur.ndim - 1) + [(0, dpool - d)]
+        k_cur = jnp.pad(k_cur, cpad)
+        v_cur = jnp.pad(v_cur, cpad)
+    return q, k_cur, v_cur
+
+
 def _prefill_kernel(q, k, v, seq_lens, window, *, interpret, softcap):
     """The kernel leg of attention_prefill: d-padding + VMEM routing.
     Shapes may be shard-local (called from inside the meshed shard_map)."""
     from gridllm_tpu.ops import pallas_kernels
+    from gridllm_tpu.ops.kvcache import lane_pad_dim
 
     t, d = q.shape[1], q.shape[3]
-    dp = -(-d // 128) * 128  # also in interpret mode, so tests cover it
+    dp = lane_pad_dim(d)  # also in interpret mode, so tests cover it
     if dp != d:
         pad = [(0, 0)] * (q.ndim - 1) + [(0, dp - d)]
         # correct the kernel's rsqrt(dp) scale back to rsqrt(d)
@@ -121,20 +139,13 @@ def attention_prefill(
         return kernel(q, k, v, seq_lens, window)
     from jax.sharding import PartitionSpec as P
 
-    # a static-int window (0 = full attention for most families) must stay
-    # static so the kernels specialize it away; only traced per-layer
-    # scalars (gemma2) travel as shard_map operands
+    # window always travels as a scalar operand — the kernels read it from
+    # SMEM at runtime either way, so there is nothing to specialize
     hs = P(None, None, ax, None)
-    if isinstance(window, (int, float)):
-        sm = _shard_map_kernel(
-            mesh, partial(kernel, window=window),
-            in_specs=(hs, hs, hs, P(None)), out_specs=hs,
-        )
-        return sm(q, k, v, seq_lens)
     sm = _shard_map_kernel(
         mesh, kernel, in_specs=(hs, hs, hs, P(None), P()), out_specs=hs,
     )
-    return sm(q, k, v, seq_lens, window)
+    return sm(q, k, v, seq_lens, jnp.asarray(window, jnp.int32))
 
 
 def paged_attention_decode(
@@ -160,10 +171,11 @@ def paged_attention_decode(
     during decode. Pools may be the FULL [L, P, ps, KVH, D] stack with
     `layer` selecting the layer to read (pass from inside a layer scan so
     no per-layer pool slice is materialized). Routes to the page-streaming
-    kernel when enabled. Mosaic requires 128-lane-aligned page slices, so
-    head_dim must be a multiple of 128 on real TPU (d=64 models fall back
-    to the jnp gather path; packing two heads per lane tile is future
-    kernel work). `logit_softcap` (static) and `window` (may be traced,
+    kernel when enabled. Mosaic requires 128-lane-aligned page slices;
+    d=64 models (qwen2.5 class) keep the kernel path via the engine's
+    lane-padded pool (ops.kvcache.lane_pad_dim) — the dispatch pads
+    q/k_cur/v_cur to the pool's D and slices the output back, exactly.
+    `logit_softcap` (static) and `window` (may be traced,
     gemma2 alternates per layer) are handled inside the kernel — windowed
     decode never DMAs pages below the window.
 
@@ -171,6 +183,15 @@ def paged_attention_decode(
     over tp — each shard runs the kernel on its kv-head slice of the page
     pool, no collectives (the wo row-parallel psum that follows stays
     GSPMD's, outside the wrapper)."""
+    d, dpool = q.shape[-1], k_pages.shape[-1]
+    if dpool != d:
+        q, k_cur, v_cur = _lane_pad_qkv(q, k_cur, v_cur, dpool)
+        out = paged_attention_decode(
+            q, k_pages, v_pages, page_table, lengths, page_size,
+            k_cur=k_cur, v_cur=v_cur, layer=layer, use_pallas=use_pallas,
+            logit_softcap=logit_softcap, window=window, mesh=mesh,
+        )
+        return out[..., :d]
     use, interpret = _pallas_mode(use_pallas)
     mode, ax = kernel_mesh_axis(mesh, k_pages.shape[-2], q.shape[1])
     if use and mode != "ref" and (interpret or q.shape[-1] % 128 == 0):
@@ -188,25 +209,19 @@ def paged_attention_decode(
 
         pool = P(*((None,) * (k_pages.ndim - 2)), ax, None)
         hs = P(None, ax, None)
-        # optional/traced operands (k_cur/v_cur, layer, a traced window)
-        # must enter through in_specs — shard_map bodies cannot close over
-        # tracers; a static-int window folds into the body so the kernels
-        # keep specializing window=0 away
-        static_window = isinstance(window, (int, float))
-        opt = {}
+        # optional/traced operands (k_cur/v_cur, layer, window) must enter
+        # through in_specs — shard_map bodies cannot close over tracers.
+        # window is always an operand: the kernels read it from SMEM at
+        # runtime either way, so there is nothing to specialize.
+        opt = {"window": (jnp.asarray(window, jnp.int32), P())}
         if k_cur is not None:
             opt["k_cur"], opt["v_cur"] = (k_cur, hs), (v_cur, hs)
         if layer is not None:
             opt["layer"] = (layer, P())
-        if not static_window:
-            opt["window"] = (window, P())
         names = sorted(opt)
 
         def sm_body(q, kp, vp, pt, lens, *dyn):
-            kw = dict(zip(names, dyn))
-            if static_window:
-                kw["window"] = window
-            return kernel(q, kp, vp, pt, lens, **kw)
+            return kernel(q, kp, vp, pt, lens, **dict(zip(names, dyn)))
 
         args = [q, k_pages, v_pages, page_table, lengths]
         specs = [hs, pool, pool, P(*((None,) * page_table.ndim)), P(None)]
@@ -258,12 +273,71 @@ def attention_prefix_chunk(
     round 1 ("chunked prefill against an existing cached prefix") — the
     piece that makes prompts longer than the largest bucket run as repeated
     fixed-shape chunk programs instead of per-length recompiles
-    (VERDICT.md #4). jnp path only for now: the chunk flash kernel with a
-    paged-prefix stream is future kernel work.
+    (VERDICT.md #4). Dispatch: pallas_kernels.prefix_chunk (prefix pages
+    streamed from HBM, chunk K/V resident) when the chunk fits the VMEM
+    budget; jnp fallback (dense prefix gather) otherwise — both mesh-aware
+    (full-manual shard_map over tp, like paged_attention_decode).
     """
-    del use_pallas, mesh  # no kernel variant yet — jnp is mesh/GSPMD-safe
+    dq, dpool = q.shape[-1], k_pages.shape[-1]
+    if dpool != dq:
+        q, k_cur, v_cur = _lane_pad_qkv(q, k_cur, v_cur, dpool)
+        out = attention_prefix_chunk(
+            q, k_pages, v_pages, table_row, start, total_len, page_size,
+            k_cur=k_cur, v_cur=v_cur, layer=layer, use_pallas=use_pallas,
+            logit_softcap=logit_softcap, window=window, mesh=mesh,
+        )
+        return out[..., :dq]
     _, t, h, d = q.shape
     kvh = k_pages.shape[-2]
+    use, interpret = _pallas_mode(use_pallas)
+    mode, ax = kernel_mesh_axis(mesh, kvh, h)
+    # kernel path: the chunk flash kernel streams prefix pages from HBM
+    # and keeps the chunk's K/V resident — gated on the chunk's per-layer
+    # K+V fitting the VMEM budget and Mosaic's lane alignment. The budget
+    # is per SHARD: under tp the resident chunk is kvh/tp heads wide.
+    kvh_local = kvh // mesh.shape["tp"] if ax == "tp" else kvh
+    if (
+        use and mode != "ref" and k_cur is not None
+        and (interpret or d % 128 == 0)
+        and t % min(128, t) == 0
+        and 2 * t * kvh_local * d * q.dtype.itemsize <= _FLASH_KV_VMEM_CAP
+    ):
+        from gridllm_tpu.ops import pallas_kernels
+
+        kernel = partial(
+            pallas_kernels.prefix_chunk, page_size=page_size,
+            interpret=interpret, softcap=float(logit_softcap),
+        )
+        if mode == "direct":
+            return kernel(q, k_pages, v_pages, table_row, start, total_len,
+                          k_cur=k_cur, v_cur=v_cur, layer=layer,
+                          window=window)
+        from jax.sharding import PartitionSpec as P
+
+        pool = P(*((None,) * (k_pages.ndim - 2)), ax, None)
+        hs = P(None, None, ax, None)
+        cur = P(None, ax, None)
+        opt = {
+            "start": (start, P()),
+            "total_len": (total_len, P()),
+            "window": (jnp.asarray(window, jnp.int32), P()),
+        }
+        if layer is not None:
+            opt["layer"] = (layer, P())
+        names = sorted(opt)
+
+        def sm_body(q, kp, vp, row, kc, vc, *dyn):
+            kw = dict(zip(names, dyn))
+            return kernel(q, kp, vp, row, kw.pop("start"),
+                          kw.pop("total_len"), k_cur=kc, v_cur=vc, **kw)
+
+        args = [q, k_pages, v_pages, table_row, k_cur, v_cur]
+        specs = [hs, pool, pool, P(None), cur, cur]
+        args += [opt[n][0] for n in names]
+        specs += [opt[n][1] for n in names]
+        sm = _shard_map_kernel(mesh, sm_body, in_specs=tuple(specs),
+                               out_specs=hs)
+        return sm(*args)
     g = h // kvh
     scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32))
 

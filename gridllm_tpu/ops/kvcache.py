@@ -104,6 +104,26 @@ def _shard_map_kernel(mesh, body, in_specs, out_specs):
     )
 
 
+def lane_pad_dim(d: int) -> int:
+    """Head dim rounded up to the 128-lane tile. The engine allocates the
+    page pool at this width when kernels are on (d=64 models: qwen2.5
+    class) so Mosaic's alignment constraint is met and decode/writes keep
+    the kernel path; the attention/write dispatchers pad q/K/V to the
+    pool's width and slice outputs back (exact — see
+    ops.attention.paged_attention_decode). Costs 2x KV memory on d=64
+    models, which are the smallest ones served."""
+    return -(-d // 128) * 128
+
+
+def _pad_new_lanes(k_pages, k_new, v_new):
+    """Zero-pad fresh K/V rows to a lane-padded pool's head dim."""
+    dpool, d = k_pages.shape[-1], k_new.shape[-1]
+    if dpool == d:
+        return k_new, v_new
+    pad = [(0, 0)] * (k_new.ndim - 1) + [(0, dpool - d)]
+    return jnp.pad(k_new, pad), jnp.pad(v_new, pad)
+
+
 def _wrap_write_kernel(mesh, ax, kernel, scalar_specs):
     """Shared meshed wrapper for the two pool-write kernels: pools + new
     rows split on `ax` over kv-heads, trailing host-computed operands
@@ -277,6 +297,7 @@ def write_decode_all(
     full-manual shard_map with kv-heads split over tp (writes are
     shard-local — no collectives; see kernel_mesh_axis).
     """
+    k_new, v_new = _pad_new_lanes(k_pages, k_new, v_new)
     s = jnp.arange(page_table.shape[0], dtype=jnp.int32)
     page_idx = _safe_page_idx(
         lambda p: page_table[s, p], positions, active, page_size,
@@ -324,6 +345,7 @@ def write_prefill_all(
     page-aligned `start` (engine-guaranteed; see paged_write_chunk).
     Under `mesh`: full-manual shard_map, kv-heads split over tp.
     """
+    k_new, v_new = _pad_new_lanes(k_pages, k_new, v_new)
     use, interpret = _pallas_mode(use_pallas)
     mode, ax = kernel_mesh_axis(mesh, k_new.shape[2])
     if use and mode != "ref" and k_new.shape[1] % page_size == 0 and (

@@ -547,6 +547,260 @@ def paged_decode(
 
 
 # ---------------------------------------------------------------------------
+# chunked-prefill attention against the paged prefix
+# ---------------------------------------------------------------------------
+
+
+def _prefix_chunk_kernel(
+    scal_ref,    # SMEM prefetch [4]: [layer, window (0=full), start, total]
+    table_ref,   # SMEM prefetch [maxp]: this slot's page ids
+    q_ref,       # VMEM (BQ, KVH, G, D) — this q block
+    kc_ref,      # VMEM (C, KVH, D) — the WHOLE chunk's K (resident)
+    vc_ref,
+    k_hbm,       # ANY [L, P, ps, KVH, D] — the full page pool
+    v_hbm,
+    o_ref,       # VMEM (BQ, KVH, G, D)
+    k_scr,       # VMEM (2, ps, KVH, D) double buffer (prefix pages)
+    v_scr,
+    sems,        # DMA sems (2, 2)
+    *, ps: int, bq: int, bk: int, kvh: int, g: int, d: int,
+    softcap: float,
+):
+    """Two-phase online softmax per q block: (1) stream the slot's PREFIX
+    pages HBM→VMEM double-buffered (same DMA discipline as
+    _paged_decode_kernel — every conditional start is guarded by the same
+    bound as its wait); (2) the chunk's own K/V blocks from VMEM with
+    causal masking. Positions: q row r of block qi is absolute
+    start + qi*BQ + r; prefix rows are absolute [0, start); chunk K rows
+    are absolute start + [0, C) with rows ≥ total (= start + valid)
+    masked."""
+    qi = pl.program_id(0)
+    window = scal_ref[1]
+    start = scal_ref[2]
+    total = scal_ref[3]
+    scale = jax.lax.rsqrt(jnp.float32(d))
+    q = q_ref[...].astype(jnp.float32) * scale     # [BQ, KVH, G, D]
+
+    q_rel = qi * bq + jax.lax.broadcasted_iota(
+        jnp.int32, (kvh, bq * g, 1), 1
+    ) // g                                          # chunk-relative q pos
+    q_abs = start + q_rel
+
+    layer = scal_ref[0]
+
+    def k_dma(slot, page_no):
+        page = jnp.maximum(table_ref[page_no], 0)
+        return pltpu.make_async_copy(
+            k_hbm.at[layer, page], k_scr.at[slot], sems.at[slot, 0]
+        )
+
+    def v_dma(slot, page_no):
+        page = jnp.maximum(table_ref[page_no], 0)
+        return pltpu.make_async_copy(
+            v_hbm.at[layer, page], v_scr.at[slot], sems.at[slot, 1]
+        )
+
+    # prefix pages: [0, start) — ceil so a partial last page is visited
+    # (its rows ≥ start are masked); with a sliding window, pages fully
+    # below this q block's lowest window edge are never DMA'd
+    n_pref = jnp.minimum(
+        pl.cdiv(jnp.maximum(start, 0), ps), table_ref.shape[0]
+    )
+    p0 = jnp.where(
+        window > 0, jnp.maximum(start + qi * bq - window + 1, 0) // ps, 0
+    )
+    p0 = jnp.minimum(p0, n_pref)
+
+    @pl.when(n_pref > p0)
+    def _():
+        k_dma(0, p0).start()
+        v_dma(0, p0).start()
+
+    def pref_body(p, carry):
+        m, l, acc = carry
+        slot = jax.lax.rem(p - p0, 2)
+
+        @pl.when(p + 1 < n_pref)
+        def _():
+            nxt = jax.lax.rem(p + 1 - p0, 2)
+            k_dma(nxt, p + 1).start()
+            v_dma(nxt, p + 1).start()
+
+        k_dma(slot, p).wait()
+        v_dma(slot, p).wait()
+        k_page = k_scr[slot]                        # [ps, KVH, D]
+        v_page = v_scr[slot]
+
+        logits = jnp.stack([
+            jax.lax.dot_general(
+                q[:, h].reshape(bq * g, d),
+                k_page[:, h, :].astype(jnp.float32),
+                (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            for h in range(kvh)
+        ])                                          # [KVH, BQ*G, ps]
+        if softcap:
+            logits = softcap * jnp.tanh(logits / softcap)
+        pos = p * ps + jax.lax.broadcasted_iota(
+            jnp.int32, (kvh, bq * g, ps), 2
+        )
+        valid = (pos < start) & (
+            (window <= 0) | (q_abs - pos < window)
+        )
+        logits = jnp.where(valid, logits, -1e30)
+
+        m_new = jnp.maximum(m, logits.max(axis=2, keepdims=True))
+        alpha = jnp.exp(m - m_new)
+        prob = jnp.exp(logits - m_new)
+        l_new = l * alpha + prob.sum(axis=2, keepdims=True)
+        acc_new = acc * alpha + jnp.stack([
+            jax.lax.dot_general(
+                prob[h], v_page[:, h, :].astype(jnp.float32),
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            for h in range(kvh)
+        ])
+        return m_new, l_new, acc_new
+
+    m0 = jnp.full((kvh, bq * g, 1), -1e30, jnp.float32)
+    l0 = jnp.zeros((kvh, bq * g, 1), jnp.float32)
+    acc0 = jnp.zeros((kvh, bq * g, d), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(p0, n_pref, pref_body, (m0, l0, acc0))
+
+    # phase 2: the chunk's own K/V — causal within the chunk
+    nkb = pl.cdiv((qi + 1) * bq, bk)
+    kb0 = jnp.where(
+        window > 0, jnp.maximum(qi * bq - window + 1, 0) // bk, 0
+    )
+    kb0 = jnp.minimum(kb0, nkb)
+
+    def chunk_body(kb, carry):
+        m, l, acc = carry
+        k_blk = kc_ref[pl.ds(kb * bk, bk)]          # [BK, KVH, D]
+        v_blk = vc_ref[pl.ds(kb * bk, bk)]
+        logits = jnp.stack([
+            jax.lax.dot_general(
+                q[:, h].reshape(bq * g, d),
+                k_blk[:, h, :].astype(jnp.float32),
+                (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            for h in range(kvh)
+        ])                                          # [KVH, BQ*G, BK]
+        if softcap:
+            logits = softcap * jnp.tanh(logits / softcap)
+        krel = kb * bk + jax.lax.broadcasted_iota(
+            jnp.int32, (kvh, bq * g, bk), 2
+        )
+        dist = q_rel - krel
+        valid = (dist >= 0) & (start + krel < total) & (
+            (window <= 0) | (dist < window)
+        )
+        logits = jnp.where(valid, logits, -1e30)
+
+        m_new = jnp.maximum(m, logits.max(axis=2, keepdims=True))
+        alpha = jnp.exp(m - m_new)
+        prob = jnp.exp(logits - m_new)
+        l_new = l * alpha + prob.sum(axis=2, keepdims=True)
+        acc_new = acc * alpha + jnp.stack([
+            jax.lax.dot_general(
+                prob[h], v_blk[:, h, :].astype(jnp.float32),
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            for h in range(kvh)
+        ])
+        return m_new, l_new, acc_new
+
+    _, l, acc = jax.lax.fori_loop(kb0, nkb, chunk_body, (m, l, acc))
+    out = acc / jnp.maximum(l, 1e-30)               # [KVH, BQ*G, D]
+    o_ref[...] = (
+        out.reshape(kvh, bq, g, d).transpose(1, 0, 2, 3).astype(o_ref.dtype)
+    )
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("page_size", "interpret", "softcap"))
+def prefix_chunk(
+    q: jnp.ndarray,
+    k_pages: jnp.ndarray,
+    v_pages: jnp.ndarray,
+    table_row: jnp.ndarray,
+    start: jnp.ndarray,
+    total_len: jnp.ndarray,
+    page_size: int,
+    k_cur: jnp.ndarray,
+    v_cur: jnp.ndarray,
+    layer: jnp.ndarray | None = None,
+    interpret: bool = False,
+    softcap: float = 0.0,
+    window: jnp.ndarray | int = 0,
+) -> jnp.ndarray:
+    """Kernel form of ops.attention.attention_prefix_chunk (k_cur mode):
+    one chunk of queries [1, C, H, D] against the slot's full cached
+    context — prefix K/V streamed from the page pool page-by-page
+    (double-buffered DMA), the chunk's own K/V ([C, KVH, D], not yet in
+    the pool) VMEM-resident with causal masking. `start` is the absolute
+    position of q[0]; `total_len` = start + valid rows in this chunk.
+    This keeps >prefill_chunk prompts on the kernel path (VERDICT r04 #5)
+    — the jnp fallback gathers the whole prefix densely per layer.
+    """
+    _, c, h, d = q.shape
+    if k_pages.ndim == 4:
+        k_pages = k_pages[None]
+        v_pages = v_pages[None]
+    if layer is None:
+        layer = jnp.int32(0)
+    kvh = k_pages.shape[3]
+    g = h // kvh
+    bq = min(128, c)
+    bk = min(128, c)
+    assert c % bq == 0 and c % bk == 0, (c, bq, bk)
+
+    kernel = functools.partial(
+        _prefix_chunk_kernel, ps=page_size, bq=bq, bk=bk, kvh=kvh,
+        g=g, d=d, softcap=softcap,
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(c // bq,),
+        in_specs=[
+            pl.BlockSpec((bq, kvh, g, d), lambda i, *_: (i, 0, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((c, kvh, d), lambda i, *_: (0, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((c, kvh, d), lambda i, *_: (0, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=pl.BlockSpec((bq, kvh, g, d), lambda i, *_: (i, 0, 0, 0),
+                               memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.VMEM((2, page_size, kvh, d), k_pages.dtype),
+            pltpu.VMEM((2, page_size, kvh, d), v_pages.dtype),
+            pltpu.SemaphoreType.DMA((2, 2)),
+        ],
+    )
+    scal = jnp.stack([
+        jnp.asarray(layer, jnp.int32).reshape(()),
+        jnp.asarray(window, jnp.int32).reshape(()),
+        jnp.asarray(start, jnp.int32).reshape(()),
+        jnp.asarray(total_len, jnp.int32).reshape(()),
+    ])
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((c, kvh, g, d), q.dtype),
+        interpret=interpret,
+    )(scal, table_row.astype(jnp.int32), q[0].reshape(c, kvh, g, d),
+      k_cur, v_cur, k_pages, v_pages)
+    return out.reshape(1, c, h, d)
+
+
+# ---------------------------------------------------------------------------
 # paged KV writes (in-place DMA; replaces XLA scatter on the hot path)
 # ---------------------------------------------------------------------------
 #

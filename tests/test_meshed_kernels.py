@@ -256,3 +256,31 @@ def test_meshed_engine_generates_with_kernels():
         kvcache._env_mode.cache_clear()
     assert results["meshed-kernels"] == results["unmeshed-jnp"]
     assert len(results["meshed-kernels"]) == 8
+
+
+def test_meshed_prefix_chunk_matches_ref():
+    """The chunk-prefill kernel through the full-manual tp shard_map."""
+    mesh = _mesh()
+    t, ps, maxp = 16, 8, 6
+    kp = jax.random.normal(jax.random.PRNGKey(0), (L, NP, PS, KVH, D),
+                           jnp.float32)
+    vp = kp * 0.9
+    row = jnp.arange(maxp, dtype=jnp.int32)
+    q = jax.random.normal(jax.random.PRNGKey(1), (1, t, H, D), jnp.float32)
+    kc = jax.random.normal(jax.random.PRNGKey(2), (t, KVH, D), jnp.float32)
+    vc = jax.random.normal(jax.random.PRNGKey(3), (t, KVH, D), jnp.float32)
+    start, total = jnp.int32(PS), jnp.int32(PS + 12)
+
+    from gridllm_tpu.ops.attention import attention_prefix_chunk
+
+    got = jax.jit(
+        lambda q, kp, vp, row, start, total, kc, vc: attention_prefix_chunk(
+            q, kp, vp, row, start, total, PS, k_cur=kc, v_cur=vc,
+            layer=jnp.int32(1), use_pallas=True, mesh=mesh,
+        )
+    )(q, kp, vp, row, start, total, kc, vc)
+    want = attention_prefix_chunk(
+        q, kp, vp, row, start, total, PS, k_cur=kc, v_cur=vc,
+        layer=jnp.int32(1), use_pallas=False,
+    )
+    np.testing.assert_allclose(got[:, :12], want[:, :12], atol=2e-5)
