@@ -82,6 +82,10 @@ async def run_bench(model: str, n_requests: int, n_tokens: int,
     config = Config()
     registry = WorkerRegistry(bus, config.scheduler)
     scheduler = JobScheduler(bus, registry, config.scheduler)
+    # stage stats read every measured timeline — outgrow the default trace
+    # LRU so large --requests runs aren't silently truncated to its tail
+    scheduler.tracer.max_traces = max(scheduler.tracer.max_traces,
+                                      n_requests * 2 + 16)
     await registry.initialize()
     await scheduler.initialize()
     app = create_app(bus, registry, scheduler, config)
@@ -92,6 +96,7 @@ async def run_bench(model: str, n_requests: int, n_tokens: int,
             client_ctx=(app, worker), engine=engine, model=model,
             n_requests=n_requests, n_tokens=n_tokens,
             prompt_len=prompt_len, profile_dir=profile_dir, ckpt=ckpt,
+            scheduler=scheduler,
         )
     finally:
         # teardown ALSO on failure: the kernel-fallback retry in main()
@@ -110,8 +115,37 @@ async def run_bench(model: str, n_requests: int, n_tokens: int,
             pass
 
 
+def _stage_stats(tracer, request_ids) -> dict:
+    """p50 per-stage durations (ms) from the obs tracer's stitched
+    timelines — the per-stage breakdown that explains the end-to-end
+    numbers, read from the SAME spans /admin/trace serves instead of being
+    re-timed here (ISSUE 1 satellite)."""
+    keymap = {"queue.wait": "p50_queue_wait_ms",
+              "engine.prefill": "p50_prefill_ms",
+              "engine.decode": "p50_decode_ms"}
+    stages: dict[str, list[float]] = {k: [] for k in keymap}
+    ttfts: list[float] = []
+    for rid in request_ids:
+        for s in tracer.export(rid) or []:
+            if s["name"] in stages and s.get("durationMs") is not None:
+                stages[s["name"]].append(s["durationMs"])
+            elif s["name"] == "gateway.first_token":
+                t = (s.get("meta") or {}).get("ttftMs")
+                if t is not None:
+                    ttfts.append(float(t))
+    out = {keymap[name]: round(statistics.median(vals), 2)
+           for name, vals in stages.items() if vals}
+    if ttfts:
+        # gateway-side TTFT (submit → first stream frame) — the top-level
+        # p50_ttft_ms stays the client-observed HTTP number; the delta
+        # between them is gateway/HTTP overhead
+        out["p50_ttft_gateway_ms"] = round(statistics.median(ttfts), 2)
+    return out
+
+
 async def _run_bench_inner(client_ctx, engine, model, n_requests, n_tokens,
-                           prompt_len, profile_dir, ckpt) -> dict:
+                           prompt_len, profile_dir, ckpt,
+                           scheduler=None) -> dict:
     import aiohttp
     from aiohttp.test_utils import TestClient, TestServer
 
@@ -137,6 +171,8 @@ async def _run_bench_inner(client_ctx, engine, model, n_requests, n_tokens,
     if not engine.running and not engine.embedding_only:
         raise RuntimeError("engine runner died during warmup "
                            "(device-level failure)")
+    # stage stats must cover the MEASURED requests only, not the warmup
+    warm_ids = set(scheduler.tracer.ids()) if scheduler is not None else set()
 
     ttfts: list[float] = []
     itls: list[float] = []  # per-stream mean inter-token latency
@@ -189,12 +225,23 @@ async def _run_bench_inner(client_ctx, engine, model, n_requests, n_tokens,
 
     await client.close()  # remaining teardown is run_bench's finally
 
+    stages = {}
+    if scheduler is not None:
+        # worker-side spans publish on trace:{id} AFTER job:result resolves
+        # the HTTP stream — drain the bus so the tail requests' prefill/
+        # decode spans are ingested before we read the timelines
+        flush = getattr(scheduler.bus, "flush", None)
+        if flush is not None:
+            await flush()
+        measured = [r for r in scheduler.tracer.ids() if r not in warm_ids]
+        stages = _stage_stats(scheduler.tracer, measured)
     return {
         "tok_s": tokens_out[0] / wall,
         "p50_ttft_ms": statistics.median(ttfts) * 1000,
         "p50_itl_ms": statistics.median(itls) if itls else None,
         "tokens": tokens_out[0],
         "wall_s": wall,
+        "stages": stages,
         "weights": "real-checkpoint" if ckpt else "random-weights synthetic",
     }
 
@@ -437,6 +484,10 @@ def main() -> int:
         if r.get("p50_itl_ms") is not None:
             payload["p50_itl_ms"] = round(r["p50_itl_ms"], 1)
         payload["tokens"] = r["tokens"]
+        if r.get("stages"):
+            # per-stage breakdown from the obs tracer (queue-wait/prefill/
+            # decode p50s) — explains the end-to-end numbers above
+            payload["stages"] = r["stages"]
     else:
         payload["texts"] = r["texts"]
     if errors:

@@ -19,10 +19,59 @@ from __future__ import annotations
 
 import abc
 import asyncio
+import time
 from typing import Awaitable, Callable
+
+from gridllm_tpu.obs import metrics as obs
 
 # handler(channel, message) — message is the raw string payload
 Handler = Callable[[str, str], Awaitable[None]]
+
+# Bus-plane instruments (process-global registry): publish/deliver volumes
+# and delivery latency (publish → handler start), labeled by channel CLASS
+# (per-job/per-worker ids collapsed) so cardinality stays bounded.
+_PUBLISHED = obs.default_registry().counter(
+    "gridllm_bus_messages_published_total",
+    "Messages published to the bus, by channel class.",
+    ("channel",),
+)
+_DELIVERED = obs.default_registry().counter(
+    "gridllm_bus_messages_delivered_total",
+    "Messages delivered to subscribed handlers, by channel class.",
+    ("channel",),
+)
+_DELIVERY_LATENCY = obs.default_registry().histogram(
+    "gridllm_bus_delivery_latency_seconds",
+    "Latency from subscriber-side enqueue to handler start, by channel class.",
+    ("channel",),
+)
+
+_CHANNEL_CLASS_PREFIXES = (
+    ("job:stream:", "job:stream"),
+    ("job:result:", "job:result"),
+    ("admin:result:", "admin:result"),
+    ("worker:reregister:", "worker:reregister"),
+    ("trace:", "trace"),
+    # multi-host SPMD plan replay: slice:{worker_id}:plan and
+    # slice:{worker_id}:ready:{pid} — collapse both under one class
+    ("slice:", "slice"),
+)
+
+
+def channel_class(channel: str) -> str:
+    """Collapse per-id channels (``job:stream:{id}``, ``worker:{id}:job``)
+    into their fixed class name for metric labels."""
+    for prefix, cls in _CHANNEL_CLASS_PREFIXES:
+        if channel.startswith(prefix):
+            return cls
+    if channel.startswith("worker:") and channel.endswith(":job"):
+        return "worker:job"
+    return channel
+
+
+def record_publish(channel: str) -> None:
+    """Called by bus implementations on every publish."""
+    _PUBLISHED.inc(channel=channel_class(channel))
 
 
 class HandlerPump:
@@ -33,12 +82,17 @@ class HandlerPump:
 
     def __init__(self, handler: Handler):
         self.handler = handler
-        self.queue: asyncio.Queue[tuple[str, str]] = asyncio.Queue()
+        self.queue: asyncio.Queue[tuple[str, str, float]] = asyncio.Queue()
         self.task = asyncio.ensure_future(self._run())
 
     async def _run(self) -> None:
         while True:
-            channel, message = await self.queue.get()
+            channel, message, t_push = await self.queue.get()
+            cls = channel_class(channel)
+            _DELIVERED.inc(channel=cls)
+            _DELIVERY_LATENCY.observe(
+                max(0.0, time.monotonic() - t_push), channel=cls
+            )
             try:
                 await self.handler(channel, message)
             except asyncio.CancelledError:
@@ -51,7 +105,7 @@ class HandlerPump:
                 self.queue.task_done()
 
     def push(self, channel: str, message: str) -> None:
-        self.queue.put_nowait((channel, message))
+        self.queue.put_nowait((channel, message, time.monotonic()))
 
     async def drain(self) -> None:
         await self.queue.join()

@@ -14,7 +14,13 @@ from __future__ import annotations
 import fnmatch
 import time
 
-from gridllm_tpu.bus.base import Handler, HandlerPump, MessageBus, Subscription
+from gridllm_tpu.bus.base import (
+    Handler,
+    HandlerPump,
+    MessageBus,
+    Subscription,
+    record_publish,
+)
 
 
 class InMemoryBus(MessageBus):
@@ -98,6 +104,7 @@ class InMemoryBus(MessageBus):
 
     # -- pub/sub ------------------------------------------------------------
     async def publish(self, channel: str, message: str) -> int:
+        record_publish(channel)
         pumps: list[HandlerPump] = list(self._subs.get(channel, []))
         for pattern, phs in self._psubs.items():
             if fnmatch.fnmatchcase(channel, pattern):

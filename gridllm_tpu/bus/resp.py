@@ -22,7 +22,13 @@ from __future__ import annotations
 import asyncio
 from typing import Awaitable, Callable
 
-from gridllm_tpu.bus.base import Handler, HandlerPump, MessageBus, Subscription
+from gridllm_tpu.bus.base import (
+    Handler,
+    HandlerPump,
+    MessageBus,
+    Subscription,
+    record_publish,
+)
 from gridllm_tpu.utils.logging import get_logger
 
 log = get_logger("bus.resp")
@@ -283,6 +289,7 @@ class RespBus(MessageBus):
 
     # -- pub/sub ------------------------------------------------------------
     async def publish(self, channel: str, message: str) -> int:
+        record_publish(channel)
         return int(await self._pub.command("PUBLISH", channel, message))
 
     async def subscribe(self, channel: str, handler: Handler) -> Subscription:

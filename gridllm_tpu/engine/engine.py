@@ -54,6 +54,7 @@ import numpy as np
 from gridllm_tpu.engine.tokenizer import DetokState, Tokenizer, get_tokenizer
 from gridllm_tpu.models import llama
 from gridllm_tpu.models.configs import ModelConfig, get_config
+from gridllm_tpu.obs import SIZE_BUCKETS, default_registry
 from gridllm_tpu.ops.kvcache import PagedKVCache, PageAllocator
 from gridllm_tpu.ops.sampling import (
     SamplingParams,
@@ -66,6 +67,36 @@ from gridllm_tpu.parallel.sharding import shard_cache, shard_params
 from gridllm_tpu.utils.logging import get_logger
 
 log = get_logger("engine")
+
+# Engine-plane instruments (process-global registry → the worker's
+# /metrics). Updated from the runner thread / step() only, so the metric
+# locks are uncontended on the hot path.
+_OBS = default_registry()
+_TOKENS_TOTAL = _OBS.counter(
+    "gridllm_engine_tokens_total",
+    "Tokens processed, by model and kind (prefill = prompt tokens "
+    "dispatched, decode = tokens sampled and ingested).",
+    ("model", "kind"),
+)
+_STEP_DURATION = _OBS.histogram(
+    "gridllm_engine_step_duration_seconds",
+    "Per-decode-step wall time (fused-block fetch time divided by the "
+    "block's step count), by model.",
+    ("model",),
+)
+_BATCH_OCCUPANCY = _OBS.histogram(
+    "gridllm_engine_batch_occupancy",
+    "Active slots at each decode-block dispatch, by model.",
+    ("model",), buckets=SIZE_BUCKETS,
+)
+_KV_PAGES_USED = _OBS.gauge(
+    "gridllm_engine_kv_pages_used", "KV page-pool pages in use, by model.",
+    ("model",),
+)
+_KV_PAGES_FREE = _OBS.gauge(
+    "gridllm_engine_kv_pages_free", "KV page-pool pages free, by model.",
+    ("model",),
+)
 
 
 def _model_module(cfg: ModelConfig):
@@ -382,6 +413,7 @@ class InferenceEngine:
             self._inflight.clear()
             self._free_slots = list(range(self.config.max_slots - 1, -1, -1))
             self._init_device_state()
+            self._update_kv_gauges()
             if self.plan_sink is not None:  # after-success; see _try_admit
                 self.plan_sink({"op": "reset"})
 
@@ -696,7 +728,14 @@ class InferenceEngine:
         st.t_prefill_ns = time.perf_counter_ns() - t0
         st.joined_gen = self._gen + 1  # first block dispatched after this
         self._slots[slot] = st
+        _TOKENS_TOTAL.inc(len(ids), model=self.cfg.name, kind="prefill")
+        self._update_kv_gauges()
         return True
+
+    def _update_kv_gauges(self) -> None:
+        free = self.alloc.free_pages
+        _KV_PAGES_FREE.set(free, model=self.cfg.name)
+        _KV_PAGES_USED.set(self.config.num_pages - free, model=self.cfg.name)
 
     def _expand_image_tokens(self, ids: list[int], n_images: int) -> list[int]:
         """Expand image placeholders to num_patches copies each (the splice
@@ -882,6 +921,7 @@ class InferenceEngine:
             if self.plan_sink is not None:  # after-success; see _try_admit
                 self.plan_sink({"op": "deact", "slot": slot})
         self.alloc.free(slot)
+        self._update_kv_gauges()
         del self._slots[slot]
         self._free_slots.append(slot)
         if st.req.on_chunk:
@@ -890,6 +930,7 @@ class InferenceEngine:
     def _dispatch_block(self, k: int) -> None:
         """Dispatch one fused k-step decode block (no host sync)."""
         with self.dispatch_lock:
+            _BATCH_OCCUPANCY.observe(len(self._slots), model=self.cfg.name)
             self._gen += 1
             (out, self.tokens, self.cache, self.counts, self.window,
              self.wlen, self.sampling) = self._decode_block_fn(
@@ -907,6 +948,7 @@ class InferenceEngine:
         reused after this block was dispatched) are skipped entirely."""
         k = tok_np.shape[0] - 1
         now = time.perf_counter_ns()
+        ingested = 0
         for slot, st in list(self._slots.items()):
             if st.joined_gen > gen:
                 continue
@@ -919,8 +961,11 @@ class InferenceEngine:
                 st.t_first_decode = now
             for r in range(first_row, k + 1):
                 self._ingest(slot, st, int(tok_np[r, slot]))
+                ingested += 1
                 if slot not in self._slots:
                     break  # finished mid-block; later rows are post-EOS junk
+        if ingested:
+            _TOKENS_TOTAL.inc(ingested, model=self.cfg.name, kind="decode")
 
     def _drain_ctl(self) -> None:
         while self._ctl:
@@ -943,7 +988,9 @@ class InferenceEngine:
             return bool(self._pending)
         self._dispatch_block(1)
         gen, out, _ = self._inflight.popleft()
+        t0 = time.perf_counter()
         self._ingest_block(gen, np.asarray(jax.device_get(out)))
+        _STEP_DURATION.observe(time.perf_counter() - t0, model=self.cfg.name)
         return True
 
     # ------------------------------------------------------------- runner
@@ -1028,8 +1075,14 @@ class InferenceEngine:
         k = self.config.decode_block
         while len(self._inflight) < max(1, self.config.pipeline_depth):
             self._dispatch_block(k)
-        gen, out, _ = self._inflight.popleft()
+        gen, out, blk = self._inflight.popleft()
+        t0 = time.perf_counter()
         self._ingest_block(gen, np.asarray(jax.device_get(out)))
+        # fetch+ingest wall time per fused step; in steady state the fetch
+        # of block N overlaps block N+1's compute, so this is the honest
+        # per-step pace the pipeline sustains
+        _STEP_DURATION.observe(
+            (time.perf_counter() - t0) / max(blk, 1), model=self.cfg.name)
 
     # ---------------------------------------------------------- public API
 

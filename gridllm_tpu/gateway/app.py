@@ -20,6 +20,7 @@ from gridllm_tpu.bus.base import MessageBus
 from gridllm_tpu.gateway import (
     health_routes,
     inference_routes,
+    obs_routes,
     ollama_routes,
     openai_routes,
 )
@@ -37,7 +38,10 @@ def create_app(bus: MessageBus, registry: WorkerRegistry, scheduler: JobSchedule
     config = config or load_config()
     version = gridllm_tpu.__version__
     app = web.Application(
-        middlewares=[error_middleware, rate_limit_middleware(config.gateway)],
+        # metrics outermost: it must observe the FINAL status, including
+        # error-middleware translations and 429s from the rate limiter
+        middlewares=[obs_routes.metrics_middleware(scheduler),
+                     error_middleware, rate_limit_middleware(config.gateway)],
         client_max_size=config.gateway.max_body_bytes,
     )
     app[APP_ENV] = config.env
@@ -73,6 +77,7 @@ def create_app(bus: MessageBus, registry: WorkerRegistry, scheduler: JobSchedule
                                               admin=admin))
     app.add_routes(inference_routes.build_routes(registry, scheduler))
     app.add_routes(health_routes.build_routes(bus, registry, scheduler, version))
+    app.add_routes(obs_routes.build_routes(scheduler))
 
     async def root(request: web.Request) -> web.Response:
         """Root summary (reference: server/src/index.ts:86-109)."""

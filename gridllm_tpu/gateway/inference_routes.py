@@ -105,6 +105,10 @@ def build_routes(registry: WorkerRegistry, scheduler: JobScheduler) -> list[web.
                 "activeJobs": stats["activeJobs"],
                 "totalProcessed": stats["totalJobsProcessed"],
                 "totalFailed": stats["totalJobsFailed"],
+                "totalTimedOut": stats["totalJobsTimedOut"],
+                "totalCancelled": stats["totalJobsCancelled"],
+                "totalRetried": stats["totalJobsRetried"],
+                "totalOrphaned": stats["totalJobsOrphaned"],
             },
             "workers": counts,
         })

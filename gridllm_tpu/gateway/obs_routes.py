@@ -1,0 +1,97 @@
+"""Observability endpoints + HTTP metrics middleware (ISSUE 1).
+
+- ``GET /metrics``: Prometheus text exposition. Renders the scheduler's
+  per-instance registry (gateway/scheduler/worker-liveness series) plus the
+  process-global default registry (bus, and — in single-process deployments
+  like bench.py — engine/kernel series).
+- ``GET /admin/trace/{request_id}``: the stitched gateway+worker span
+  timeline recorded by obs/tracer.py.
+- ``metrics_middleware``: request count by route/method/status and
+  end-to-end latency histogram by route. Route labels use the matched
+  route's canonical pattern (``/inference/{job_id}/status``), never the raw
+  path, so label cardinality stays bounded.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+
+from aiohttp import web
+
+from gridllm_tpu.obs import PROMETHEUS_CONTENT_TYPE, default_registry, render_registries
+from gridllm_tpu.scheduler import JobScheduler
+
+
+def metrics_middleware(scheduler: JobScheduler):
+    requests_total = scheduler.metrics.counter(
+        "gridllm_gateway_requests_total",
+        "HTTP requests handled by the gateway, by route/method/status.",
+        ("route", "method", "status"),
+    )
+    duration = scheduler.metrics.histogram(
+        "gridllm_gateway_request_duration_seconds",
+        "End-to-end HTTP request latency (including streaming bodies), "
+        "by route.",
+        ("route",),
+    )
+
+    def route_of(request: web.Request) -> str:
+        info = request.match_info
+        resource = info.route.resource if info.route is not None else None
+        canonical = getattr(resource, "canonical", None)
+        return canonical or "unmatched"
+
+    @web.middleware
+    async def middleware(request: web.Request, handler):
+        if request.path == "/metrics":
+            return await handler(request)  # don't count scrapes
+        t0 = time.monotonic()
+        status = 500
+        try:
+            response = await handler(request)
+            status = response.status
+            return response
+        except web.HTTPException as e:
+            status = e.status
+            raise
+        except asyncio.CancelledError:
+            # client closed the connection mid-stream — not a server fault;
+            # 499 per the nginx convention so disconnects don't pollute the
+            # 5xx error rate
+            status = 499
+            raise
+        finally:
+            route = route_of(request)
+            requests_total.inc(route=route, method=request.method,
+                               status=str(status))
+            duration.observe(time.monotonic() - t0, route=route)
+
+    return middleware
+
+
+def build_routes(scheduler: JobScheduler) -> list[web.RouteDef]:
+
+    async def metrics(request: web.Request) -> web.Response:
+        text = render_registries(scheduler.metrics, default_registry())
+        return web.Response(text=text,
+                            headers={"Content-Type": PROMETHEUS_CONTENT_TYPE})
+
+    async def trace(request: web.Request) -> web.Response:
+        request_id = request.match_info["request_id"]
+        spans = scheduler.tracer.export(request_id)
+        if spans is None:
+            from gridllm_tpu.gateway.errors import ApiError
+
+            raise ApiError(f"No trace recorded for request '{request_id}'",
+                           404, "TRACE_NOT_FOUND")
+        return web.json_response({
+            "requestId": request_id,
+            "spans": spans,
+            "sources": sorted({s["source"] for s in spans}),
+        })
+
+    return [
+        web.get("/metrics", metrics),
+        web.get("/admin/trace/{request_id}", trace),
+    ]

@@ -15,7 +15,11 @@ from aiohttp import web
 
 from gridllm_tpu.utils.config import GatewayConfig
 
-_BYPASS_PREFIXES = ("/health", "/live", "/ready")
+# /metrics joins the health bypass: a Prometheus scrape cadence (every
+# 10-15 s) would otherwise eat the client budget of whatever shares the
+# scraper's IP (and throttling a scrape blinds the dashboard exactly when
+# traffic spikes)
+_BYPASS_PREFIXES = ("/health", "/live", "/ready", "/metrics")
 
 
 def rate_limit_middleware(config: GatewayConfig):

@@ -1,0 +1,39 @@
+"""Observability subsystem: metrics registry + request tracer (ISSUE 1).
+
+Pure stdlib — no prometheus_client, no OpenTelemetry. See metrics.py for
+the instrument/encoding layer and tracer.py for span timelines.
+"""
+
+from gridllm_tpu.obs.metrics import (
+    LATENCY_BUCKETS,
+    PROMETHEUS_CONTENT_TYPE,
+    SIZE_BUCKETS,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    default_registry,
+    render_registries,
+)
+from gridllm_tpu.obs.tracer import (
+    TRACE_CHANNEL_PREFIX,
+    Span,
+    Tracer,
+    trace_channel,
+)
+
+__all__ = [
+    "LATENCY_BUCKETS",
+    "PROMETHEUS_CONTENT_TYPE",
+    "SIZE_BUCKETS",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "Span",
+    "TRACE_CHANNEL_PREFIX",
+    "Tracer",
+    "default_registry",
+    "render_registries",
+    "trace_channel",
+]

@@ -29,6 +29,7 @@ from gridllm_tpu.ops.kvcache import (
     _shard_map_kernel,
     gather_kv,
     kernel_mesh_axis,
+    record_kernel_path,
 )
 
 __all__ = [
@@ -124,14 +125,17 @@ def attention_prefill(
     use, interpret = _pallas_mode(use_pallas)
     t, d = q.shape[1], q.shape[3]
     if not use or t % min(128, t) != 0:
+        record_kernel_path("attention_prefill", False)
         return attention_prefill_ref(
             q, k, v, seq_lens, logit_softcap=logit_softcap, window=window
         )
     mode, ax = kernel_mesh_axis(mesh, k.shape[2], q.shape[2])
     if mode == "ref":
+        record_kernel_path("attention_prefill", False)
         return attention_prefill_ref(
             q, k, v, seq_lens, logit_softcap=logit_softcap, window=window
         )
+    record_kernel_path("attention_prefill", True)
     kernel = partial(
         _prefill_kernel, interpret=interpret, softcap=float(logit_softcap)
     )
@@ -197,6 +201,7 @@ def paged_attention_decode(
     if use and mode != "ref" and (interpret or q.shape[-1] % 128 == 0):
         from gridllm_tpu.ops import pallas_kernels
 
+        record_kernel_path("attention_decode", True)
         kernel = partial(
             pallas_kernels.paged_decode, page_size=page_size,
             interpret=interpret, softcap=float(logit_softcap),
@@ -230,6 +235,7 @@ def paged_attention_decode(
         sm = _shard_map_kernel(mesh, sm_body, in_specs=tuple(specs),
                                out_specs=hs)
         return sm(*args)
+    record_kernel_path("attention_decode", False)
     if k_pages.ndim == 5:  # fallback: materialize the layer slice
         li = jnp.int32(0) if layer is None else layer
         k_pages = jax.lax.dynamic_index_in_dim(k_pages, li, keepdims=False)
@@ -304,6 +310,7 @@ def attention_prefix_chunk(
     ):
         from gridllm_tpu.ops import pallas_kernels
 
+        record_kernel_path("attention_prefix_chunk", True)
         kernel = partial(
             pallas_kernels.prefix_chunk, page_size=page_size,
             interpret=interpret, softcap=float(logit_softcap),
@@ -338,6 +345,7 @@ def attention_prefix_chunk(
         sm = _shard_map_kernel(mesh, sm_body, in_specs=tuple(specs),
                                out_specs=hs)
         return sm(*args)
+    record_kernel_path("attention_prefix_chunk", False)
     g = h // kvh
     scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32))
 

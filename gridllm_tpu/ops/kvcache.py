@@ -28,6 +28,25 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 
+from gridllm_tpu.obs import default_registry
+
+# Which implementation each traced program took: "pallas" (kernel) or
+# "jnp" (fallback scatter/reference). Incremented at TRACE time — once per
+# compiled program, not per step — so a nonzero jnp count for an op that
+# should run the kernel path is the silent-fallback tripwire (the
+# pre-fb61f50 d=64 fallback would have been one dashboard cell, not a
+# bisect). ops/attention.py records through this too.
+_KERNEL_DISPATCH = default_registry().counter(
+    "gridllm_kernel_dispatch_total",
+    "Compiled programs by op and implementation path (pallas kernel vs "
+    "jnp fallback). Counted per trace/compile, not per step.",
+    ("op", "path"),
+)
+
+
+def record_kernel_path(op: str, kernel: bool) -> None:
+    _KERNEL_DISPATCH.inc(op=op, path="pallas" if kernel else "jnp")
+
 
 @functools.cache
 def _env_mode() -> tuple[bool, bool]:
@@ -311,6 +330,7 @@ def write_decode_all(
     if use and mode != "ref" and (interpret or k_pages.shape[-1] % 128 == 0):
         from gridllm_tpu.ops.pallas_kernels import paged_write_decode
 
+        record_kernel_path("write_decode", True)
         kernel = partial(paged_write_decode, interpret=interpret)
         if mode == "wrap":
             from jax.sharding import PartitionSpec as P
@@ -318,6 +338,7 @@ def write_decode_all(
             kernel = _wrap_write_kernel(mesh, ax, kernel,
                                         (P(None), P(None)))
         return kernel(k_pages, v_pages, k_new, v_new, page_idx, offset)
+    record_kernel_path("write_decode", False)
     # one scatter over (page, row) applied to every layer: index arrays are
     # adjacent advanced indices after the leading ':' so the result keeps
     # [L, S, KVH, D] — matching k_new's layout
@@ -353,6 +374,7 @@ def write_prefill_all(
     ):
         from gridllm_tpu.ops.pallas_kernels import paged_write_chunk
 
+        record_kernel_path("write_prefill", True)
         kernel = partial(
             paged_write_chunk, page_size=page_size, interpret=interpret
         )
@@ -363,6 +385,7 @@ def write_prefill_all(
                                         (P(None), P(), P()))
         return kernel(k_pages, v_pages, k_new, v_new, table_row, start,
                       length)
+    record_kernel_path("write_prefill", False)
     t = jnp.arange(k_new.shape[1], dtype=jnp.int32)
     pos = start + t
     page_idx = _safe_page_idx(

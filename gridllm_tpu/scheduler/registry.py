@@ -30,6 +30,7 @@ import json
 import time
 
 from gridllm_tpu.bus.base import MessageBus, Subscription
+from gridllm_tpu.obs import Counter, Gauge, MetricsRegistry
 from gridllm_tpu.utils.config import SchedulerConfig
 from gridllm_tpu.utils.events import EventEmitter
 from gridllm_tpu.utils.logging import get_logger
@@ -49,6 +50,32 @@ class WorkerRegistry(EventEmitter):
         self._subs: list[Subscription] = []
         self._tasks: list[asyncio.Task] = []
         self._running = False
+        self.metrics: MetricsRegistry | None = None
+        self._workers_gauge: Gauge | None = None
+        self._removed_total: Counter | None = None
+
+    def attach_metrics(self, metrics: MetricsRegistry) -> None:
+        """Wire worker-liveness instruments onto a registry (called by
+        JobScheduler.__init__ so gateway /metrics sees them): a by-status
+        gauge collected at render time plus a removals counter by reason."""
+        self.metrics = metrics
+        self._workers_gauge = metrics.gauge(
+            "gridllm_workers", "Registered workers, by status.", ("status",))
+        self._removed_total = metrics.counter(
+            "gridllm_workers_removed_total",
+            "Workers removed from the registry, by reason "
+            "(unregistered/disconnected/heartbeat_timeout/aliveness_probe).",
+            ("reason",),
+        )
+        metrics.add_collector("worker_registry", self._collect)
+
+    def _collect(self) -> None:
+        if self._workers_gauge is None:
+            return
+        for status, n in self.get_worker_count().items():
+            if status == "total":  # derivable; exporting it double-counts
+                continue           # every worker under sum(gridllm_workers)
+            self._workers_gauge.set(n, status=status)
 
     # -- lifecycle ----------------------------------------------------------
     async def initialize(self) -> None:
@@ -217,6 +244,8 @@ class WorkerRegistry(EventEmitter):
         info = self.workers.pop(worker_id, None)
         await self.bus.hdel(WORKERS_KEY, worker_id)
         if info is not None:
+            if self._removed_total is not None:
+                self._removed_total.inc(reason=reason or "unknown")
             log.worker("worker removed", worker_id, reason=reason)
             self.emit("worker_removed", worker_id, info, reason)
 

@@ -40,10 +40,12 @@ import time
 from typing import Any, Awaitable, Callable
 
 from gridllm_tpu.bus.base import MessageBus, Subscription
+from gridllm_tpu.obs import MetricsRegistry, Tracer
+from gridllm_tpu.obs.tracer import TRACE_CHANNEL_PREFIX
 from gridllm_tpu.scheduler.registry import WorkerRegistry
 from gridllm_tpu.utils.config import SchedulerConfig
 from gridllm_tpu.utils.events import EventEmitter
-from gridllm_tpu.utils.logging import get_logger
+from gridllm_tpu.utils.logging import bind_request_id, get_logger
 from gridllm_tpu.utils.types import (
     InferenceRequest,
     JobAssignment,
@@ -83,7 +85,8 @@ class _QueuedJob:
 
 class JobScheduler(EventEmitter):
     def __init__(self, bus: MessageBus, registry: WorkerRegistry,
-                 config: SchedulerConfig | None = None):
+                 config: SchedulerConfig | None = None,
+                 metrics: MetricsRegistry | None = None):
         super().__init__()
         self.bus = bus
         self.registry = registry
@@ -101,8 +104,42 @@ class JobScheduler(EventEmitter):
         self._no_owner_warned: dict[str, float] = {}  # model → last warn time
         self._cancelled: dict[str, float] = {}        # jobId → cancel time
         self._running = False
-        self.total_completed = 0
-        self.total_failed = 0
+        # observability (obs/): per-instance registry so each server (and
+        # each test stack) starts from zeroed counters; cumulative stats in
+        # get_stats() are sourced from HERE, so /health/* and /metrics can
+        # never disagree. The tracer holds gateway-side span timelines and
+        # ingests worker-side ones published on trace:{request_id}.
+        self.metrics = metrics or MetricsRegistry()
+        self.tracer = Tracer(source="gateway")
+        self._jobs_total = self.metrics.counter(
+            "gridllm_scheduler_jobs_total",
+            "Job lifecycle events (queued/dispatched/completed/failed/"
+            "timeout/cancelled/retried/orphaned/nacked).",
+            ("event",),
+        )
+        self._queue_wait = self.metrics.histogram(
+            "gridllm_scheduler_queue_wait_seconds",
+            "Time jobs spend queued before assignment to a worker.",
+        )
+        self._assignments = self.metrics.counter(
+            "gridllm_scheduler_worker_assignments_total",
+            "Jobs assigned, by worker.",
+            ("worker",),
+        )
+        self._ttft = self.metrics.histogram(
+            "gridllm_request_ttft_seconds",
+            "Time from streaming-job submission to the first streamed "
+            "token frame, by model.",
+            ("model",),
+        )
+        self._queue_depth = self.metrics.gauge(
+            "gridllm_scheduler_queue_depth", "Jobs currently queued.")
+        self._active_gauge = self.metrics.gauge(
+            "gridllm_scheduler_active_jobs",
+            "Jobs currently assigned to workers.")
+        self.metrics.add_collector("scheduler", self._collect_gauges)
+        registry.attach_metrics(self.metrics)
+        self._queue_spans: dict[str, Any] = {}  # jobId → open queue span
 
     # -- lifecycle ----------------------------------------------------------
     async def initialize(self) -> None:
@@ -113,6 +150,11 @@ class JobScheduler(EventEmitter):
             ("job:timeout", self._on_job_timeout_report),
         ]:
             self._subs.append(await self.bus.subscribe(channel, handler))
+        # worker-side span timelines arrive on trace:{request_id}; merging
+        # them here is what stitches one end-to-end timeline per request
+        self._subs.append(
+            await self.bus.psubscribe(f"{TRACE_CHANNEL_PREFIX}*",
+                                      self._on_trace))
         await self._load_existing_jobs()
         self._sweep_task = asyncio.create_task(self._sweep_loop())
         # new capacity → dispatch; lost worker → requeue its jobs
@@ -168,13 +210,52 @@ class JobScheduler(EventEmitter):
             self.active_jobs[job_id] = assignment
             self._arm_timeout(assignment, remaining_ms=assignment.timeout - age_ms)
 
+    # -- observability ------------------------------------------------------
+    def _collect_gauges(self) -> None:
+        """Render-time collector: point-in-time gauges from live state."""
+        self._queue_depth.set(len(self.job_queue))
+        self._active_gauge.set(len(self.active_jobs))
+
+    async def _on_trace(self, channel: str, raw: str) -> None:
+        """Ingest a worker-published span timeline (obs/tracer.py)."""
+        try:
+            data = json.loads(raw)
+            rid = data.get("requestId") or channel[len(TRACE_CHANNEL_PREFIX):]
+            spans = data.get("spans") or []
+        except Exception:
+            return
+        if rid and isinstance(spans, list):
+            self.tracer.ingest(rid, spans)
+
+    def _begin_queue_span(self, request: InferenceRequest, **meta: Any) -> None:
+        """Open a queue.wait span for a (re)queued job; closed at dispatch
+        or cancellation. Requeues (retry/orphan/nack) open a fresh one."""
+        old = self._queue_spans.pop(request.id, None)
+        if old is not None:
+            self.tracer.end(old)
+        self._queue_spans[request.id] = self.tracer.begin(
+            request.id, "queue.wait",
+            priority=request.priority.value, **meta)
+
+    def _end_queue_span(self, job_id: str, **meta: Any) -> None:
+        span = self._queue_spans.pop(job_id, None)
+        if span is not None:
+            self.tracer.end(span, **meta)
+
     # -- public API ---------------------------------------------------------
-    async def add_job(self, request: InferenceRequest) -> str:
-        """Queue a job and trigger dispatch (reference: JobScheduler.ts:651-664)."""
+    async def add_job(self, request: InferenceRequest,
+                      requeue: bool = False) -> str:
+        """Queue a job and trigger dispatch (reference: JobScheduler.ts:651-664).
+        ``requeue=True`` (the retry ladder) skips the ``queued`` counter so
+        requeues are counted only by their own event (retried/nacked/
+        orphaned) and ``queued`` balances against terminal events."""
         qj = _QueuedJob(request, self._seq)
         self._seq += 1
         self.job_queue.append(qj)
         await self._persist_queued(qj)
+        if not requeue:
+            self._jobs_total.inc(event="queued")
+        self._begin_queue_span(request)
         log.job("job queued", request.id, model=request.model,
                 priority=request.priority.value)
         self.emit("job_queued", request)
@@ -197,21 +278,40 @@ class JobScheduler(EventEmitter):
                 except Exception as e:
                     future.set_exception(e)
 
+        md = request.metadata or {}
+        endpoint = (md.get("openaiEndpoint") or md.get("ollamaEndpoint")
+                    or md.get("endpoint") or "")
+        root = self.tracer.begin(request.id, "gateway.request",
+                                 endpoint=endpoint, model=request.model)
         subs: list[Subscription] = []
-        for channel, handler in extra_subs or []:
-            subs.append(await self.bus.subscribe(channel, handler))
-        subs.append(await self.bus.subscribe(f"job:result:{request.id}", on_result))
-        try:
-            await self.add_job(request)
+        outcome = "error"
+        with bind_request_id(request.id):
             try:
-                return await asyncio.wait_for(future, timeout_ms / 1000)
-            except asyncio.TimeoutError:
-                await self.cancel_job(request.id, reason="timeout")
-                raise JobTimeoutError(
-                    f"Job {request.id} timed out after {timeout_ms} ms") from None
-        finally:
-            for sub in subs:
-                await sub.unsubscribe()
+                for channel, handler in extra_subs or []:
+                    subs.append(await self.bus.subscribe(channel, handler))
+                subs.append(await self.bus.subscribe(
+                    f"job:result:{request.id}", on_result))
+                await self.add_job(request)
+                try:
+                    result = await asyncio.wait_for(future, timeout_ms / 1000)
+                    outcome = "success" if result.success else "failed"
+                    return result
+                except asyncio.TimeoutError:
+                    outcome = "timeout"
+                    # end the root BEFORE cancel_job's tracer.abort seals
+                    # the timeline, so the outcome lands on the span
+                    self.tracer.end(root, outcome=outcome)
+                    await self.cancel_job(request.id, reason="timeout")
+                    raise JobTimeoutError(
+                        f"Job {request.id} timed out after {timeout_ms} ms"
+                    ) from None
+            finally:
+                # seal the trace BEFORE the awaited unsubscribes: a bus
+                # error there must not leak the open root span
+                self.tracer.end(root, outcome=outcome)
+                self.tracer.finish(request.id)
+                for sub in subs:
+                    await sub.unsubscribe()
 
     async def submit_and_wait(self, request: InferenceRequest,
                               timeout_ms: int | None = None) -> JobResult:
@@ -227,12 +327,20 @@ class JobScheduler(EventEmitter):
     ) -> JobResult:
         """Streaming submit: forward ``job:stream:{id}`` frames to on_chunk,
         return the final result (reference: JobScheduler.ts:713-856)."""
+        t_submit = time.time()
+        first = [True]
 
         async def on_stream(_ch: str, raw: str) -> None:
             try:
                 chunk = StreamChunk.model_validate_json(raw)
             except Exception:
                 return
+            if first[0]:
+                first[0] = False
+                ttft = time.time() - t_submit
+                self._ttft.observe(ttft, model=request.model)
+                self.tracer.event(request.id, "gateway.first_token",
+                                  ttftMs=round(ttft * 1000, 3))
             await on_chunk(chunk)
 
         return await self._submit_and_await(
@@ -244,24 +352,44 @@ class JobScheduler(EventEmitter):
         JobScheduler.ts:874-908). The cancelled-set guards the race where a
         dispatch pass already snapshotted the queued job."""
         self._cancelled[job_id] = time.time()
+
+        def account() -> None:
+            # a cancel with reason="timeout" is the waiter-side timeout
+            # path — count it as a timeout, not a user cancellation
+            event = "timeout" if reason == "timeout" else "cancelled"
+            self._jobs_total.inc(event=event)
+            self._end_queue_span(job_id, cancelled=True, reason=reason)
+            self.tracer.abort(job_id, reason=reason)
+
         retry = self._retry_handles.pop(job_id, None)
         if retry is not None:
             retry.cancel()
+            account()
             log.job("retrying job cancelled", job_id, reason=reason)
             return True
         for i, qj in enumerate(self.job_queue):
             if qj.request.id == job_id:
                 self.job_queue.pop(i)
                 await self.bus.hdel(JOB_QUEUE_KEY, job_id)
+                account()
                 log.job("queued job cancelled", job_id, reason=reason)
                 return True
-        assignment = self.active_jobs.get(job_id)
+        # claim synchronously before the publish await — the armed
+        # _handle_job_timeout can interleave there and the job must be
+        # accounted (timeout vs cancelled) exactly once
+        assignment = self.active_jobs.pop(job_id, None)
         if assignment is not None:
-            await self.bus.publish(
-                f"worker:{assignment.workerId}:job",
-                json.dumps({"type": "job_cancellation", "jobId": job_id, "reason": reason}),
-            )
-            await self._clear_active(job_id, free_worker=True)
+            try:
+                await self.bus.publish(
+                    f"worker:{assignment.workerId}:job",
+                    json.dumps({"type": "job_cancellation", "jobId": job_id, "reason": reason}),
+                )
+            finally:
+                # the job is already claimed — even a dead bus must not
+                # skip the terminal accounting and cleanup
+                account()
+                await self._clear_active(job_id, free_worker=True,
+                                         assignment=assignment)
             log.job("active job cancelled", job_id,
                     worker_id=assignment.workerId, reason=reason)
             return True
@@ -280,12 +408,34 @@ class JobScheduler(EventEmitter):
         return None
 
     def get_stats(self) -> dict[str, Any]:
+        """Instantaneous queue/active sizes plus cumulative lifecycle
+        counters sourced from the metrics registry — the same series
+        /metrics exports, so health snapshots and scrapes cannot disagree."""
+        jt = self._jobs_total
+        completed = int(jt.value(event="completed"))
+        failed = int(jt.value(event="failed"))
+        timed_out = int(jt.value(event="timeout"))
         return {
             "queuedJobs": len(self.job_queue),
             "activeJobs": len(self.active_jobs),
-            "totalJobsProcessed": self.total_completed,
-            "totalJobsFailed": self.total_failed,
+            "totalJobsProcessed": completed,
+            "totalJobsFailed": failed + timed_out,
+            "totalJobsCompleted": completed,
+            "totalJobsTimedOut": timed_out,
+            "totalJobsCancelled": int(jt.value(event="cancelled")),
+            "totalJobsRetried": int(jt.value(event="retried")),
+            "totalJobsOrphaned": int(jt.value(event="orphaned")),
         }
+
+    @property
+    def total_completed(self) -> int:
+        return int(self._jobs_total.value(event="completed"))
+
+    @property
+    def total_failed(self) -> int:
+        # permanent failures + timeouts, matching the pre-obs attribute
+        return (int(self._jobs_total.value(event="failed"))
+                + int(self._jobs_total.value(event="timeout")))
 
     # -- dispatch -----------------------------------------------------------
     def request_dispatch(self) -> None:
@@ -315,6 +465,7 @@ class JobScheduler(EventEmitter):
                 if qj.request.id in self._cancelled:
                     assigned_ids.add(qj.request.id)  # drop from queue below
                     await self.bus.hdel(JOB_QUEUE_KEY, qj.request.id)
+                    self._end_queue_span(qj.request.id, cancelled=True)
                     continue
                 worker = self._select_worker(qj.request)
                 if worker is None:
@@ -400,6 +551,12 @@ class JobScheduler(EventEmitter):
             json.dumps({"type": "job_assignment", "job": assignment.model_dump(mode="json")}),
         )
         self._arm_timeout(assignment, remaining_ms=timeout_ms)
+        self._jobs_total.inc(event="dispatched")
+        self._assignments.inc(worker=worker.workerId)
+        self._queue_wait.observe(max(0.0, time.time() - qj.enqueued_at))
+        self._end_queue_span(request.id, worker=worker.workerId)
+        self.tracer.event(request.id, "scheduler.dispatch",
+                          worker=worker.workerId)
         log.job("job assigned", request.id, worker_id=worker.workerId)
         self.emit("job_assigned", assignment)
         return True
@@ -422,9 +579,19 @@ class JobScheduler(EventEmitter):
         except Exception:
             return
         if result.jobId not in self.active_jobs:
-            return  # stale/duplicate completion
+            # stale/duplicate completion — but in the race window where the
+            # orphan sweep requeued this job just before its (successful)
+            # result arrived, a copy of an already-answered request is still
+            # sitting in the queue or on the retry ladder; purge it so it is
+            # never executed again. Purging IS this job's completion (the
+            # orphaned copy was its only live record), so count it.
+            if await self._drop_resolved(result.jobId):
+                self._jobs_total.inc(event="completed")
+                self.emit("job_completed", result)
+                self.request_dispatch()
+            return
         await self._clear_active(result.jobId, free_worker=True)
-        self.total_completed += 1
+        self._jobs_total.inc(event="completed")
         log.job("job completed", result.jobId, worker_id=result.workerId,
                 ms=round(result.processingTimeMs, 1))
         self.emit("job_completed", result)
@@ -454,6 +621,8 @@ class JobScheduler(EventEmitter):
                 qj = _QueuedJob(request, self._front_seq)
                 self.job_queue.insert(0, qj)
                 await self._persist_queued(qj)
+                self._jobs_total.inc(event="nacked")
+                self._begin_queue_span(request, nacked=True)
                 log.job("assignment NACKed; requeued (no retry consumed)",
                         result.jobId, worker_id=result.workerId, nacks=nacks)
                 self.request_dispatch()
@@ -465,18 +634,22 @@ class JobScheduler(EventEmitter):
             request.metadata["retryCount"] = retry_count + 1
             request.metadata["lastError"] = result.error
             delay_s = self.config.retry_delay_ms / 1000
+            self._jobs_total.inc(event="retried")
+            self.tracer.event(result.jobId, "scheduler.retry",
+                              attempt=retry_count + 1, error=result.error)
             log.job("job failed; retry scheduled", result.jobId,
                     attempt=retry_count + 1, delay_s=delay_s, error=result.error)
 
             def do_retry() -> None:
                 self._retry_handles.pop(result.jobId, None)
                 if self._running:
-                    asyncio.ensure_future(self.add_job(request))
+                    asyncio.ensure_future(self.add_job(request, requeue=True))
 
             loop = asyncio.get_running_loop()
             self._retry_handles[result.jobId] = loop.call_later(delay_s, do_retry)
         else:
-            self.total_failed += 1
+            self._jobs_total.inc(event="failed")
+            self.tracer.abort(result.jobId, reason="failed")
             log.job("job failed permanently", result.jobId, error=result.error)
             await self.bus.publish(f"job:result:{result.jobId}", result.model_dump_json())
             self.emit("job_failed", result)
@@ -494,21 +667,52 @@ class JobScheduler(EventEmitter):
 
     async def _handle_job_timeout(self, job_id: str) -> None:
         """Server-side job timeout (reference: JobScheduler.ts:516-551)."""
-        assignment = self.active_jobs.get(job_id)
+        # claim the assignment synchronously BEFORE any await: the
+        # waiter-side cancel_job(reason="timeout") can interleave during a
+        # bus suspension and this timeout must be accounted exactly once
+        assignment = self.active_jobs.pop(job_id, None)
         if assignment is None:
-            return  # already completed — benign
+            return  # already completed/cancelled — benign
+        self._jobs_total.inc(event="timeout")
+        # close any still-open spans for the job so a timeout storm cannot
+        # leak tracer state (asserted by the chaos tests)
+        self._end_queue_span(job_id, timeout=True)
+        self.tracer.abort(job_id, reason="timeout")
         log.job("job timed out", job_id, worker_id=assignment.workerId)
-        await self.bus.publish(
-            f"worker:{assignment.workerId}:job",
-            json.dumps({"type": "job_cancellation", "jobId": job_id, "reason": "timeout"}),
-        )
-        await self._clear_active(job_id, free_worker=True)
-        self.total_failed += 1
+        try:
+            await self.bus.publish(
+                f"worker:{assignment.workerId}:job",
+                json.dumps({"type": "job_cancellation", "jobId": job_id, "reason": "timeout"}),
+            )
+        finally:
+            # already claimed + accounted above — a dead bus must not skip
+            # the persisted-record/timer/worker cleanup
+            await self._clear_active(job_id, free_worker=True,
+                                     assignment=assignment)
         result = JobResult(jobId=job_id, workerId=assignment.workerId,
                            success=False, error="Job timed out")
         await self.bus.publish(f"job:result:{job_id}", result.model_dump_json())
         self.emit("job_timeout", result)
         self.request_dispatch()
+
+    async def _drop_resolved(self, job_id: str) -> bool:
+        """Remove every pending copy of a job whose result has already been
+        delivered (queued entry, persisted queue record, retry timer).
+        Returns True if a pending copy existed."""
+        retry = self._retry_handles.pop(job_id, None)
+        if retry is not None:
+            retry.cancel()
+        dropped = retry is not None
+        for i, qj in enumerate(self.job_queue):
+            if qj.request.id == job_id:
+                self.job_queue.pop(i)
+                await self.bus.hdel(JOB_QUEUE_KEY, job_id)
+                dropped = True
+                break
+        if dropped:
+            self._end_queue_span(job_id, resolved_elsewhere=True)
+            log.job("already-resolved job purged from queue", job_id)
+        return dropped
 
     # -- orphan machinery ---------------------------------------------------
     async def _on_worker_removed(self, worker_id: str, _info: WorkerInfo, reason: str) -> None:
@@ -539,6 +743,9 @@ class JobScheduler(EventEmitter):
         qj = _QueuedJob(request, self._front_seq)
         self.job_queue.insert(0, qj)
         await self._persist_queued(qj)
+        self._jobs_total.inc(event="orphaned")
+        self._begin_queue_span(request, orphaned=True,
+                               original_worker=assignment.workerId)
         log.job("job orphaned and requeued", job_id,
                 original_worker=assignment.workerId, reason=reason,
                 requeue_count=md["requeueCount"])
@@ -582,8 +789,12 @@ class JobScheduler(EventEmitter):
             json.dumps({"seq": qj.seq, "request": qj.request.model_dump(mode="json")}),
         )
 
-    async def _clear_active(self, job_id: str, free_worker: bool) -> None:
-        assignment = self.active_jobs.pop(job_id, None)
+    async def _clear_active(self, job_id: str, free_worker: bool,
+                            assignment: JobAssignment | None = None) -> None:
+        """``assignment`` carries a pre-popped entry: callers that must claim
+        the job synchronously before their first await pass it here so the
+        worker is still released."""
+        assignment = self.active_jobs.pop(job_id, None) or assignment
         await self.bus.hdel(ACTIVE_JOBS_KEY, job_id)
         handle = self._timeout_handles.pop(job_id, None)
         if handle is not None:

@@ -9,15 +9,40 @@ domain tags, JSON-ish single-line output, circular-safe serialization
 
 from __future__ import annotations
 
+import contextvars
 import json
 import logging
 import os
 import sys
 import time
-from typing import Any
+from contextlib import contextmanager
+from typing import Any, Iterator
 
 _LEVEL = os.environ.get("GRIDLLM_LOG_LEVEL", "info").upper()
 _CONFIGURED = False
+
+# Active request id (set while a trace span is open for the request, see
+# obs/tracer.py): every structured log record emitted inside the context
+# gains a request_id field, so log lines grep-join with span timelines.
+_REQUEST_ID: contextvars.ContextVar[str | None] = contextvars.ContextVar(
+    "gridllm_request_id", default=None
+)
+
+
+@contextmanager
+def bind_request_id(request_id: str | None) -> Iterator[None]:
+    """Attach ``request_id`` to all structured logs emitted in this context
+    (async-task-local via contextvars; engine threads are outside it and
+    keep passing ids explicitly)."""
+    token = _REQUEST_ID.set(request_id)
+    try:
+        yield
+    finally:
+        _REQUEST_ID.reset(token)
+
+
+def current_request_id() -> str | None:
+    return _REQUEST_ID.get()
 
 
 def _safe(obj: Any, _depth: int = 0) -> Any:
@@ -42,6 +67,9 @@ class StructuredLogger:
         self._log = logging.getLogger(name)
 
     def _emit(self, level: int, msg: str, kw: dict[str, Any]) -> None:
+        rid = _REQUEST_ID.get()
+        if rid is not None and "request_id" not in kw:
+            kw = {"request_id": rid, **kw}
         if kw:
             try:
                 msg = f"{msg} {json.dumps(_safe(kw), default=str)}"

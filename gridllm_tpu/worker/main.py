@@ -139,10 +139,18 @@ def build_health_app(service: WorkerService) -> web.Application:
             "models": list(service.engines),
         })
 
+    async def metrics(_):
+        # the process-global registry carries every worker-plane series:
+        # engine tokens/steps/KV pool, kernel-dispatch paths, bus, jobs
+        from gridllm_tpu.obs import PROMETHEUS_CONTENT_TYPE, default_registry
+
+        return web.Response(text=default_registry().render(),
+                            headers={"Content-Type": PROMETHEUS_CONTENT_TYPE})
+
     app.add_routes([
         web.get("/health", health), web.get("/health/live", live),
         web.get("/health/ready", ready), web.get("/health/system", system),
-        web.get("/worker/status", status),
+        web.get("/worker/status", status), web.get("/metrics", metrics),
     ])
     return app
 

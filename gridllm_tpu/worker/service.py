@@ -25,9 +25,10 @@ from typing import Any
 
 from gridllm_tpu.bus.base import MessageBus, Subscription
 from gridllm_tpu.engine import GenerationRequest, GenerationResult, InferenceEngine
+from gridllm_tpu.obs import Tracer, default_registry, trace_channel
 from gridllm_tpu.utils.config import WorkerConfig
 from gridllm_tpu.utils.events import EventEmitter
-from gridllm_tpu.utils.logging import get_logger
+from gridllm_tpu.utils.logging import bind_request_id, get_logger
 from gridllm_tpu.utils.types import (
     InferenceResponse,
     JobAssignment,
@@ -52,6 +53,15 @@ log = get_logger("worker")
 
 # single source of truth shared with the advertised maxConcurrentTasks
 _capacity = total_slots
+
+# Worker-plane job outcomes (process-global registry; the worker's health
+# port serves /metrics from it — worker/main.py)
+_JOBS_TOTAL = default_registry().counter(
+    "gridllm_worker_jobs_total",
+    "Jobs executed by worker services in this process, by outcome "
+    "(completed/failed/cancelled/nacked/duplicate_dropped).",
+    ("event",),
+)
 
 
 class NonRetryableJobError(RuntimeError):
@@ -97,7 +107,12 @@ class WorkerService(EventEmitter):
         self._subs: list[Subscription] = []
         self._tasks: list[asyncio.Task] = []
         self._cancelled: set[str] = set()
+        self._executing: set[str] = set()
         self._last_status: str | None = None
+        # per-request execution spans; published on trace:{request_id} when
+        # the job resolves so the gateway can stitch its side of the
+        # timeline with ours (obs/tracer.py)
+        self.tracer = Tracer(source=f"worker:{self.worker_id}")
 
     # ---------------------------------------------------------- lifecycle
 
@@ -365,12 +380,33 @@ class WorkerService(EventEmitter):
         if msg.get("type") != "job_assignment":
             return
         assignment = JobAssignment.model_validate(msg["job"])
+        if assignment.jobId in self._executing:
+            # re-dispatch of a job we are ALREADY running: the scheduler's
+            # orphan sweep re-orphans an in-flight job when first-compile
+            # GIL pressure starves our heartbeat past the disconnect
+            # window, then hands it straight back. The in-flight run will
+            # publish the result; running it twice would waste a slot and
+            # double-stream the client.
+            _JOBS_TOTAL.inc(event="duplicate_dropped")
+            self.tracer.event(assignment.jobId, "worker.duplicate_dropped",
+                              worker=self.worker_id)
+            log.warning("duplicate assignment dropped",
+                        jobId=assignment.jobId)
+            return
         if self.current_jobs >= self.max_concurrent:
             # NACK instead of the reference's silent drop
+            _JOBS_TOTAL.inc(event="nacked")
+            self.tracer.event(assignment.jobId, "worker.nack",
+                              worker=self.worker_id,
+                              currentJobs=self.current_jobs)
             await self._publish_failure(
                 assignment, "worker at capacity", nack=True
             )
+            await self._publish_trace(assignment.jobId)
             return
+        # marked HERE (not in _execute) so two back-to-back deliveries
+        # can't both pass the dedup check before either task starts
+        self._executing.add(assignment.jobId)
         asyncio.ensure_future(self._execute(assignment))
 
     def _resolve_name(self, model: str) -> str | None:
@@ -394,38 +430,74 @@ class WorkerService(EventEmitter):
     async def _execute(self, assignment: JobAssignment) -> None:
         req = assignment.request
         self.current_jobs += 1
-        await self._publish_status_if_changed()
         started = time.time()
-        self.emit("job_started", assignment)
+        span = self.tracer.begin(req.id, "worker.execute",
+                                 worker=self.worker_id, model=req.model,
+                                 requestType=req.request_type)
+        outcome = "failed"
+        # everything that can raise (bus publishes included) sits inside the
+        # try: the finally MUST run, or req.id leaks in _executing and every
+        # future re-dispatch of this job is dropped as a duplicate
         try:
-            engine = self._resolve_engine(req.model)
-            if engine is None:
-                raise ValueError(f"model not served here: {req.model}")
-            rtype = req.request_type
-            if rtype == "embedding":
-                response = await self._run_embedding(engine, req)
-            else:
-                response = await self._run_generation(engine, assignment)
-            if response is None:  # cancelled — scheduler already resolved it
-                return
-            result = JobResult(
-                jobId=req.id, workerId=self.worker_id, success=True,
-                response=response,
-                processingTimeMs=(time.time() - started) * 1000,
-            )
-            self.total_processed += 1
-            await self.bus.publish("job:completed", result.model_dump_json())
-            await self.bus.publish(f"job:result:{req.id}", result.model_dump_json())
-            self.emit("job_completed", result)
+            await self._publish_status_if_changed()
+            self.emit("job_started", assignment)
+            with bind_request_id(req.id):
+                engine = self._resolve_engine(req.model)
+                if engine is None:
+                    raise ValueError(f"model not served here: {req.model}")
+                rtype = req.request_type
+                if rtype == "embedding":
+                    response = await self._run_embedding(engine, req)
+                else:
+                    response = await self._run_generation(engine, assignment)
+                if response is None:
+                    # cancelled — scheduler already resolved it
+                    outcome = "cancelled"
+                    return
+                result = JobResult(
+                    jobId=req.id, workerId=self.worker_id, success=True,
+                    response=response,
+                    processingTimeMs=(time.time() - started) * 1000,
+                )
+                await self.bus.publish("job:completed", result.model_dump_json())
+                await self.bus.publish(f"job:result:{req.id}", result.model_dump_json())
+                # only after BOTH publishes: a publish failure goes down the
+                # retryable-failure path and must not be recorded completed
+                self.total_processed += 1
+                outcome = "completed"
+                self.emit("job_completed", result)
         except Exception as e:
             log.warning("job failed", jobId=req.id, error=str(e))
+            span.meta["error"] = str(e)
             await self._publish_failure(
                 assignment, str(e),
                 retryable=not isinstance(e, NonRetryableJobError),
             )
         finally:
+            # local bookkeeping first — it must survive a dead bus; the
+            # status publish goes last because it can raise on bus loss
+            # (_publish_trace guards internally)
+            self._executing.discard(req.id)
             self.current_jobs -= 1
+            _JOBS_TOTAL.inc(event=outcome)
+            self.tracer.end(span, outcome=outcome)
+            await self._publish_trace(req.id)
             await self._publish_status_if_changed()
+
+    async def _publish_trace(self, request_id: str) -> None:
+        """Seal the request's span timeline and ship it to the gateway."""
+        spans = self.tracer.finish(request_id)
+        if not spans:
+            return
+        try:
+            await self.bus.publish(trace_channel(request_id), json.dumps({
+                "requestId": request_id,
+                "workerId": self.worker_id,
+                "spans": spans,
+            }))
+        except Exception as e:  # noqa: BLE001 — tracing must never fail a job
+            log.warning("trace publish failed", request_id=request_id,
+                        error=str(e))
 
     async def _publish_failure(
         self, assignment: JobAssignment, error: str, nack: bool = False,
@@ -446,7 +518,8 @@ class WorkerService(EventEmitter):
         single = isinstance(texts, str)
         texts = [texts] if single else list(texts or [])
         t0 = time.perf_counter_ns()
-        vecs = await asyncio.to_thread(engine.embed, texts)
+        with self.tracer.span(req.id, "engine.embed", texts=len(texts)):
+            vecs = await asyncio.to_thread(engine.embed, texts)
         dur = time.perf_counter_ns() - t0
         return InferenceResponse(
             id=req.id, model=req.model, created_at=iso_now(), done=True,
@@ -514,6 +587,8 @@ class WorkerService(EventEmitter):
                 prompt, add_bos=False
             )
         engine.submit(gen)
+        t_submit = time.time()
+        t_first: float | None = None
 
         buf = ""
         eval_count = 0
@@ -526,9 +601,32 @@ class WorkerService(EventEmitter):
                 await self._flush_stream(req, buf, eval_count)
                 buf, last_flush = "", time.monotonic()
                 continue
+            if delta and t_first is None:
+                # only a frame actually carrying a token counts — a bare
+                # done frame (cancel/error/immediate EOS) must not leave a
+                # fake first-token mark on the trace
+                t_first = time.time()
+                self.tracer.event(req.id, "worker.first_token",
+                                  sinceSubmitMs=round(
+                                      (t_first - t_submit) * 1000, 3))
             buf += delta
             if done:
                 assert res is not None
+                # engine-stage spans: submit→first-token is the honest
+                # prefill wait (queue + compile + prefill dispatch),
+                # first-token→done the decode stretch; engine-measured ns
+                # ride in meta for exact attribution
+                now = time.time()
+                tf = t_first if t_first is not None else now
+                self.tracer.record(
+                    req.id, "engine.prefill", t_submit, tf,
+                    promptTokens=res.prompt_eval_count,
+                    engineNs=res.prompt_eval_duration_ns)
+                if res.eval_count:
+                    self.tracer.record(
+                        req.id, "engine.decode", tf, now,
+                        tokens=res.eval_count,
+                        engineNs=res.eval_duration_ns)
                 if res.done_reason == "cancel":
                     return None
                 if res.done_reason == "error":

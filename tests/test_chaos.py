@@ -133,6 +133,17 @@ async def test_worker_sigkill_mid_job_recovers_on_second_worker():
             w.workerId != victim_id
             for w in registry.get_online_workers()
         )
+        # observability (ISSUE 1): the recovery is visible in the metrics —
+        # the job was orphaned then completed — and the failure storm left
+        # no leaked active span in the tracer
+        stats = scheduler.get_stats()
+        assert stats["totalJobsOrphaned"] >= 1
+        assert stats["totalJobsCompleted"] == 1
+        assert scheduler.tracer.active_count() == 0, (
+            scheduler.tracer.active_ids())
+        text = scheduler.metrics.render()
+        assert 'gridllm_scheduler_jobs_total{event="orphaned"}' in text
+        assert 'gridllm_workers_removed_total' in text
     finally:
         if child.poll() is None:
             child.kill()

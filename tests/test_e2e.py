@@ -207,3 +207,56 @@ async def test_images_travel_to_engine_and_reject_loudly(tiny_engine):
         assert resp.status == 200 and (await resp.json())["done"]
     finally:
         await _teardown(registry, scheduler, worker, client, bus)
+
+
+async def test_metrics_and_trace_through_real_engine(tiny_engine):
+    """ISSUE 1 acceptance: after a request served by the REAL engine worker,
+    /metrics carries engine token counters, KV page-pool gauges, and
+    kernel-dispatch counters, and /admin/trace/{id} returns a stitched
+    gateway+worker timeline including the engine stage spans."""
+    bus, registry, scheduler, worker, client = await _stack(tiny_engine)
+    try:
+        resp = await client.post("/ollama/api/generate", json={
+            "model": MODEL, "prompt": "observe me",
+            "options": {"temperature": 0, "num_predict": 6},
+        })
+        assert resp.status == 200
+        lines = [json.loads(l) for l in (await resp.text()).strip().splitlines()]
+        assert lines[-1]["done"] is True
+        await bus.flush()
+
+        text = await (await client.get("/metrics")).text()
+        # engine token counters (process-global registry)
+        assert f'gridllm_engine_tokens_total{{model="{MODEL}",kind="decode"}}' in text
+        assert f'gridllm_engine_tokens_total{{model="{MODEL}",kind="prefill"}}' in text
+        # KV page-pool gauges: pool fully free again after the request
+        assert f'gridllm_engine_kv_pages_used{{model="{MODEL}"}} 0' in text
+        assert f'gridllm_engine_kv_pages_free{{model="{MODEL}"}} 64' in text
+        # kernel-vs-jnp dispatch counters (jnp fallback on the CPU backend)
+        assert 'gridllm_kernel_dispatch_total{op="attention_decode",path="jnp"}' in text
+        # engine step/occupancy histograms populated
+        assert f'gridllm_engine_step_duration_seconds_count{{model="{MODEL}"}}' in text
+        assert f'gridllm_engine_batch_occupancy_count{{model="{MODEL}"}}' in text
+        # worker-plane job outcomes
+        assert 'gridllm_worker_jobs_total{event="completed"}' in text
+        # TTFT histogram fed by the streaming path
+        assert f'gridllm_request_ttft_seconds_count{{model="{MODEL}"}} 1' in text
+
+        # the stitched trace: gateway + worker sources, engine stage spans
+        ids = scheduler.tracer.ids()
+        assert ids
+        body = await (await client.get(f"/admin/trace/{ids[-1]}")).json()
+        names = [s["name"] for s in body["spans"]]
+        for expected in ("gateway.request", "queue.wait", "scheduler.dispatch",
+                         "gateway.first_token", "worker.execute",
+                         "worker.first_token", "engine.prefill",
+                         "engine.decode"):
+            assert expected in names, (expected, names)
+        assert any(s.startswith("worker:") for s in body["sources"])
+        decode = next(s for s in body["spans"] if s["name"] == "engine.decode")
+        assert decode["meta"]["tokens"] == 6
+        # no leaked active spans on either side
+        assert scheduler.tracer.active_count() == 0
+        assert worker.tracer.active_count() == 0
+    finally:
+        await _teardown(registry, scheduler, worker, client, bus)

@@ -1,0 +1,455 @@
+"""Observability subsystem tests (ISSUE 1): metric encoding golden strings,
+tracer span stitching over the in-memory bus, gateway /metrics +
+/admin/trace integration with a REAL engine worker, and the timeout
+chaos assertion (counter increments, no leaked active span)."""
+
+import asyncio
+import json
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from gridllm_tpu.bus.memory import InMemoryBus
+from gridllm_tpu.gateway.app import create_app
+from gridllm_tpu.obs import MetricsRegistry, Tracer, trace_channel
+from gridllm_tpu.scheduler import JobScheduler, WorkerRegistry
+from gridllm_tpu.utils.config import Config
+from gridllm_tpu.utils.types import InferenceRequest
+
+from .helpers import FakeWorker, fast_config
+
+# ---------------------------------------------------------------------------
+# metrics: instruments + Prometheus text encoding
+# ---------------------------------------------------------------------------
+
+
+def test_counter_encoding_golden():
+    reg = MetricsRegistry()
+    c = reg.counter("http_requests_total", "Total requests.",
+                    ("route", "status"))
+    c.inc(route="/api/generate", status="200")
+    c.inc(2, route="/api/generate", status="200")
+    c.inc(route="/v1/models", status="404")
+    assert reg.render() == (
+        "# HELP http_requests_total Total requests.\n"
+        "# TYPE http_requests_total counter\n"
+        'http_requests_total{route="/api/generate",status="200"} 3\n'
+        'http_requests_total{route="/v1/models",status="404"} 1\n'
+    )
+
+
+def test_gauge_encoding_and_ops():
+    reg = MetricsRegistry()
+    g = reg.gauge("queue_depth", "Queued jobs.")
+    g.set(4)
+    g.inc()
+    g.dec(2)
+    assert g.value() == 3
+    assert reg.render() == (
+        "# HELP queue_depth Queued jobs.\n"
+        "# TYPE queue_depth gauge\n"
+        "queue_depth 3\n"
+    )
+
+
+def test_histogram_bucketing_and_encoding_golden():
+    reg = MetricsRegistry()
+    h = reg.histogram("latency_seconds", "Latency.", ("op",),
+                      buckets=(0.1, 1.0, 5.0))
+    for v in (0.05, 0.5, 0.7, 3.0, 99.0):
+        h.observe(v, op="gen")
+    assert h.count(op="gen") == 5
+    assert h.sum(op="gen") == pytest.approx(103.25)
+    assert reg.render() == (
+        "# HELP latency_seconds Latency.\n"
+        "# TYPE latency_seconds histogram\n"
+        'latency_seconds_bucket{op="gen",le="0.1"} 1\n'
+        'latency_seconds_bucket{op="gen",le="1"} 3\n'
+        'latency_seconds_bucket{op="gen",le="5"} 4\n'
+        'latency_seconds_bucket{op="gen",le="+Inf"} 5\n'
+        'latency_seconds_sum{op="gen"} 103.25\n'
+        'latency_seconds_count{op="gen"} 5\n'
+    )
+
+
+def test_label_escaping():
+    reg = MetricsRegistry()
+    c = reg.counter("weird_total", "Weird labels.", ("msg",))
+    c.inc(msg='say "hi"\nback\\slash')
+    out = reg.render()
+    assert 'msg="say \\"hi\\"\\nback\\\\slash"' in out
+
+
+def test_get_or_create_idempotent_and_type_safe():
+    reg = MetricsRegistry()
+    c1 = reg.counter("things_total", "Things.", ("kind",))
+    c2 = reg.counter("things_total", "Things.", ("kind",))
+    assert c1 is c2
+    with pytest.raises(ValueError):
+        reg.gauge("things_total", "Things.")
+    with pytest.raises(ValueError):
+        reg.counter("things_total", "Things.", ("other",))
+
+
+def test_collector_runs_at_render_and_is_replaceable():
+    reg = MetricsRegistry()
+    g = reg.gauge("depth", "Depth.")
+    reg.add_collector("src", lambda: g.set(7))
+    assert "depth 7" in reg.render()
+    reg.add_collector("src", lambda: g.set(9))  # latest wins
+    assert "depth 9" in reg.render()
+
+    def boom() -> None:
+        raise RuntimeError("dead stack")
+
+    reg.add_collector("src", boom)  # a dead collector must not break scrape
+    assert "depth 9" in reg.render()
+
+
+# ---------------------------------------------------------------------------
+# tracer
+# ---------------------------------------------------------------------------
+
+
+def test_tracer_span_lifecycle_and_leak_free_abort():
+    t = Tracer(source="gateway")
+    root = t.begin("r1", "gateway.request", model="m1")
+    with t.span("r1", "queue.wait"):
+        pass
+    assert t.active_count() == 1  # root still open
+    t.end(root, outcome="success")
+    assert t.active_count() == 0
+    spans = t.finish("r1")
+    assert [s["name"] for s in spans] == ["gateway.request", "queue.wait"]
+    assert spans[0]["meta"]["outcome"] == "success"
+
+    # abort closes open spans and marks them
+    t2 = Tracer(source="gateway")
+    t2.begin("r2", "gateway.request")
+    t2.abort("r2", reason="timeout")
+    assert t2.active_count() == 0
+    spans = t2.export("r2")
+    assert spans[0]["meta"]["aborted"] is True
+    assert spans[0]["meta"]["reason"] == "timeout"
+
+
+def test_tracer_lru_eviction():
+    t = Tracer(source="gateway", max_traces=2)
+    for i in range(4):
+        t.event(f"r{i}", "e")
+        t.finish(f"r{i}")
+    assert t.ids() == ["r2", "r3"]
+    assert t.export("r0") is None
+
+
+def test_histogram_bucket_mismatch_raises():
+    reg = MetricsRegistry()
+    h = reg.histogram("occ", "Occ.", buckets=(1.0, 2.0))
+    assert reg.histogram("occ", "Occ.", buckets=(2.0, 1.0)) is h  # same set
+    with pytest.raises(ValueError):
+        reg.histogram("occ", "Occ.", buckets=(1.0, 3.0))
+
+
+def test_tracer_post_seal_spans_fold_into_done():
+    """Spans recorded after finish() (a retry event landing once the waiter
+    timed out and sealed the trace) must join the finished timeline, not
+    strand in the unsealed buffer forever."""
+    t = Tracer(source="gateway")
+    t.event("r1", "a")
+    t.finish("r1")
+    t.event("r1", "scheduler.retry")
+    assert [s["name"] for s in t.export("r1")] == ["a", "scheduler.retry"]
+    assert not t._closed
+    # a queue span opened+ended after the seal folds the same way
+    s = t.begin("r1", "queue.wait")
+    t.end(s)
+    assert not t._closed and t.active_count() == 0
+    assert [s["name"] for s in t.export("r1")] == [
+        "a", "scheduler.retry", "queue.wait"]
+
+
+def test_tracer_closed_buffer_hard_cap():
+    """Requests that never reach a terminal seal cannot grow the unsealed
+    buffer without bound — overflow force-seals oldest-first."""
+    t = Tracer(source="gateway", max_traces=2)
+    for i in range(5):
+        t.event(f"r{i}", "e")
+    assert len(t._closed) == 2
+    assert t.ids() == ["r1", "r2"]  # r0 force-sealed then LRU-evicted
+
+
+def test_tracer_late_end_metadata_survives_seal_race():
+    """The scheduler's failure handler aborts the trace before the waiter's
+    finally ends the root span — the waiter's outcome must land anyway."""
+    t = Tracer(source="gateway")
+    root = t.begin("r1", "gateway.request")
+    t.abort("r1", reason="failed")
+    t.end(root, outcome="failed")
+    spans = t.export("r1")
+    assert spans[0]["meta"]["outcome"] == "failed"
+    assert "aborted" not in spans[0]["meta"]
+    assert t.active_count() == 0
+
+
+def test_tracer_ingest_replaces_same_source():
+    """A re-publication (full timeline each time) replaces that source's
+    spans instead of duplicating them; other sources are untouched."""
+    t = Tracer(source="gateway")
+    t.ingest("r1", [
+        {"name": "worker.nack", "source": "worker:w1", "start": 1.0, "end": 1.0},
+    ])
+    t.ingest("r1", [
+        {"name": "worker.nack", "source": "worker:w1", "start": 1.0, "end": 1.0},
+        {"name": "worker.execute", "source": "worker:w1", "start": 2.0, "end": 3.0},
+    ])
+    assert [s["name"] for s in t.export("r1")] == [
+        "worker.nack", "worker.execute"]
+    t.ingest("r1", [
+        {"name": "worker.execute", "source": "worker:w2", "start": 4.0, "end": 5.0},
+    ])
+    assert [s["source"] for s in t.export("r1")] == [
+        "worker:w1", "worker:w1", "worker:w2"]
+
+
+async def test_span_stitching_across_in_memory_bus():
+    """Worker-side tracer publishes on trace:{id}; the scheduler's psubscribe
+    ingests it into the gateway tracer → one merged timeline."""
+    bus = InMemoryBus(key_prefix="G:")
+    await bus.connect()
+    cfg = fast_config()
+    registry = WorkerRegistry(bus, cfg)
+    scheduler = JobScheduler(bus, registry, cfg)
+    await registry.initialize()
+    await scheduler.initialize()
+
+    # gateway-side spans for a request
+    root = scheduler.tracer.begin("req-1", "gateway.request")
+    scheduler.tracer.end(root)
+    scheduler.tracer.finish("req-1")
+
+    # worker-side tracer on the other end of the bus
+    wt = Tracer(source="worker:w9")
+    with wt.span("req-1", "worker.execute", model="m1"):
+        wt.event("req-1", "worker.first_token")
+    spans = wt.finish("req-1")
+    await bus.publish(trace_channel("req-1"), json.dumps(
+        {"requestId": "req-1", "workerId": "w9", "spans": spans}))
+    await bus.flush()
+
+    timeline = scheduler.tracer.export("req-1")
+    names = [s["name"] for s in timeline]
+    sources = {s["source"] for s in timeline}
+    assert "gateway.request" in names
+    assert "worker.execute" in names and "worker.first_token" in names
+    assert sources == {"gateway", "worker:w9"}
+    # chronological order
+    starts = [s["start"] for s in timeline]
+    assert starts == sorted(starts)
+
+    await scheduler.shutdown()
+    await registry.shutdown()
+    await bus.disconnect()
+
+
+# ---------------------------------------------------------------------------
+# gateway integration: /metrics + /admin/trace with the stub worker
+# ---------------------------------------------------------------------------
+
+
+async def _make_stack():
+    bus = InMemoryBus(key_prefix="G:")
+    await bus.connect()
+    cfg = fast_config()
+    registry = WorkerRegistry(bus, cfg)
+    scheduler = JobScheduler(bus, registry, cfg)
+    await registry.initialize()
+    await scheduler.initialize()
+    app = create_app(bus, registry, scheduler, Config(scheduler=cfg))
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    return client, bus, registry, scheduler
+
+
+async def _teardown(client, bus, registry, scheduler, *workers):
+    for w in workers:
+        await w.stop(announce=False)
+    await client.close()
+    await scheduler.shutdown()
+    await registry.shutdown()
+    await bus.disconnect()
+
+
+class TracingFakeWorker(FakeWorker):
+    """FakeWorker that also publishes worker-side spans, like WorkerService."""
+
+    def __init__(self, *a, **kw):
+        super().__init__(*a, **kw)
+        self.tracer = Tracer(source=f"worker:{self.worker_id}")
+
+    async def _execute(self, assignment):
+        span = self.tracer.begin(assignment.jobId, "worker.execute",
+                                 worker=self.worker_id,
+                                 model=assignment.request.model)
+        try:
+            await super()._execute(assignment)
+        finally:
+            self.tracer.end(span)
+            spans = self.tracer.finish(assignment.jobId)
+            await self.bus.publish(trace_channel(assignment.jobId), json.dumps({
+                "requestId": assignment.jobId,
+                "workerId": self.worker_id,
+                "spans": spans,
+            }))
+
+
+async def test_gateway_metrics_and_trace_after_completed_request():
+    client, bus, registry, scheduler = await _make_stack()
+    w = TracingFakeWorker(bus, "w1", ["m1"], stream_tokens=["a", "b", "c"])
+    await w.start()
+    await bus.flush()
+
+    # streaming request → TTFT observed from the first stream frame
+    resp = await client.post("/ollama/api/generate",
+                             json={"model": "m1", "prompt": "go"})
+    assert resp.status == 200
+    await resp.text()
+    await bus.flush()
+
+    resp = await client.get("/metrics")
+    assert resp.status == 200
+    assert "text/plain" in resp.headers["Content-Type"]
+    text = await resp.text()
+
+    # request counters labeled by route/status
+    assert ('gridllm_gateway_requests_total{route="/ollama/api/generate",'
+            'method="POST",status="200"} 1') in text
+    # TTFT histogram non-empty
+    assert 'gridllm_request_ttft_seconds_count{model="m1"} 1' in text
+    # scheduler lifecycle counters + queue gauge
+    assert 'gridllm_scheduler_jobs_total{event="completed"} 1' in text
+    assert 'gridllm_scheduler_jobs_total{event="dispatched"} 1' in text
+    assert "gridllm_scheduler_queue_depth 0" in text
+    assert 'gridllm_scheduler_worker_assignments_total{worker="w1"} 1' in text
+    # worker liveness gauge (registry collector; no redundant "total"
+    # series — sum(gridllm_workers) must equal the fleet size)
+    assert 'gridllm_workers{status="online"} 1' in text
+    assert 'gridllm_workers{status="total"}' not in text
+    # queue-wait histogram recorded
+    assert "gridllm_scheduler_queue_wait_seconds_count 1" in text
+    # bus counters (process-global registry, concatenated into the scrape)
+    assert "gridllm_bus_messages_published_total" in text
+
+    # health snapshots read the SAME counters (satellite: cannot disagree)
+    stats = (await (await client.get("/health/jobs")).json())["stats"]
+    assert stats["totalJobsProcessed"] == 1
+    assert stats["totalJobsCompleted"] == 1
+    assert stats["totalJobsTimedOut"] == 0
+
+    # stitched gateway+worker trace
+    ids = scheduler.tracer.ids()
+    assert len(ids) == 1
+    resp = await client.get(f"/admin/trace/{ids[0]}")
+    assert resp.status == 200
+    body = await resp.json()
+    names = [s["name"] for s in body["spans"]]
+    assert "gateway.request" in names
+    assert "queue.wait" in names
+    assert "scheduler.dispatch" in names
+    assert "gateway.first_token" in names
+    assert "worker.execute" in names
+    assert set(body["sources"]) == {"gateway", "worker:w1"}
+
+    # unknown id → 404 envelope
+    resp = await client.get("/admin/trace/nope")
+    assert resp.status == 404
+
+    await _teardown(client, bus, registry, scheduler, w)
+
+
+async def test_request_latency_histogram_by_route():
+    client, bus, registry, scheduler = await _make_stack()
+    for _ in range(3):
+        assert (await client.get("/health")).status == 200
+    text = await (await client.get("/metrics")).text()
+    assert ('gridllm_gateway_request_duration_seconds_count'
+            '{route="/health"} 3') in text
+    # unmatched paths collapse into one label value (bounded cardinality)
+    await client.get("/definitely/not/a/route")
+    text = await (await client.get("/metrics")).text()
+    assert ('gridllm_gateway_requests_total{route="unmatched",'
+            'method="GET",status="404"} 1') in text
+    await _teardown(client, bus, registry, scheduler)
+
+
+# ---------------------------------------------------------------------------
+# chaos: timeouts increment the counter and leak no active span
+# ---------------------------------------------------------------------------
+
+
+async def test_timeout_increments_counter_and_leaks_no_span():
+    client, bus, registry, scheduler = await _make_stack()
+    # worker that sits on the job far past the submit timeout
+    w = FakeWorker(bus, "w1", ["m1"], delay_s=30)
+    await w.start()
+    await bus.flush()
+
+    from gridllm_tpu.scheduler.scheduler import JobTimeoutError
+
+    req = InferenceRequest(id="job-timeout-1", model="m1", prompt="x")
+    with pytest.raises(JobTimeoutError):
+        await scheduler.submit_and_wait(req, timeout_ms=200)
+    await bus.flush()
+
+    stats = scheduler.get_stats()
+    assert stats["totalJobsTimedOut"] == 1
+    assert stats["totalJobsFailed"] == 1  # timeouts count as failures
+    assert stats["totalJobsProcessed"] == 0
+    text = scheduler.metrics.render()
+    assert 'gridllm_scheduler_jobs_total{event="timeout"} 1' in text
+    # no leaked active span anywhere (root + queue spans all sealed)
+    assert scheduler.tracer.active_count() == 0, scheduler.tracer.active_ids()
+    # the trace survives, marked aborted
+    spans = scheduler.tracer.export("job-timeout-1")
+    assert spans is not None
+    root = next(s for s in spans if s["name"] == "gateway.request")
+    assert root["meta"]["outcome"] == "timeout"
+
+    await _teardown(client, bus, registry, scheduler, w)
+
+
+async def test_server_side_timeout_timer_path():
+    """The armed per-job timer (not the waiter) also counts + cleans up."""
+    client, bus, registry, scheduler = await _make_stack()
+    w = FakeWorker(bus, "w1", ["m1"], delay_s=30)
+    await w.start()
+    await bus.flush()
+
+    req = InferenceRequest(id="job-timer-1", model="m1", prompt="x",
+                           timeout=150)
+    await scheduler.add_job(req)
+    for _ in range(60):
+        await asyncio.sleep(0.05)
+        if scheduler.get_stats()["totalJobsTimedOut"]:
+            break
+    assert scheduler.get_stats()["totalJobsTimedOut"] == 1
+    assert scheduler.tracer.active_count() == 0
+    # the counter increments before the cancellation publish is delivered —
+    # drain the bus so the worker has seen it
+    await bus.flush()
+    assert w.cancelled == ["job-timer-1"]  # worker told to drop it
+
+    await _teardown(client, bus, registry, scheduler, w)
+
+
+async def test_worker_removal_counter():
+    client, bus, registry, scheduler = await _make_stack()
+    w = FakeWorker(bus, "w1", ["m1"])
+    await w.start()
+    await bus.flush()
+    await w.stop()  # announces unregistered
+    await bus.flush()
+    text = scheduler.metrics.render()
+    assert ('gridllm_workers_removed_total{reason="unregistered"} 1'
+            in text)
+    assert 'gridllm_workers{status="online"} 0' in text
+    await _teardown(client, bus, registry, scheduler)
