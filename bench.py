@@ -226,6 +226,8 @@ async def _run_bench_inner(client_ctx, engine, model, n_requests, n_tokens,
     await client.close()  # remaining teardown is run_bench's finally
 
     stages = {}
+    slo_attainment = None
+    goodput_tok_s = None
     if scheduler is not None:
         # worker-side spans publish on trace:{id} AFTER job:result resolves
         # the HTTP stream — drain the bus so the tail requests' prefill/
@@ -235,6 +237,13 @@ async def _run_bench_inner(client_ctx, engine, model, n_requests, n_tokens,
             await flush()
         measured = [r for r in scheduler.tracer.ids() if r not in warm_ids]
         stages = _stage_stats(scheduler.tracer, measured)
+        # SLO/goodput from the obs SLO engine (ISSUE 2): the measured
+        # streams are the "interactive" class (the warmup is non-streaming
+        # → "batch", so it does not pollute these numbers)
+        inter = scheduler.slo.snapshot()["classes"].get("interactive") or {}
+        slo_attainment = inter.get("attainment")
+        if inter.get("goodputTokens") is not None:
+            goodput_tok_s = inter["goodputTokens"] / wall
     return {
         "tok_s": tokens_out[0] / wall,
         "p50_ttft_ms": statistics.median(ttfts) * 1000,
@@ -242,6 +251,8 @@ async def _run_bench_inner(client_ctx, engine, model, n_requests, n_tokens,
         "tokens": tokens_out[0],
         "wall_s": wall,
         "stages": stages,
+        "slo_attainment": slo_attainment,
+        "goodput_tok_s": goodput_tok_s,
         "weights": "real-checkpoint" if ckpt else "random-weights synthetic",
     }
 
@@ -367,13 +378,22 @@ def main() -> int:
         # beats silently never writing the trace
         ap.error("--profile is only supported on the generate bench")
 
-    errors: list[str] = []
+    # structured run health (ISSUE 2 satellite — replaces the ||-joined
+    # error string): `attempts` logs every stage that failed along the way,
+    # `fallback` names a degraded execution path actually taken,
+    # `degraded` flags a number that must not be read as the requested
+    # config's. The driver still gets exactly one JSON line.
+    attempts: list[dict] = []
+    degraded = False
+    fallback = None
     if args.tiny:
         platform = "cpu"
     else:
         platform, diags = probe_backend()
-        if any("ok" not in d for d in diags[:1]) or platform == "cpu":
-            errors.extend(d for d in diags if "ok" not in d)
+        attempts.extend(
+            {"stage": "backend_probe", "detail": d}
+            for d in diags if "ok" not in d
+        )
     if platform == "cpu":
         # degraded mode: still produce a number, flagged via "error".
         # The env may force-register an accelerator plugin at the jax
@@ -393,9 +413,12 @@ def main() -> int:
         if not args.tiny:
             # flag the substitution even when the CPU probe itself was
             # healthy — a tiny-model number must never read as `requested`
-            errors.append(
-                f"degraded: cpu fallback, {requested} replaced with {args.model}"
-            )
+            degraded = True
+            attempts.append({
+                "stage": "degrade",
+                "detail": f"cpu fallback, {requested} replaced "
+                          f"with {args.model}",
+            })
 
     metric_name = (  # provisional — refined with weights provenance below
         f"embeddings/sec via /ollama/api/embed ({args.model})" if args.embed
@@ -429,10 +452,8 @@ def main() -> int:
                 # hardware (interpret-mode tests can't catch every Mosaic
                 # behavior) must degrade to the jnp path and still produce
                 # an honest TPU number, not a 0.0 — flagged in the metric
-                errors.append(
-                    f"kernel path failed ({msg}); retrying with "
-                    "GRIDLLM_PALLAS=0"
-                )
+                fallback = "pallas-disabled"
+                attempts.append({"stage": "kernel_path", "error": msg})
                 # drop the traceback BEFORE the retry: it pins the failed
                 # run's engine (weights + KV pool in HBM) via its frames
                 first_err = None
@@ -463,12 +484,15 @@ def main() -> int:
         import traceback
 
         tb = traceback.format_exc().strip().splitlines()
-        errors.append(f"{type(e).__name__}: {e}")
-        errors.extend(tb[-3:])
+        attempts.append({"stage": "run",
+                         "error": f"{type(e).__name__}: {e}",
+                         "traceback": tb[-3:]})
         emit({
             "metric": metric_name, "value": 0.0,
             "unit": "embeddings/s" if args.embed else "tok/s",
-            "vs_baseline": 0.0, "error": " || ".join(errors),
+            "vs_baseline": 0.0, "error": f"{type(e).__name__}: {e}",
+            "attempts": attempts, "degraded": degraded,
+            "fallback": fallback,
         })
         return 0  # JSON line emitted — that is the contract
     payload = {
@@ -478,6 +502,7 @@ def main() -> int:
         "vs_baseline": round(value / baseline, 3) if baseline else None,
         "platform": platform,
         "wall_s": round(r["wall_s"], 2),
+        "degraded": degraded,
     }
     if not args.embed:
         payload["p50_ttft_ms"] = round(r["p50_ttft_ms"], 1)
@@ -488,10 +513,16 @@ def main() -> int:
             # per-stage breakdown from the obs tracer (queue-wait/prefill/
             # decode p50s) — explains the end-to-end numbers above
             payload["stages"] = r["stages"]
+        if r.get("slo_attainment") is not None:
+            payload["slo_attainment"] = round(r["slo_attainment"], 4)
+        if r.get("goodput_tok_s") is not None:
+            payload["goodput_tok_s"] = round(r["goodput_tok_s"], 2)
     else:
         payload["texts"] = r["texts"]
-    if errors:
-        payload["error"] = " || ".join(errors)
+    if fallback:
+        payload["fallback"] = fallback
+    if attempts:
+        payload["attempts"] = attempts
     emit(payload)
     return 0
 

@@ -54,7 +54,7 @@ import numpy as np
 from gridllm_tpu.engine.tokenizer import DetokState, Tokenizer, get_tokenizer
 from gridllm_tpu.models import llama
 from gridllm_tpu.models.configs import ModelConfig, get_config
-from gridllm_tpu.obs import SIZE_BUCKETS, default_registry
+from gridllm_tpu.obs import SIZE_BUCKETS, default_flight_recorder, default_registry
 from gridllm_tpu.ops.kvcache import PagedKVCache, PageAllocator
 from gridllm_tpu.ops.sampling import (
     SamplingParams,
@@ -97,6 +97,11 @@ _KV_PAGES_FREE = _OBS.gauge(
     "gridllm_engine_kv_pages_free", "KV page-pool pages free, by model.",
     ("model",),
 )
+# flight recorder (obs/flightrec.py): lifecycle events land in the "engine"
+# ring; block dispatches are SAMPLED (one record per _FLIGHT_SAMPLE
+# generations) so the hot loop stays a deque append every few dozen steps
+_FLIGHTREC = default_flight_recorder()
+_FLIGHT_SAMPLE = 16
 
 
 def _model_module(cfg: ModelConfig):
@@ -195,7 +200,7 @@ class _Slot:
     __slots__ = (
         "req", "ids", "prompt_len", "generated", "detok", "text", "emitted_len",
         "num_predict", "stop_seqs", "eos_ids", "capacity", "joined_gen",
-        "t_start", "t_prefill_ns", "t_first_decode",
+        "t_start", "t_prefill_ns", "t_first_decode", "t_last_ingest",
     )
 
     def __init__(self, req: GenerationRequest, ids: list[int], capacity: int,
@@ -219,6 +224,7 @@ class _Slot:
         self.t_start = time.perf_counter_ns()
         self.t_prefill_ns = 0
         self.t_first_decode = 0
+        self.t_last_ingest = 0.0  # epoch seconds of last host-visible token
 
     def holdback(self) -> int:
         """Chars at the tail of `text` that could still become a stop
@@ -729,6 +735,8 @@ class InferenceEngine:
         st.joined_gen = self._gen + 1  # first block dispatched after this
         self._slots[slot] = st
         _TOKENS_TOTAL.inc(len(ids), model=self.cfg.name, kind="prefill")
+        _FLIGHTREC.record("engine", "admit", model=self.cfg.name,
+                          request=req.id, slot=slot, promptTokens=len(ids))
         self._update_kv_gauges()
         return True
 
@@ -924,6 +932,9 @@ class InferenceEngine:
         self._update_kv_gauges()
         del self._slots[slot]
         self._free_slots.append(slot)
+        _FLIGHTREC.record("engine", "finish", model=self.cfg.name,
+                          request=st.req.id, slot=slot, reason=reason,
+                          tokens=len(st.generated))
         if st.req.on_chunk:
             st.req.on_chunk(last_delta, True, res)
 
@@ -932,6 +943,11 @@ class InferenceEngine:
         with self.dispatch_lock:
             _BATCH_OCCUPANCY.observe(len(self._slots), model=self.cfg.name)
             self._gen += 1
+            if self._gen % _FLIGHT_SAMPLE == 0:  # sampled step-loop record
+                _FLIGHTREC.record("engine", "block", model=self.cfg.name,
+                                  gen=self._gen, k=k,
+                                  slots=len(self._slots),
+                                  pending=len(self._pending))
             (out, self.tokens, self.cache, self.counts, self.window,
              self.wlen, self.sampling) = self._decode_block_fn(
                 self.params, self.cache, self.tokens, self.active,
@@ -948,6 +964,7 @@ class InferenceEngine:
         reused after this block was dispatched) are skipped entirely."""
         k = tok_np.shape[0] - 1
         now = time.perf_counter_ns()
+        wall = time.time()
         ingested = 0
         for slot, st in list(self._slots.items()):
             if st.joined_gen > gen:
@@ -959,6 +976,7 @@ class InferenceEngine:
                 st.t_prefill_ns = now - st.t_start
             if not st.t_first_decode:
                 st.t_first_decode = now
+            st.t_last_ingest = wall  # decode-progress mark (batch_state)
             for r in range(first_row, k + 1):
                 self._ingest(slot, st, int(tok_np[r, slot]))
                 ingested += 1
@@ -1039,6 +1057,9 @@ class InferenceEngine:
             except Exception as e:  # noqa: BLE001 — keep serving others
                 log.error("engine block failed; aborting in-flight requests",
                           model=self.cfg.name, error=str(e))
+                _FLIGHTREC.record("engine", "step_failure",
+                                  model=self.cfg.name, error=str(e)[:200],
+                                  streak=fail_streak + 1)
                 self._inflight.clear()
                 self.abort_all(f"engine failure: {e}")
                 try:
@@ -1053,6 +1074,9 @@ class InferenceEngine:
                     # stop().)
                     log.error("engine unrecoverable after repeated failures;"
                               " runner exiting", model=self.cfg.name)
+                    _FLIGHTREC.record("engine", "runner_dead",
+                                      model=self.cfg.name,
+                                      error=str(e)[:200])
                     self.abort_all("engine unrecoverable")
                     return
 
@@ -1234,3 +1258,38 @@ class InferenceEngine:
     @property
     def queued_requests(self) -> int:
         return len(self._pending)
+
+    def batch_state(self) -> dict[str, Any]:
+        """Point-in-time batch snapshot for hang diagnoses and flight
+        recorder dumps (obs/flightrec.py engine probes): which request
+        holds which slot, how far it got, and how long since its last
+        host-visible token. Reads mutable state without the dispatch lock
+        — a wedged runner holding that lock is exactly when this must
+        still answer; a torn read is a cosmetic risk, a blocked dump a
+        fatal one."""
+        now_ns = time.perf_counter_ns()
+        wall = time.time()
+        slots = {}
+        for slot, st in list(self._slots.items()):
+            slots[str(slot)] = {
+                "request": st.req.id,
+                "phase": "decode" if st.t_first_decode else "prefill",
+                "promptTokens": st.prompt_len,
+                "generated": len(st.generated),
+                "ageS": round((now_ns - st.t_start) / 1e9, 3),
+                "sinceLastTokenS": (
+                    round(wall - st.t_last_ingest, 3)
+                    if st.t_last_ingest else None),
+            }
+        return {
+            "model": self.cfg.name,
+            "running": self.running,
+            "embeddingOnly": self.embedding_only,
+            "slots": slots,
+            "pending": len(self._pending),
+            "inflightBlocks": len(self._inflight),
+            "dispatchGen": self._gen,
+            "freeSlots": len(self._free_slots),
+            "kvPagesFree": self.alloc.free_pages
+            if not self.embedding_only else None,
+        }

@@ -105,10 +105,16 @@ class GatewayServer:
 
     def __init__(self, config: Config | None = None, bus: MessageBus | None = None):
         self.config = config or load_config()
+        from gridllm_tpu.obs import default_flight_recorder
+
+        default_flight_recorder().set_capacity(
+            self.config.obs.flightrec_capacity)
         self.bus = bus or create_bus(self.config.bus.url,
                                      key_prefix=self.config.bus.key_prefix)
         self.registry = WorkerRegistry(self.bus, self.config.scheduler)
-        self.scheduler = JobScheduler(self.bus, self.registry, self.config.scheduler)
+        self.scheduler = JobScheduler(self.bus, self.registry, self.config.scheduler,
+                                      slo_config=self.config.obs.slo,
+                                      watchdog_config=self.config.obs.watchdog)
         self.app = create_app(self.bus, self.registry, self.scheduler, self.config)
         self._runner: web.AppRunner | None = None
         self._status_task: asyncio.Task | None = None

@@ -1,15 +1,21 @@
-"""Observability endpoints + HTTP metrics middleware (ISSUE 1).
+"""Observability endpoints + HTTP metrics middleware (ISSUE 1 + 2).
 
 - ``GET /metrics``: Prometheus text exposition. Renders the scheduler's
-  per-instance registry (gateway/scheduler/worker-liveness series) plus the
-  process-global default registry (bus, and — in single-process deployments
-  like bench.py — engine/kernel series).
+  per-instance registry (gateway/scheduler/worker-liveness/SLO series) plus
+  the process-global default registry (bus, and — in single-process
+  deployments like bench.py — engine/kernel series).
 - ``GET /admin/trace/{request_id}``: the stitched gateway+worker span
   timeline recorded by obs/tracer.py.
+- ``GET /admin/slo``: per-class SLO attainment, burn rates, and goodput
+  from obs/slo.py — the same state the ``gridllm_slo_*`` gauges render.
+- ``GET /admin/dump``: the flight-recorder post-mortem artifact
+  (obs/flightrec.py): event rings, active traces, SLO snapshot, registry
+  and engine state, plus any retained auto dumps from hang/crash detection.
 - ``metrics_middleware``: request count by route/method/status and
   end-to-end latency histogram by route. Route labels use the matched
   route's canonical pattern (``/inference/{job_id}/status``), never the raw
-  path, so label cardinality stays bounded.
+  path, so label cardinality stays bounded. Server-fault responses (5xx)
+  also land in the gateway flight-recorder ring.
 """
 
 from __future__ import annotations
@@ -19,7 +25,13 @@ import time
 
 from aiohttp import web
 
-from gridllm_tpu.obs import PROMETHEUS_CONTENT_TYPE, default_registry, render_registries
+from gridllm_tpu.obs import (
+    PROMETHEUS_CONTENT_TYPE,
+    build_dump,
+    default_flight_recorder,
+    default_registry,
+    render_registries,
+)
 from gridllm_tpu.scheduler import JobScheduler
 
 
@@ -66,6 +78,10 @@ def metrics_middleware(scheduler: JobScheduler):
             requests_total.inc(route=route, method=request.method,
                                status=str(status))
             duration.observe(time.monotonic() - t0, route=route)
+            if status >= 500:  # server faults only — the ring is for
+                default_flight_recorder().record(  # post-mortems, not access logs
+                    "gateway", "server_error", route=route,
+                    method=request.method, status=status)
 
     return middleware
 
@@ -91,7 +107,15 @@ def build_routes(scheduler: JobScheduler) -> list[web.RouteDef]:
             "sources": sorted({s["source"] for s in spans}),
         })
 
+    async def slo(request: web.Request) -> web.Response:
+        return web.json_response(scheduler.slo.snapshot())
+
+    async def dump(request: web.Request) -> web.Response:
+        return web.json_response(build_dump(scheduler, reason="on_demand"))
+
     return [
         web.get("/metrics", metrics),
         web.get("/admin/trace/{request_id}", trace),
+        web.get("/admin/slo", slo),
+        web.get("/admin/dump", dump),
     ]

@@ -1,9 +1,21 @@
-"""Observability subsystem: metrics registry + request tracer (ISSUE 1).
+"""Observability subsystem.
 
-Pure stdlib — no prometheus_client, no OpenTelemetry. See metrics.py for
-the instrument/encoding layer and tracer.py for span timelines.
+Raw telemetry (ISSUE 1): metrics.py (instruments + Prometheus text
+encoding) and tracer.py (stitched per-request span timelines).
+Interpretation layer (ISSUE 2): slo.py (per-class objectives, attainment,
+burn rates, goodput), watchdog.py (per-phase hang detection), flightrec.py
+(black-box event rings + post-mortem dump artifacts).
+
+Pure stdlib — no prometheus_client, no OpenTelemetry.
 """
 
+from gridllm_tpu.obs.flightrec import (
+    FlightRecorder,
+    build_dump,
+    default_flight_recorder,
+    register_engine_probe,
+    unregister_engine_probe,
+)
 from gridllm_tpu.obs.metrics import (
     LATENCY_BUCKETS,
     PROMETHEUS_CONTENT_TYPE,
@@ -15,25 +27,35 @@ from gridllm_tpu.obs.metrics import (
     default_registry,
     render_registries,
 )
+from gridllm_tpu.obs.slo import SLOEngine, classify_request
 from gridllm_tpu.obs.tracer import (
     TRACE_CHANNEL_PREFIX,
     Span,
     Tracer,
     trace_channel,
 )
+from gridllm_tpu.obs.watchdog import HangWatchdog
 
 __all__ = [
     "LATENCY_BUCKETS",
     "PROMETHEUS_CONTENT_TYPE",
     "SIZE_BUCKETS",
     "Counter",
+    "FlightRecorder",
     "Gauge",
+    "HangWatchdog",
     "Histogram",
     "MetricsRegistry",
+    "SLOEngine",
     "Span",
     "TRACE_CHANNEL_PREFIX",
     "Tracer",
+    "build_dump",
+    "classify_request",
+    "default_flight_recorder",
     "default_registry",
+    "register_engine_probe",
     "render_registries",
     "trace_channel",
+    "unregister_engine_probe",
 ]

@@ -163,8 +163,22 @@ class Tracer:
         spans = self._done.pop(request_id, []) + extra
         spans.sort(key=lambda s: (s.start, s.end or s.start))
         self._done[request_id] = spans
+        self._trim_done_locked()
+
+    def _trim_done_locked(self) -> None:
+        """LRU-evict finished timelines — but never a request that still
+        has OPEN spans here: evicting it would silently drop its already-
+        ingested worker half, and the later finish() would re-insert only
+        the gateway half (a half-merged timeline for a live request).
+        If every entry is open (pathological), evict oldest anyway —
+        bounded memory beats a perfect timeline."""
         while len(self._done) > self.max_traces:
-            self._done.popitem(last=False)
+            victim = next(
+                (rid for rid in self._done if rid not in self._open), None)
+            if victim is None:
+                self._done.popitem(last=False)
+                continue
+            del self._done[victim]
 
     # -- lifecycle ----------------------------------------------------------
     def finish(self, request_id: str) -> list[dict[str, Any]]:
@@ -195,8 +209,7 @@ class Tracer:
                 return []
             spans.sort(key=lambda s: (s.start, s.end or s.start))
             self._done[request_id] = spans
-            while len(self._done) > self.max_traces:
-                self._done.popitem(last=False)
+            self._trim_done_locked()
             return [s.to_dict() for s in spans]
 
     def ingest(self, request_id: str, span_dicts: list[dict[str, Any]]) -> None:
@@ -204,10 +217,21 @@ class Tracer:
         finished store, preserving chronological order. Each publication
         carries the publishing side's FULL timeline (finish() re-seals), so
         a re-publication — e.g. a worker that NACKed earlier and later ran
-        the job — REPLACES that source's spans rather than duplicating them."""
+        the job — REPLACES that source's spans rather than duplicating them.
+
+        Incoming spans that are still OPEN (a flight-recorder dump of a
+        dying worker's active spans — normal publications are sealed by
+        finish()) are closed here with an aborted marker: the publisher is
+        never coming back to end them, and /admin/trace must not serve a
+        half-merged timeline with remote spans dangling open forever."""
         incoming = [Span.from_dict(request_id, d) for d in span_dicts]
         if not incoming:
             return
+        for s in incoming:
+            if s.end is None:
+                s.end = s.start
+                s.meta.setdefault("aborted", True)
+                s.meta.setdefault("reason", "unsealed_at_publish")
         sources = {s.source for s in incoming}
         with self._lock:
             # requests still in flight gateway-side keep their open/closed
@@ -217,8 +241,7 @@ class Tracer:
             spans = kept + incoming
             spans.sort(key=lambda s: (s.start, s.end or s.start))
             self._done[request_id] = spans
-            while len(self._done) > self.max_traces:
-                self._done.popitem(last=False)
+            self._trim_done_locked()
 
     # -- queries ------------------------------------------------------------
     def export(self, request_id: str) -> list[dict[str, Any]] | None:

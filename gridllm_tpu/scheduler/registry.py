@@ -30,7 +30,7 @@ import json
 import time
 
 from gridllm_tpu.bus.base import MessageBus, Subscription
-from gridllm_tpu.obs import Counter, Gauge, MetricsRegistry
+from gridllm_tpu.obs import Counter, Gauge, MetricsRegistry, default_flight_recorder
 from gridllm_tpu.utils.config import SchedulerConfig
 from gridllm_tpu.utils.events import EventEmitter
 from gridllm_tpu.utils.logging import get_logger
@@ -132,6 +132,10 @@ class WorkerRegistry(EventEmitter):
         await self.bus.hset(WORKERS_KEY, info.workerId, info.model_dump_json())
         log.worker("worker registered", info.workerId,
                    models=info.model_names(), new=is_new)
+        if is_new:
+            default_flight_recorder().record(
+                "registry", "worker_registered", worker=info.workerId,
+                models=info.model_names())
         self.emit("worker_registered", info)
 
     async def _on_unregistered(self, _ch: str, raw: str) -> None:
@@ -247,6 +251,9 @@ class WorkerRegistry(EventEmitter):
             if self._removed_total is not None:
                 self._removed_total.inc(reason=reason or "unknown")
             log.worker("worker removed", worker_id, reason=reason)
+            default_flight_recorder().record(
+                "registry", "worker_removed", worker=worker_id,
+                reason=reason or "unknown", currentJobs=info.currentJobs)
             self.emit("worker_removed", worker_id, info, reason)
 
     async def request_worker_reregistration(self, worker_id: str) -> None:

@@ -40,10 +40,17 @@ import time
 from typing import Any, Awaitable, Callable
 
 from gridllm_tpu.bus.base import MessageBus, Subscription
-from gridllm_tpu.obs import MetricsRegistry, Tracer
+from gridllm_tpu.obs import (
+    HangWatchdog,
+    MetricsRegistry,
+    SLOEngine,
+    Tracer,
+    classify_request,
+    default_flight_recorder,
+)
 from gridllm_tpu.obs.tracer import TRACE_CHANNEL_PREFIX
 from gridllm_tpu.scheduler.registry import WorkerRegistry
-from gridllm_tpu.utils.config import SchedulerConfig
+from gridllm_tpu.utils.config import SchedulerConfig, SLOConfig, WatchdogConfig
 from gridllm_tpu.utils.events import EventEmitter
 from gridllm_tpu.utils.logging import bind_request_id, get_logger
 from gridllm_tpu.utils.types import (
@@ -86,7 +93,9 @@ class _QueuedJob:
 class JobScheduler(EventEmitter):
     def __init__(self, bus: MessageBus, registry: WorkerRegistry,
                  config: SchedulerConfig | None = None,
-                 metrics: MetricsRegistry | None = None):
+                 metrics: MetricsRegistry | None = None,
+                 slo_config: SLOConfig | None = None,
+                 watchdog_config: WatchdogConfig | None = None):
         super().__init__()
         self.bus = bus
         self.registry = registry
@@ -140,6 +149,16 @@ class JobScheduler(EventEmitter):
         self.metrics.add_collector("scheduler", self._collect_gauges)
         registry.attach_metrics(self.metrics)
         self._queue_spans: dict[str, Any] = {}  # jobId → open queue span
+        # interpretation layer (ISSUE 2): SLO judgments on the same
+        # registry, the hang watchdog sweeping this scheduler's state
+        # (started in initialize), and the process flight recorder
+        self.slo = SLOEngine(slo_config, self.metrics)
+        self.watchdog = HangWatchdog(self, watchdog_config)
+        self.flightrec = default_flight_recorder()
+        # jobId → (first stream frame ts, last stream frame ts): the only
+        # pre-completion sign of life a worker gives the gateway; feeds
+        # the watchdog's decode-stall detection
+        self._stream_progress: dict[str, tuple[float, float]] = {}
 
     # -- lifecycle ----------------------------------------------------------
     async def initialize(self) -> None:
@@ -157,6 +176,7 @@ class JobScheduler(EventEmitter):
                                       self._on_trace))
         await self._load_existing_jobs()
         self._sweep_task = asyncio.create_task(self._sweep_loop())
+        self.watchdog.start()
         # new capacity → dispatch; lost worker → requeue its jobs
         self.registry.on("worker_registered", lambda *_: self.request_dispatch())
         self.registry.on("worker_status_changed", lambda *_: self.request_dispatch())
@@ -166,6 +186,7 @@ class JobScheduler(EventEmitter):
 
     async def shutdown(self) -> None:
         self._running = False
+        await self.watchdog.stop()
         if self._sweep_task:
             self._sweep_task.cancel()
             self._sweep_task = None
@@ -264,10 +285,15 @@ class JobScheduler(EventEmitter):
 
     async def _submit_and_await(self, request: InferenceRequest,
                                 timeout_ms: int | None,
-                                extra_subs: list[tuple[str, Any]] | None = None) -> JobResult:
+                                extra_subs: list[tuple[str, Any]] | None = None,
+                                ttft_ref: list | None = None) -> JobResult:
         """Shared body of the synchronous submit APIs: subscribe the per-job
-        result channel (plus any extras), queue, await with timeout+cancel."""
+        result channel (plus any extras), queue, await with timeout+cancel.
+        ``ttft_ref`` is the streaming path's one-slot TTFT holder (filled by
+        its stream handler) so the SLO judgment sees the first-token time."""
         timeout_ms = timeout_ms or request.timeout or self.config.job_timeout_ms
+        t_submit = time.time()
+        slo_class = classify_request(request)
         loop = asyncio.get_running_loop()
         future: asyncio.Future[JobResult] = loop.create_future()
 
@@ -295,9 +321,14 @@ class JobScheduler(EventEmitter):
                 try:
                     result = await asyncio.wait_for(future, timeout_ms / 1000)
                     outcome = "success" if result.success else "failed"
+                    self._judge_slo(slo_class, request, result,
+                                    e2e_s=time.time() - t_submit,
+                                    ttft_ref=ttft_ref)
                     return result
                 except asyncio.TimeoutError:
                     outcome = "timeout"
+                    self.slo.record(slo_class, ok=False,
+                                    e2e_s=timeout_ms / 1000)
                     # end the root BEFORE cancel_job's tracer.abort seals
                     # the timeline, so the outcome lands on the span
                     self.tracer.end(root, outcome=outcome)
@@ -308,10 +339,29 @@ class JobScheduler(EventEmitter):
             finally:
                 # seal the trace BEFORE the awaited unsubscribes: a bus
                 # error there must not leak the open root span
+                self._stream_progress.pop(request.id, None)
                 self.tracer.end(root, outcome=outcome)
                 self.tracer.finish(request.id)
                 for sub in subs:
                     await sub.unsubscribe()
+
+    def _judge_slo(self, slo_class: str, request: InferenceRequest,
+                   result: JobResult, e2e_s: float,
+                   ttft_ref: list | None) -> None:
+        """SLO judgment for a resolved submit: measurements come from the
+        result's engine-measured timing fields plus the streaming TTFT."""
+        tokens = 0
+        itl_s = None
+        resp = result.response
+        if resp is not None:
+            tokens = int(resp.eval_count or 0)
+            if tokens > 1 and resp.eval_duration:
+                itl_s = (resp.eval_duration / 1e9) / (tokens - 1)
+        self.slo.record(
+            slo_class, ok=result.success,
+            ttft_s=(ttft_ref[0] if ttft_ref else None),
+            itl_s=itl_s, e2e_s=e2e_s, tokens=tokens,
+        )
 
     async def submit_and_wait(self, request: InferenceRequest,
                               timeout_ms: int | None = None) -> JobResult:
@@ -329,23 +379,45 @@ class JobScheduler(EventEmitter):
         return the final result (reference: JobScheduler.ts:713-856)."""
         t_submit = time.time()
         first = [True]
+        ttft_ref: list = [None]
 
         async def on_stream(_ch: str, raw: str) -> None:
             try:
                 chunk = StreamChunk.model_validate_json(raw)
             except Exception:
                 return
+            now = time.time()
             if first[0]:
                 first[0] = False
-                ttft = time.time() - t_submit
+                ttft = now - t_submit
+                ttft_ref[0] = ttft
                 self._ttft.observe(ttft, model=request.model)
                 self.tracer.event(request.id, "gateway.first_token",
                                   ttftMs=round(ttft * 1000, 3))
+            # progress only while the job is live: a trailing frame
+            # delivered after the result resolved (separate pump queues)
+            # must not re-insert an entry the finally block just popped
+            if request.id in self.active_jobs:
+                first_ts = self._stream_progress.get(request.id,
+                                                     (now, now))[0]
+                self._stream_progress[request.id] = (first_ts, now)
             await on_chunk(chunk)
 
         return await self._submit_and_await(
             request, timeout_ms,
-            extra_subs=[(f"job:stream:{request.id}", on_stream)])
+            extra_subs=[(f"job:stream:{request.id}", on_stream)],
+            ttft_ref=ttft_ref)
+
+    async def publish_cancellation(self, worker_id: str, job_id: str,
+                                   reason: str) -> None:
+        """The one place the job_cancellation message is built — the
+        waiter-cancel, timeout, and watchdog-hang paths all send the same
+        shape to ``worker:{id}:job``."""
+        await self.bus.publish(
+            f"worker:{worker_id}:job",
+            json.dumps({"type": "job_cancellation", "jobId": job_id,
+                        "reason": reason}),
+        )
 
     async def cancel_job(self, job_id: str, reason: str = "cancelled") -> bool:
         """Cancel a queued, retrying, or active job (reference:
@@ -358,6 +430,8 @@ class JobScheduler(EventEmitter):
             # path — count it as a timeout, not a user cancellation
             event = "timeout" if reason == "timeout" else "cancelled"
             self._jobs_total.inc(event=event)
+            self.flightrec.record("scheduler", event, job=job_id,
+                                  reason=reason)
             self._end_queue_span(job_id, cancelled=True, reason=reason)
             self.tracer.abort(job_id, reason=reason)
 
@@ -380,10 +454,8 @@ class JobScheduler(EventEmitter):
         assignment = self.active_jobs.pop(job_id, None)
         if assignment is not None:
             try:
-                await self.bus.publish(
-                    f"worker:{assignment.workerId}:job",
-                    json.dumps({"type": "job_cancellation", "jobId": job_id, "reason": reason}),
-                )
+                await self.publish_cancellation(assignment.workerId, job_id,
+                                                reason)
             finally:
                 # the job is already claimed — even a dead bus must not
                 # skip the terminal accounting and cleanup
@@ -589,6 +661,16 @@ class JobScheduler(EventEmitter):
                 self._jobs_total.inc(event="completed")
                 self.emit("job_completed", result)
                 self.request_dispatch()
+            else:
+                # no pending copy either → the job already resolved through
+                # another worker and THIS execution's tokens were wasted
+                # work (the at-least-once cost goodput accounting exists
+                # to surface)
+                wasted = int(getattr(result.response, "eval_count", 0) or 0)
+                self.slo.record_waste(wasted, reason="duplicate_execution")
+                self.flightrec.record(
+                    "scheduler", "duplicate_completion",
+                    job=result.jobId, worker=result.workerId, tokens=wasted)
             return
         await self._clear_active(result.jobId, free_worker=True)
         self._jobs_total.inc(event="completed")
@@ -622,6 +704,9 @@ class JobScheduler(EventEmitter):
                 self.job_queue.insert(0, qj)
                 await self._persist_queued(qj)
                 self._jobs_total.inc(event="nacked")
+                self.flightrec.record("scheduler", "nacked",
+                                      job=result.jobId,
+                                      worker=result.workerId, nacks=nacks)
                 self._begin_queue_span(request, nacked=True)
                 log.job("assignment NACKed; requeued (no retry consumed)",
                         result.jobId, worker_id=result.workerId, nacks=nacks)
@@ -637,6 +722,9 @@ class JobScheduler(EventEmitter):
             self._jobs_total.inc(event="retried")
             self.tracer.event(result.jobId, "scheduler.retry",
                               attempt=retry_count + 1, error=result.error)
+            self.flightrec.record("scheduler", "retry", job=result.jobId,
+                                  attempt=retry_count + 1,
+                                  error=str(result.error)[:200])
             log.job("job failed; retry scheduled", result.jobId,
                     attempt=retry_count + 1, delay_s=delay_s, error=result.error)
 
@@ -649,6 +737,9 @@ class JobScheduler(EventEmitter):
             self._retry_handles[result.jobId] = loop.call_later(delay_s, do_retry)
         else:
             self._jobs_total.inc(event="failed")
+            self.flightrec.record("scheduler", "failed", job=result.jobId,
+                                  worker=result.workerId,
+                                  error=str(result.error)[:200])
             self.tracer.abort(result.jobId, reason="failed")
             log.job("job failed permanently", result.jobId, error=result.error)
             await self.bus.publish(f"job:result:{result.jobId}", result.model_dump_json())
@@ -674,16 +765,16 @@ class JobScheduler(EventEmitter):
         if assignment is None:
             return  # already completed/cancelled — benign
         self._jobs_total.inc(event="timeout")
+        self.flightrec.record("scheduler", "timeout", job=job_id,
+                              worker=assignment.workerId)
         # close any still-open spans for the job so a timeout storm cannot
         # leak tracer state (asserted by the chaos tests)
         self._end_queue_span(job_id, timeout=True)
         self.tracer.abort(job_id, reason="timeout")
         log.job("job timed out", job_id, worker_id=assignment.workerId)
         try:
-            await self.bus.publish(
-                f"worker:{assignment.workerId}:job",
-                json.dumps({"type": "job_cancellation", "jobId": job_id, "reason": "timeout"}),
-            )
+            await self.publish_cancellation(assignment.workerId, job_id,
+                                            "timeout")
         finally:
             # already claimed + accounted above — a dead bus must not skip
             # the persisted-record/timer/worker cleanup
@@ -729,6 +820,14 @@ class JobScheduler(EventEmitter):
         metadata (reference: JobScheduler.ts:259-315)."""
         job_id = assignment.jobId
         await self._clear_active(job_id, free_worker=False)
+        # mark the loss on the trace BEFORE the requeue opens fresh spans:
+        # the dead worker will never publish its half of the timeline, and
+        # /admin/trace must say so instead of showing an unexplained gap
+        self.tracer.event(job_id, "scheduler.worker_lost",
+                          worker=assignment.workerId, reason=reason)
+        self._stream_progress.pop(job_id, None)
+        self.flightrec.record("scheduler", "orphaned", job=job_id,
+                              worker=assignment.workerId, reason=reason)
         request = assignment.request
         request.priority = Priority.high
         md = request.metadata

@@ -117,6 +117,73 @@ class WorkerConfig(BaseModel):
     performance_tier: str = "medium"
 
 
+class SLOClassConfig(BaseModel):
+    """Latency objectives for one request class (ISSUE 2). ``None`` means
+    the objective does not apply to the class (embeddings have no ITL)."""
+
+    ttft_ms: float | None = None       # submit → first streamed token
+    itl_ms: float | None = None        # mean inter-token latency
+    e2e_ms: float | None = None        # submit → final result
+    target: float = Field(0.99, gt=0, le=1)  # attainment objective
+
+
+def default_slo_classes() -> dict[str, SLOClassConfig]:
+    """Request classes and their default objectives. Classification
+    (obs/slo.py classify_request): streaming generation is interactive,
+    non-streaming generation is batch, embeddings are their own class."""
+    return {
+        "interactive": SLOClassConfig(ttft_ms=2_000, itl_ms=200,
+                                      e2e_ms=120_000, target=0.99),
+        "batch": SLOClassConfig(e2e_ms=300_000, target=0.95),
+        "embedding": SLOClassConfig(e2e_ms=10_000, target=0.99),
+    }
+
+
+class SLOConfig(BaseModel):
+    """SLO engine knobs (obs/slo.py). ``GRIDLLM_SLO_CLASSES`` may carry a
+    JSON object {class: {ttft_ms, itl_ms, e2e_ms, target}} that REPLACES
+    the defaults wholesale (partial per-class merges would make the
+    effective objective ambiguous)."""
+
+    enabled: bool = True
+    classes: dict[str, SLOClassConfig] = Field(
+        default_factory=default_slo_classes)
+    # burn-rate windows (seconds): one fast window for paging, one slow
+    # window for ticket-level alerts (multi-window burn-rate alerting)
+    windows_s: list[int] = Field(default_factory=lambda: [300, 3600])
+
+
+class WatchdogConfig(BaseModel):
+    """Hang watchdog (obs/watchdog.py): per-phase deadlines after which a
+    request is flagged as wedged. Defaults are generous — first-compile on
+    a cold worker is minutes, and a false hang requeue wastes real work."""
+
+    enabled: bool = True
+    interval_ms: int = Field(1_000, gt=0)
+    # open queue.wait span older than this → phase "queue"
+    queue_deadline_ms: int = Field(120_000, gt=0)
+    # assigned, no stream frame yet → "dispatch" past this ...
+    dispatch_deadline_ms: int = Field(60_000, gt=0)
+    # ... and "prefill" past this (gateway-side the two are only
+    # distinguishable by age; worker-side engine probes refine it)
+    prefill_deadline_ms: int = Field(240_000, gt=0)
+    # first token seen but no frame for this long → "decode-step"
+    decode_stall_ms: int = Field(60_000, gt=0)
+    # abort + requeue hung ACTIVE jobs (reason "hang"); queue-phase hangs
+    # are diagnosis-only (there is nothing to requeue)
+    requeue: bool = True
+
+
+class ObsConfig(BaseModel):
+    """Interpretation-layer observability (ISSUE 2): SLO engine, hang
+    watchdog, flight recorder."""
+
+    slo: SLOConfig = Field(default_factory=SLOConfig)
+    watchdog: WatchdogConfig = Field(default_factory=WatchdogConfig)
+    # per-subsystem ring capacity of the flight recorder
+    flightrec_capacity: int = Field(256, gt=0)
+
+
 class Config(BaseModel):
     env: str = "development"
     bus: BusConfig = Field(default_factory=BusConfig)
@@ -124,6 +191,26 @@ class Config(BaseModel):
     gateway: GatewayConfig = Field(default_factory=GatewayConfig)
     worker: WorkerConfig = Field(default_factory=WorkerConfig)
     engine: EngineConfig = Field(default_factory=EngineConfig)
+    obs: ObsConfig = Field(default_factory=ObsConfig)
+
+
+def _slo_config_from_env() -> SLOConfig:
+    """SLO objectives from the environment. ``GRIDLLM_SLO_CLASSES`` is a
+    JSON object replacing the default class table; ``GRIDLLM_SLO_WINDOWS``
+    is a comma list of burn-rate window seconds."""
+    import json
+
+    kw: dict[str, Any] = {"enabled": _env("GRIDLLM_SLO_ENABLED", True)}
+    raw = os.environ.get("GRIDLLM_SLO_CLASSES")
+    if raw:
+        kw["classes"] = {
+            name: SLOClassConfig(**spec)
+            for name, spec in json.loads(raw).items()
+        }
+    windows = os.environ.get("GRIDLLM_SLO_WINDOWS")
+    if windows:
+        kw["windows_s"] = [int(w) for w in windows.split(",") if w]
+    return SLOConfig(**kw)
 
 
 def load_config() -> Config:
@@ -175,6 +262,23 @@ def load_config() -> Config:
                 kv_page_size=_env("GRIDLLM_KV_PAGE_SIZE", 128),
                 stream_flush_ms=_env("GRIDLLM_STREAM_FLUSH_MS", 20),
                 mesh_shape=_env("GRIDLLM_MESH_SHAPE", ""),
+            ),
+            obs=ObsConfig(
+                slo=_slo_config_from_env(),
+                watchdog=WatchdogConfig(
+                    enabled=_env("GRIDLLM_WATCHDOG_ENABLED", True),
+                    interval_ms=_env("GRIDLLM_WATCHDOG_INTERVAL", 1_000),
+                    queue_deadline_ms=_env(
+                        "GRIDLLM_WATCHDOG_QUEUE_DEADLINE", 120_000),
+                    dispatch_deadline_ms=_env(
+                        "GRIDLLM_WATCHDOG_DISPATCH_DEADLINE", 60_000),
+                    prefill_deadline_ms=_env(
+                        "GRIDLLM_WATCHDOG_PREFILL_DEADLINE", 240_000),
+                    decode_stall_ms=_env(
+                        "GRIDLLM_WATCHDOG_DECODE_STALL", 60_000),
+                    requeue=_env("GRIDLLM_WATCHDOG_REQUEUE", True),
+                ),
+                flightrec_capacity=_env("GRIDLLM_FLIGHTREC_CAPACITY", 256),
             ),
         )
     except (ValidationError, ValueError) as e:  # pragma: no cover - fail fast

@@ -147,10 +147,31 @@ def build_health_app(service: WorkerService) -> web.Application:
         return web.Response(text=default_registry().render(),
                             headers={"Content-Type": PROMETHEUS_CONTENT_TYPE})
 
+    async def dump(_):
+        # worker-side flight recorder artifact: this process's event rings
+        # + live engine batch state. No scheduler here — the gateway's
+        # /admin/dump carries the control-plane view; the worker service's
+        # ACTIVE execution spans ride along so a wedged request's trace is
+        # readable from the worker even before it resolves.
+        from gridllm_tpu.obs import build_dump
+
+        artifact = build_dump(reason="on_demand")
+        artifact["worker"] = {
+            "workerId": service.worker_id,
+            "currentJobs": service.current_jobs,
+            "models": list(service.engines),
+        }
+        artifact["activeTraces"] = {
+            rid: service.tracer.export(rid)
+            for rid in service.tracer.active_ids()
+        }
+        return web.json_response(artifact)
+
     app.add_routes([
         web.get("/health", health), web.get("/health/live", live),
         web.get("/health/ready", ready), web.get("/health/system", system),
         web.get("/worker/status", status), web.get("/metrics", metrics),
+        web.get("/admin/dump", dump),
     ])
     return app
 
@@ -172,6 +193,9 @@ async def run(config: Config | None = None) -> None:
     from gridllm_tpu.worker.group import GroupMembership, fail_logical_worker
 
     config = config or load_config()
+    from gridllm_tpu.obs import default_flight_recorder
+
+    default_flight_recorder().set_capacity(config.obs.flightrec_capacity)
     group = initialize_group()
     if group.is_group and not os.environ.get("WORKER_ID"):
         # ALL slice processes must agree on the logical worker id or the
@@ -268,6 +292,11 @@ async def run(config: Config | None = None) -> None:
             if slice_broken:
                 # jax.distributed teardown blocks on dead slice members —
                 # fail fast so the supervisor restarts the slice together
+                from gridllm_tpu.obs import default_flight_recorder
+
+                default_flight_recorder().record(
+                    "worker", "fatal_exit", worker=service.worker_id,
+                    reason=slice_broken[0])
                 log.error("slice broken; exiting", reason=slice_broken[0])
                 os._exit(1)
             shutdown_group(group)

@@ -9,6 +9,11 @@ that dies abruptly (no unregister, heartbeat key left to expire) while
 holding the job → 3-tier liveness detects it → the job is orphan-promoted
 and held → a SECOND real worker registers → the job completes through it
 and the original HTTP request succeeds.
+
+ISSUE 2 adds the OTHER death mode: a worker that wedges mid-decode WITHOUT
+exiting. Its heartbeat keeps beating, so no liveness tier ever fires — only
+the hang watchdog (obs/watchdog.py) can see the stalled stream, dump a
+post-mortem, and requeue the job.
 """
 
 import asyncio
@@ -24,9 +29,17 @@ from gridllm_tpu.bus import create_bus
 from gridllm_tpu.bus.broker import GridBusBroker
 from gridllm_tpu.engine import EngineConfig, InferenceEngine
 from gridllm_tpu.gateway.app import create_app
+from gridllm_tpu.obs import default_flight_recorder
 from gridllm_tpu.scheduler import JobScheduler, WorkerRegistry
-from gridllm_tpu.utils.config import Config, SchedulerConfig, WorkerConfig
-from gridllm_tpu.worker.service import WorkerService
+from gridllm_tpu.utils.config import (
+    Config,
+    SchedulerConfig,
+    WatchdogConfig,
+    WorkerConfig,
+)
+from gridllm_tpu.utils.types import StreamChunk, iso_now
+
+from .helpers import FakeWorker
 
 CHILD = Path(__file__).with_name("chaos_worker_child.py")
 
@@ -153,5 +166,127 @@ async def test_worker_sigkill_mid_job_recovers_on_second_worker():
         await scheduler.shutdown()
         await registry.shutdown()
         await spy.disconnect()
+        await bus.disconnect()
+        await broker.stop()
+
+
+class _WedgedWorker(FakeWorker):
+    """Streams one token frame, then stops making progress WITHOUT exiting:
+    heartbeats continue, the job is never completed, never failed. The
+    liveness tiers see a healthy worker — only the watchdog can tell."""
+
+    async def _execute(self, assignment):
+        self.current_jobs += 1
+        await self.bus.publish(f"job:stream:{assignment.jobId}", StreamChunk(
+            id=assignment.jobId, model=assignment.request.model,
+            created_at=iso_now(), response="x", done=False,
+        ).model_dump_json())
+        try:
+            await asyncio.sleep(3600)
+        finally:
+            self.current_jobs -= 1
+
+
+async def test_wedged_worker_detected_dumped_and_requeued():
+    """ISSUE 2 acceptance: a worker stalled mid-decode is detected by the
+    watchdog within its per-phase deadline, an auto dump names the hung
+    request/phase/worker, and the job is requeued (reason hang) and served
+    by a healthy worker — all over a REAL RESP broker."""
+    recorder = default_flight_recorder()
+    recorder.clear()
+    broker = GridBusBroker()
+    await broker.start(port=0)
+    url = f"resp://127.0.0.1:{broker.port}"
+    bus = create_bus(url)
+    await bus.connect()
+    sched_cfg = _chaos_config()
+    stall_ms = 400
+    registry = WorkerRegistry(bus, sched_cfg)
+    scheduler = JobScheduler(
+        bus, registry, sched_cfg,
+        watchdog_config=WatchdogConfig(
+            interval_ms=100, decode_stall_ms=stall_ms,
+            dispatch_deadline_ms=60_000, requeue=True))
+    await registry.initialize()
+    await scheduler.initialize()
+    config = Config()
+    config.scheduler = sched_cfg
+    app = create_app(bus, registry, scheduler, config)
+    client = TestClient(TestServer(app))
+    await client.start_server()
+
+    wedged_bus = create_bus(url)
+    await wedged_bus.connect()
+    wedged = _WedgedWorker(wedged_bus, "chaos-wedged", ["tiny-model"],
+                           heartbeat_interval_s=0.15)
+    healthy_bus = create_bus(url)
+    await healthy_bus.connect()
+    healthy = FakeWorker(healthy_bus, "chaos-healthy", ["tiny-model"],
+                         stream_tokens=["a", "b"],
+                         heartbeat_interval_s=0.15)
+    try:
+        await wedged.start()
+        for _ in range(100):
+            if registry.get_workers_with_model("tiny-model"):
+                break
+            await asyncio.sleep(0.05)
+
+        req_task = asyncio.create_task(client.post(
+            "/ollama/api/generate",
+            json={"model": "tiny-model", "prompt": "chaos"}))
+
+        # detection must land within the deadline + a couple of sweeps
+        t0 = asyncio.get_running_loop().time()
+        detected_at = None
+        while asyncio.get_running_loop().time() - t0 < 15:
+            await asyncio.sleep(0.05)
+            if scheduler.metrics.get("gridllm_hangs_total").value(
+                    phase="decode-step"):
+                detected_at = asyncio.get_running_loop().time()
+                break
+        assert detected_at is not None, "watchdog never fired"
+
+        # the healthy worker arrives AFTER detection; the requeued job must
+        # complete through it and resolve the original HTTP request
+        await healthy.start()
+        resp = await asyncio.wait_for(req_task, 30)
+        assert resp.status == 200
+        await resp.text()
+        assert healthy.processed, "replacement never served the job"
+        assert wedged.cancelled, "wedged worker never told to drop the job"
+
+        # the auto dump names the hung request, phase, and worker, and the
+        # hang is on the metrics + the trace
+        hang_dumps = [d for d in recorder.auto_dumps()
+                      if d["reason"].startswith("hang:")]
+        assert hang_dumps
+        hang = hang_dumps[0]["hang"]
+        assert hang["phase"] == "decode-step"
+        assert hang["worker"] == "chaos-wedged"
+        spans = scheduler.tracer.export(hang["requestId"])
+        assert any(s["name"] == "watchdog.hang" for s in spans)
+        # job:completed (lifecycle channel) may trail job:result (waiter
+        # channel) on a real broker — give the handler a moment
+        for _ in range(100):
+            if scheduler.get_stats()["totalJobsCompleted"]:
+                break
+            await asyncio.sleep(0.05)
+        stats = scheduler.get_stats()
+        assert stats["totalJobsOrphaned"] >= 1  # hang requeue path
+        assert stats["totalJobsCompleted"] == 1
+        assert scheduler.tracer.active_count() == 0, (
+            scheduler.tracer.active_ids())
+        # /admin/dump serves the artifact over HTTP too
+        body = await (await client.get("/admin/dump")).json()
+        assert any(d["reason"].startswith("hang:")
+                   for d in body["autoDumps"])
+    finally:
+        await client.close()
+        await wedged.stop(announce=False)
+        await healthy.stop(announce=False)
+        await scheduler.shutdown()
+        await registry.shutdown()
+        await wedged_bus.disconnect()
+        await healthy_bus.disconnect()
         await bus.disconnect()
         await broker.stop()

@@ -211,6 +211,79 @@ def test_tracer_ingest_replaces_same_source():
         "worker:w1", "worker:w1", "worker:w2"]
 
 
+def test_tracer_eviction_never_drops_inflight_request():
+    """ISSUE 2 tracer hygiene: LRU pressure on the finished store must not
+    evict a request that still has OPEN gateway spans — its already-ingested
+    worker half would vanish and finish() would later re-insert only the
+    gateway half (a half-merged timeline)."""
+    t = Tracer(source="gateway", max_traces=2)
+    t.begin("live", "gateway.request")  # in flight gateway-side
+    t.ingest("live", [
+        {"name": "worker.execute", "source": "worker:w1",
+         "start": 1.0, "end": 2.0},
+    ])
+    # flood the LRU with finished traces — "live" must survive the trims
+    for i in range(5):
+        t.event(f"r{i}", "e")
+        t.finish(f"r{i}")
+    assert "live" in t.ids()
+    t.finish("live")
+    names = {s["name"] for s in t.export("live")}
+    assert names == {"gateway.request", "worker.execute"}  # both halves
+
+
+def test_tracer_ingest_seals_open_remote_spans():
+    """A publication carrying OPEN spans (a dying worker's dump — sealed
+    publications never have them) must not leave remote spans dangling open
+    forever in the stitched view."""
+    t = Tracer(source="gateway")
+    t.ingest("r1", [
+        {"name": "worker.execute", "source": "worker:w1",
+         "start": 5.0, "end": None},
+    ])
+    span = t.export("r1")[0]
+    assert span["end"] is not None
+    assert span["meta"]["aborted"] is True
+    assert span["meta"]["reason"] == "unsealed_at_publish"
+
+
+async def test_orphan_marks_worker_lost_on_trace():
+    """When a worker dies mid-request the dead worker never publishes its
+    half of the timeline; the orphan path must say so on the trace instead
+    of leaving an unexplained gap (ISSUE 2 tracer hygiene)."""
+    bus = InMemoryBus(key_prefix="G:")
+    await bus.connect()
+    cfg = fast_config()
+    registry = WorkerRegistry(bus, cfg)
+    scheduler = JobScheduler(bus, registry, cfg)
+    await registry.initialize()
+    await scheduler.initialize()
+    w = FakeWorker(bus, "w1", ["m1"], delay_s=30, heartbeat_interval_s=0.1)
+    await w.start()
+    await bus.flush()
+
+    req = InferenceRequest(id="dead-worker-job", model="m1", prompt="x")
+    await scheduler.add_job(req)
+    for _ in range(100):  # event-driven dispatch runs as its own task
+        await asyncio.sleep(0.02)
+        if "dead-worker-job" in scheduler.active_jobs:
+            break
+    assert "dead-worker-job" in scheduler.active_jobs
+    await w.die()  # abrupt: heartbeat key deleted, no unregister
+    for _ in range(100):
+        await asyncio.sleep(0.05)
+        if scheduler.get_stats()["totalJobsOrphaned"]:
+            break
+    assert scheduler.get_stats()["totalJobsOrphaned"] == 1
+    spans = scheduler.tracer.export("dead-worker-job")
+    lost = [s for s in spans if s["name"] == "scheduler.worker_lost"]
+    assert lost and lost[0]["meta"]["worker"] == "w1"
+
+    await scheduler.shutdown()
+    await registry.shutdown()
+    await bus.disconnect()
+
+
 async def test_span_stitching_across_in_memory_bus():
     """Worker-side tracer publishes on trace:{id}; the scheduler's psubscribe
     ingests it into the gateway tracer → one merged timeline."""

@@ -1,0 +1,364 @@
+"""ISSUE 2 interpretation layer: SLO engine (attainment / burn rates /
+goodput), hang watchdog (per-phase detection, requeue, auto dump), flight
+recorder (bounded rings, dump artifact), and the /admin/slo + /admin/dump
+gateway routes — including the acceptance check that /admin/slo agrees
+with the /metrics gauges."""
+
+import asyncio
+import json
+
+from aiohttp.test_utils import TestClient, TestServer
+
+from gridllm_tpu.bus.memory import InMemoryBus
+from gridllm_tpu.gateway.app import create_app
+from gridllm_tpu.obs import (
+    FlightRecorder,
+    MetricsRegistry,
+    SLOEngine,
+    build_dump,
+    classify_request,
+    default_flight_recorder,
+)
+from gridllm_tpu.scheduler import JobScheduler, WorkerRegistry
+from gridllm_tpu.utils.config import (
+    Config,
+    SLOClassConfig,
+    SLOConfig,
+    WatchdogConfig,
+)
+from gridllm_tpu.utils.types import InferenceRequest
+
+from .helpers import FakeWorker, fast_config
+
+# ---------------------------------------------------------------------------
+# SLO engine unit
+# ---------------------------------------------------------------------------
+
+
+def _slo(target=0.9, **objectives) -> SLOEngine:
+    cfg = SLOConfig(classes={
+        "interactive": SLOClassConfig(target=target, **objectives)})
+    return SLOEngine(cfg, MetricsRegistry())
+
+
+def test_classify_request():
+    assert classify_request(InferenceRequest(
+        id="a", model="m", prompt="x", stream=True)) == "interactive"
+    assert classify_request(InferenceRequest(
+        id="b", model="m", prompt="x")) == "batch"
+    assert classify_request(InferenceRequest(
+        id="c", model="m", input=["x"],
+        metadata={"requestType": "embedding"})) == "embedding"
+
+
+def test_slo_judgment_and_attainment():
+    s = _slo(ttft_ms=1000, itl_ms=100, e2e_ms=10_000)
+    assert s.record("interactive", ttft_s=0.5, itl_s=0.05, e2e_s=2.0,
+                    tokens=10)
+    assert not s.record("interactive", ttft_s=2.0, itl_s=0.05, e2e_s=2.0)
+    assert not s.record("interactive", ok=False, e2e_s=1.0)
+    # a missing measurement is not a violation (one-token reply has no ITL)
+    assert s.record("interactive", ttft_s=0.5, e2e_s=2.0, tokens=1)
+    snap = s.snapshot()["classes"]["interactive"]
+    assert snap["requests"] == 4
+    assert snap["withinSlo"] == 2
+    assert snap["attainment"] == 0.5
+    assert snap["violations"] == {"ttft": 1, "error": 1}
+
+
+def test_slo_unknown_class_counts_without_objectives():
+    s = _slo(e2e_ms=1)
+    assert s.record("mystery", e2e_s=999.0)  # no objectives → within
+    assert s.snapshot()["classes"]["mystery"]["attainment"] == 1.0
+
+
+def test_burn_rate_windows():
+    s = _slo(target=0.9, e2e_ms=1000)
+    now = 1_000_000.0
+    # 4 old requests (one bad), then 2 recent (both bad): the short window
+    # must see 100% violation rate, the long window the blended rate
+    for i in range(4):
+        s.record("interactive", e2e_s=2.0 if i == 0 else 0.1,
+                 now=now - 500)
+    for _ in range(2):
+        s.record("interactive", e2e_s=2.0, now=now - 10)
+    import pytest
+
+    st = s._classes["interactive"]
+    s.config.windows_s = [1, 60, 3600]
+    rates = s._burn_rates_locked(st, 0.9, now)  # one pass, all windows
+    # budget = 1 - 0.9 = 0.1 → burn = violation_rate / 0.1
+    assert rates[60] == pytest.approx(10.0)     # 2/2 bad in window
+    assert rates[3600] == pytest.approx(5.0)    # 3/6 bad in window
+    assert rates[1] == 0.0                      # empty window
+
+
+def test_goodput_and_waste_accounting():
+    s = _slo(e2e_ms=1000)
+    s.record("interactive", e2e_s=0.5, tokens=100)   # good
+    s.record("interactive", e2e_s=5.0, tokens=40)    # violates → not goodput
+    s.record_waste(25, reason="duplicate_execution")
+    snap = s.snapshot()["goodput"]
+    assert snap["tokensTotal"] == 140
+    assert snap["tokensWithinSlo"] == 100
+    assert snap["wastedTokens"] == {"duplicate_execution": 25}
+    text = s.metrics.render()
+    assert ('gridllm_goodput_tokens_total{slo_class="interactive"} 100'
+            in text)
+    assert ('gridllm_goodput_wasted_tokens_total'
+            '{reason="duplicate_execution"} 25') in text
+
+
+def test_slo_gauges_agree_with_snapshot():
+    s = _slo(target=0.5, e2e_ms=1000)
+    s.record("interactive", e2e_s=0.1, tokens=5)
+    s.record("interactive", e2e_s=9.9, tokens=5)
+    text = s.metrics.render()  # collector runs at render
+    snap = s.snapshot()
+    att = snap["classes"]["interactive"]["attainment"]
+    assert f'gridllm_slo_attainment_ratio{{slo_class="interactive"}} {att}' \
+        in text
+    assert 'gridllm_slo_burn_rate{slo_class="interactive",window="300s"}' \
+        in text
+    assert "gridllm_goodput_ratio 0.5" in text
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+
+def test_flight_recorder_ring_bounds_and_eviction_counts():
+    rec = FlightRecorder(capacity=4)
+    for i in range(10):
+        rec.record("engine", "block", gen=i)
+    snap = rec.snapshot()
+    assert [e["gen"] for e in snap["rings"]["engine"]] == [6, 7, 8, 9]
+    assert snap["evicted"] == {"engine": 6}  # truncation is never silent
+
+
+def test_flight_recorder_auto_dumps_bounded():
+    rec = FlightRecorder(capacity=4, max_auto_dumps=2)
+    for i in range(3):
+        rec.add_auto_dump({"reason": f"r{i}"})
+    assert [d["reason"] for d in rec.auto_dumps()] == ["r1", "r2"]
+
+
+def test_build_dump_without_scheduler():
+    rec = FlightRecorder(capacity=4)
+    rec.record("bus", "reconnect", attempt=1)
+    artifact = build_dump(recorder=rec, reason="unit")
+    assert artifact["reason"] == "unit"
+    assert artifact["flightRecorder"]["rings"]["bus"][0]["event"] == \
+        "reconnect"
+    assert "engines" in artifact and "autoDumps" in artifact
+    json.dumps(artifact)  # must be JSON-able end to end
+
+
+# ---------------------------------------------------------------------------
+# stack integration: /admin/slo + /admin/dump + watchdog
+# ---------------------------------------------------------------------------
+
+
+async def _make_stack(slo_config=None, watchdog_config=None):
+    bus = InMemoryBus(key_prefix="G:")
+    await bus.connect()
+    cfg = fast_config()
+    registry = WorkerRegistry(bus, cfg)
+    scheduler = JobScheduler(bus, registry, cfg, slo_config=slo_config,
+                             watchdog_config=watchdog_config)
+    await registry.initialize()
+    await scheduler.initialize()
+    app = create_app(bus, registry, scheduler, Config(scheduler=cfg))
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    return client, bus, registry, scheduler
+
+
+async def _teardown(client, bus, registry, scheduler, *workers):
+    for w in workers:
+        await w.stop(announce=False)
+    await client.close()
+    await scheduler.shutdown()
+    await registry.shutdown()
+    await bus.disconnect()
+
+
+async def test_admin_slo_agrees_with_metrics_after_requests():
+    client, bus, registry, scheduler = await _make_stack()
+    w = FakeWorker(bus, "w1", ["m1"], stream_tokens=["a", "b", "c"])
+    await w.start()
+    await bus.flush()
+
+    for _ in range(2):
+        resp = await client.post("/ollama/api/generate",
+                                 json={"model": "m1", "prompt": "go"})
+        assert resp.status == 200
+        await resp.text()
+    await bus.flush()
+
+    def fmt(v):  # the exposition's number formatting (metrics._format_value)
+        return str(int(v)) if float(v).is_integer() else repr(float(v))
+
+    body = await (await client.get("/admin/slo")).json()
+    inter = body["classes"]["interactive"]
+    assert inter["requests"] == 2
+    assert inter["attainment"] is not None
+    text = await (await client.get("/metrics")).text()
+    assert 'gridllm_slo_requests_total{slo_class="interactive"} 2' in text
+    assert (f'gridllm_slo_attainment_ratio{{slo_class="interactive"}} '
+            f'{fmt(inter["attainment"])}') in text
+    # goodput tokens agree too
+    assert (f'gridllm_slo_tokens_total{{slo_class="interactive"}} '
+            f'{inter["tokens"]}') in text
+    await _teardown(client, bus, registry, scheduler, w)
+
+
+async def test_timeout_is_an_slo_violation():
+    client, bus, registry, scheduler = await _make_stack()
+    w = FakeWorker(bus, "w1", ["m1"], delay_s=30)
+    await w.start()
+    await bus.flush()
+    from gridllm_tpu.scheduler.scheduler import JobTimeoutError
+
+    try:
+        await scheduler.submit_and_wait(
+            InferenceRequest(id="slo-t1", model="m1", prompt="x"),
+            timeout_ms=200)
+        raise AssertionError("expected timeout")
+    except JobTimeoutError:
+        pass
+    snap = scheduler.slo.snapshot()["classes"]["batch"]
+    assert snap["requests"] == 1
+    assert snap["violations"].get("error") == 1
+    assert snap["attainment"] == 0.0
+    await _teardown(client, bus, registry, scheduler, w)
+
+
+async def test_admin_dump_artifact_sections():
+    client, bus, registry, scheduler = await _make_stack()
+    w = FakeWorker(bus, "w1", ["m1"], stream_tokens=["a"])
+    await w.start()
+    await bus.flush()
+    resp = await client.post("/ollama/api/generate",
+                             json={"model": "m1", "prompt": "go"})
+    assert resp.status == 200
+    await resp.text()
+    await bus.flush()
+
+    body = await (await client.get("/admin/dump")).json()
+    assert body["reason"] == "on_demand"
+    assert "rings" in body["flightRecorder"]
+    assert "interactive" in body["slo"]["classes"]
+    assert body["registry"]["counts"]["total"] == 1
+    assert body["scheduler"]["stats"]["totalJobsCompleted"] == 1
+    await _teardown(client, bus, registry, scheduler, w)
+
+
+class WedgedWorker(FakeWorker):
+    """Streams one token, then wedges mid-decode WITHOUT exiting: the
+    heartbeat keeps beating, so only the watchdog can tell it is stuck."""
+
+    async def _execute(self, assignment):
+        self.current_jobs += 1
+        from gridllm_tpu.utils.types import StreamChunk, iso_now
+
+        await self.bus.publish(f"job:stream:{assignment.jobId}", StreamChunk(
+            id=assignment.jobId, model=assignment.request.model,
+            created_at=iso_now(), response="x", done=False,
+        ).model_dump_json())
+        try:
+            await asyncio.sleep(3600)  # wedged forever
+        finally:
+            self.current_jobs -= 1
+
+
+async def test_watchdog_detects_decode_stall_and_requeues():
+    recorder = default_flight_recorder()
+    recorder.clear()
+    wd = WatchdogConfig(interval_ms=50, decode_stall_ms=250,
+                        dispatch_deadline_ms=60_000, requeue=True)
+    client, bus, registry, scheduler = await _make_stack(watchdog_config=wd)
+    wedged = WedgedWorker(bus, "w-wedged", ["m1"])
+    await wedged.start()
+    await bus.flush()
+
+    resp_task = asyncio.create_task(client.post(
+        "/ollama/api/generate", json={"model": "m1", "prompt": "go"}))
+    # wait until the watchdog flags the stall and requeues with reason hang
+    for _ in range(100):
+        await asyncio.sleep(0.05)
+        if scheduler.metrics.get("gridllm_hangs_total").value(
+                phase="decode-step"):
+            break
+    assert scheduler.metrics.get("gridllm_hangs_total").value(
+        phase="decode-step") >= 1
+
+    # the job was cancelled on the wedged worker and requeued (orphan path,
+    # reason hang) — a healthy worker then serves it to completion
+    await bus.flush()
+    assert wedged.cancelled  # cancellation delivered
+    healthy = FakeWorker(bus, "w-ok", ["m2", "m1"],
+                         stream_tokens=["a", "b"])
+    await healthy.start()
+    await bus.flush()
+    resp = await asyncio.wait_for(resp_task, 15)
+    assert resp.status == 200
+    await resp.text()
+    assert healthy.processed  # served by the replacement
+
+    # auto dump names the hung request, phase, and worker
+    dumps = recorder.auto_dumps()
+    hang_dumps = [d for d in dumps if d["reason"].startswith("hang:")]
+    assert hang_dumps, [d["reason"] for d in dumps]
+    hang = hang_dumps[0]["hang"]
+    assert hang["phase"] == "decode-step"
+    assert hang["worker"] == "w-wedged"
+    assert hang["requestId"]
+    # the hang marker landed on the trace
+    spans = scheduler.tracer.export(hang["requestId"])
+    assert any(s["name"] == "watchdog.hang" for s in spans)
+    text = scheduler.metrics.render()
+    assert 'gridllm_hangs_total{phase="decode-step"}' in text
+    await _teardown(client, bus, registry, scheduler, wedged, healthy)
+
+
+async def test_watchdog_flags_queue_hang_without_requeue():
+    wd = WatchdogConfig(interval_ms=50, queue_deadline_ms=100, requeue=True)
+    client, bus, registry, scheduler = await _make_stack(watchdog_config=wd)
+    # no worker serves the model → the job sits queued
+    req = InferenceRequest(id="q-hang", model="nope", prompt="x")
+    await scheduler.add_job(req)
+    for _ in range(60):
+        await asyncio.sleep(0.05)
+        if scheduler.metrics.get("gridllm_hangs_total").value(phase="queue"):
+            break
+    assert scheduler.metrics.get(
+        "gridllm_hangs_total").value(phase="queue") == 1
+    # still queued — queue hangs are diagnosis-only
+    assert scheduler.get_queue_position("q-hang") is not None
+    # flagged once, not once per sweep
+    await asyncio.sleep(0.3)
+    assert scheduler.metrics.get(
+        "gridllm_hangs_total").value(phase="queue") == 1
+    await _teardown(client, bus, registry, scheduler)
+
+
+async def test_worker_crash_triggers_auto_dump():
+    recorder = default_flight_recorder()
+    recorder.clear()
+    client, bus, registry, scheduler = await _make_stack()
+    w = FakeWorker(bus, "w-crash", ["m1"], heartbeat_interval_s=0.1)
+    await w.start()
+    await bus.flush()
+    await w.die()  # abrupt: no unregister, heartbeat key deleted
+    for _ in range(100):
+        await asyncio.sleep(0.05)
+        if any(d["reason"].startswith("worker_crash:")
+               for d in recorder.auto_dumps()):
+            break
+    crash = [d for d in recorder.auto_dumps()
+             if d["reason"].startswith("worker_crash:")]
+    assert crash, [d["reason"] for d in recorder.auto_dumps()]
+    assert crash[0]["crash"]["worker"] == "w-crash"
+    await _teardown(client, bus, registry, scheduler)
