@@ -97,6 +97,18 @@ _KV_PAGES_FREE = _OBS.gauge(
     "gridllm_engine_kv_pages_free", "KV page-pool pages free, by model.",
     ("model",),
 )
+_KV_PAGES_CACHED = _OBS.gauge(
+    "gridllm_engine_kv_pages_cached",
+    "KV page-pool pages parked in the prefix-cache reuse LRU (refcount 0, "
+    "evictable), by model.",
+    ("model",),
+)
+_PREFIX_HIT_RATE = _OBS.gauge(
+    "gridllm_prefix_cache_hit_rate",
+    "Cumulative prompt-page prefix-cache hit rate (hits / (hits+misses)), "
+    "by model.",
+    ("model",),
+)
 # flight recorder (obs/flightrec.py): lifecycle events land in the "engine"
 # ring; block dispatches are SAMPLED (one record per _FLIGHT_SAMPLE
 # generations) so the hot loop stays a deque append every few dozen steps
@@ -161,6 +173,15 @@ class EngineConfig:
     # static width of the per-slot repeat-penalty window buffer;
     # repeat_last_n (and its -1 → num_ctx resolution) clamps to this
     repeat_window: int = 256
+    # automatic prefix caching (ISSUE 3): completed requests park their
+    # full KV pages in a content-addressed reuse LRU; new requests skip
+    # prefill over their longest cached prefix. None → env
+    # GRIDLLM_PREFIX_CACHE (default on; "0" disables — bit-identical to
+    # the pre-cache engine). prefix_cache_pages bounds the LRU (None →
+    # GRIDLLM_PREFIX_CACHE_PAGES, default unbounded; 0 disables — same
+    # semantics as PageAllocator.cache_pages; negative → unbounded).
+    prefix_cache: bool | None = None
+    prefix_cache_pages: int | None = None
 
 
 @dataclasses.dataclass
@@ -183,6 +204,9 @@ class GenerationResult:
     context: list[int] = dataclasses.field(default_factory=list)
     done_reason: str = "stop"
     prompt_eval_count: int = 0
+    # prompt tokens served from the prefix cache (prefill skipped); always
+    # ≤ prompt_eval_count, 0 with caching off
+    cached_tokens: int = 0
     prompt_eval_duration_ns: int = 0
     eval_count: int = 0
     eval_duration_ns: int = 0
@@ -200,6 +224,7 @@ class _Slot:
     __slots__ = (
         "req", "ids", "prompt_len", "generated", "detok", "text", "emitted_len",
         "num_predict", "stop_seqs", "eos_ids", "capacity", "joined_gen",
+        "cached_tokens",
         "t_start", "t_prefill_ns", "t_first_decode", "t_last_ingest",
     )
 
@@ -216,6 +241,7 @@ class _Slot:
         self.stop_seqs = stop_seqs
         self.eos_ids = eos_ids
         self.capacity = capacity         # max total tokens this slot may hold
+        self.cached_tokens = 0           # prompt tokens reused from the prefix cache
         # dispatch generation of the FIRST decode block that will see this
         # slot: its row 0 (block-input tokens) carries the prefill-sampled
         # token; blocks with a lower generation predate the slot (or belong
@@ -272,6 +298,14 @@ class InferenceEngine:
             # single-device engines keep their kernels.
             self.cfg = dataclasses.replace(self.cfg, use_pallas=False)
         self._rng = random.Random(config.seed)
+        # prefix-cache capacity, resolved ONCE (env reads at startup, not
+        # per admission): 0 = off, < 0 = unbounded reuse LRU, > 0 = cap.
+        # sp > 1 prefills whole prompts via ring attention — there is no
+        # chunked path to start mid-prompt from, so caching is off there.
+        sp_prefill = self.mesh is not None and self.mesh.shape.get("sp", 1) > 1
+        self._prefix_cache_cap = (
+            0 if sp_prefill else self._resolve_prefix_cache_cap()
+        )
         self._lock = threading.Lock()
         self._pending: deque[GenerationRequest] = deque()
         self._slots: dict[int, _Slot] = {}
@@ -367,18 +401,39 @@ class InferenceEngine:
             | {self.max_context}
         )
 
+    def _resolve_prefix_cache_cap(self) -> int:
+        """EngineConfig overrides env; GRIDLLM_PREFIX_CACHE=0 disables,
+        GRIDLLM_PREFIX_CACHE_PAGES bounds the reuse LRU (default unbounded
+        — the whole page pool doubles as the cache, evicted on demand;
+        0 ALSO disables, matching PageAllocator.cache_pages)."""
+        on = self.config.prefix_cache
+        if on is None:
+            on = os.environ.get("GRIDLLM_PREFIX_CACHE", "1").lower() not in (
+                "0", "off", "false")
+        if not on:
+            return 0
+        pages = self.config.prefix_cache_pages
+        if pages is None:
+            raw = os.environ.get("GRIDLLM_PREFIX_CACHE_PAGES", "")
+            pages = int(raw) if raw else -1
+        return max(pages, -1)
+
     def _pool_head_dim(self) -> int:
         """Page-pool head dim: lane-padded to 128 when the Pallas kernels
         will run (Mosaic's alignment constraint), so d=64 models (qwen2.5
         class) keep the kernel decode path instead of the jnp gather
-        (VERDICT r04 #5). Interpret mode keeps the model's dim (tests stay
-        fast) unless GRIDLLM_POOL_PAD=1 forces the padded layout for
-        coverage. The ops dispatchers pad/slice at the boundary."""
-        from gridllm_tpu.ops.kvcache import _env_mode, lane_pad_dim
+        (VERDICT r04 #5). Resolved with the SAME policy the op dispatchers
+        use (_pallas_mode with the per-engine use_pallas override —
+        ADVICE r05), so a config that forces kernels on where the env says
+        off still gets the padded pool its kernels require. Interpret mode
+        keeps the model's dim (tests stay fast) unless GRIDLLM_POOL_PAD=1
+        forces the padded layout for coverage. The ops dispatchers
+        pad/slice at the boundary."""
+        from gridllm_tpu.ops.kvcache import _pallas_mode, lane_pad_dim
 
         d = self.cfg.head_dim_
-        use, interpret = _env_mode()
-        if not use or self.cfg.use_pallas is False:
+        use, interpret = _pallas_mode(self.cfg.use_pallas)
+        if not use:
             return d
         if interpret and os.environ.get("GRIDLLM_POOL_PAD") != "1":
             return d
@@ -389,13 +444,30 @@ class InferenceEngine:
         page allocator, sampler params, context counts, token/active rows."""
         c, mc = self.config, self.cfg
         dtype = jnp.dtype(c.dtype)
+        dpool = self._pool_head_dim()
+        if dpool != mc.head_dim_:
+            # lane padding multiplies KV bytes per page while num_pages is
+            # config-fixed — say so at startup instead of silently serving
+            # with a pool that costs dpool/d× the HBM the config budgeted
+            # (ADVICE r05: d=64 models pay 2×)
+            log.warning(
+                "page pool lane-padded; KV bytes per page scaled",
+                model=mc.name, head_dim=mc.head_dim_, pool_head_dim=dpool,
+                kv_bytes_factor=round(dpool / mc.head_dim_, 2),
+                num_pages=c.num_pages,
+                hint=f"to keep KV HBM at the unpadded budget, set "
+                     f"num_pages={int(c.num_pages * mc.head_dim_ / dpool)}",
+            )
         cache = PagedKVCache.create(
             mc.num_layers, c.num_pages, c.page_size, mc.num_kv_heads,
-            self._pool_head_dim(), c.max_slots, c.max_pages_per_slot,
+            dpool, c.max_slots, c.max_pages_per_slot,
             dtype=dtype,
         )
         self.cache = shard_cache(cache, self.mesh) if self.mesh else cache
-        self.alloc = PageAllocator(c.num_pages, c.page_size, c.max_pages_per_slot)
+        self.alloc = PageAllocator(
+            c.num_pages, c.page_size, c.max_pages_per_slot,
+            cache_pages=self._prefix_cache_cap, model=mc.name,
+        )
         self.sampling = SamplingParams.defaults(c.max_slots)
         self.counts = jnp.zeros((c.max_slots, mc.vocab_size), jnp.int32)
         # repeat-penalty window: last ≤ repeat_last_n context tokens per
@@ -549,6 +621,22 @@ class InferenceEngine:
             out = jnp.concatenate([first[None], toks])  # [k+1, S]
             return out, tokens, cache, counts, window, wlen, sp
 
+        # Prefix-cache warm admission (ISSUE 3): the cached region's tokens
+        # skip the model forward but must still flow through the
+        # repeat-penalty window/counts bookkeeping, or a warm request's
+        # sampler state (and therefore its tokens) would diverge from the
+        # cold path's. Same chunk shape as prefill_chunk_fn → one compiled
+        # program; integer-only state, so warm == cold bit for bit.
+        @partial(jax.jit, donate_argnums=(1, 2, 3))
+        def window_seed_fn(sp, window, wlen, counts, chunk, start, length,
+                           slot):
+            rl = sp.repeat_last_n[slot]
+            return window_set_slot(
+                window, wlen, counts, slot, chunk, start, length, rl,
+                mc.vocab_size,
+            )
+
+        self._window_seed_fn = window_seed_fn
         self._prefill_fn = prefill_fn
         self._prefill_chunk_fn = prefill_chunk_fn
         if self.cfg.vision:
@@ -672,9 +760,18 @@ class InferenceEngine:
             self._fail(req, f"context {want} exceeds slot capacity")
             return True
         slot = self._free_slots[-1]
+        # longest cached prefix first (pins matched pages via refcount),
+        # then allocate the remainder. Images are excluded — token ids
+        # alone don't address spliced pixel embeddings — and sp meshes
+        # have no chunked path to resume from (cap forced to 0 there).
+        cached = 0
+        if self._prefix_cache_cap != 0 and not images:
+            cached = self.alloc.match_prefix(slot, ids)
         pages = self.alloc.alloc(slot, want)
         if pages is None:
-            # pool exhausted: requeue at front, wait for a slot to free pages
+            # pool exhausted: unpin any matched prefix, requeue at front,
+            # wait for a slot to free pages
+            self.alloc.free(slot)
             with self._lock:
                 self._pending.appendleft(req)
             return False
@@ -704,6 +801,7 @@ class InferenceEngine:
             "seed": int(seed) & 0x7FFFFFFF,
             "step": 0,
         }
+        st.cached_tokens = cached
         row_list = self.alloc.table_row(slot)
         t0 = time.perf_counter_ns()
         with self.dispatch_lock:
@@ -713,7 +811,8 @@ class InferenceEngine:
             # MULTI-chunk prefill fails partway, the liaison's own stream
             # is already unpaired and the slice-failure machinery tears the
             # group down — there is no cheap reconciliation for that.)
-            self._dispatch_prefill(slot, ids, row_list, upd, images=images)
+            self._dispatch_prefill(slot, ids, row_list, upd, images=images,
+                                   cached=cached)
             if self.plan_sink is not None:
                 # SNAPSHOT the ids: the list is also _Slot.ids, which
                 # _ingest APPENDS generated tokens to — a by-reference
@@ -722,7 +821,8 @@ class InferenceEngine:
                 # the slice (caught by the vision replay test comparing
                 # follower state against the liaison's actual pool)
                 rec = {"op": "admit", "slot": slot, "ids": list(ids),
-                       "row": list(row_list), "sp": dict(upd)}
+                       "row": list(row_list), "sp": dict(upd),
+                       "cached": cached}
                 if images:
                     # raw base64 payload: followers re-run the
                     # deterministic preprocessing + encode themselves
@@ -734,16 +834,30 @@ class InferenceEngine:
         st.t_prefill_ns = time.perf_counter_ns() - t0
         st.joined_gen = self._gen + 1  # first block dispatched after this
         self._slots[slot] = st
-        _TOKENS_TOTAL.inc(len(ids), model=self.cfg.name, kind="prefill")
+        _TOKENS_TOTAL.inc(len(ids) - cached, model=self.cfg.name,
+                          kind="prefill")
+        if cached:
+            _TOKENS_TOTAL.inc(cached, model=self.cfg.name,
+                              kind="prefill_cached")
         _FLIGHTREC.record("engine", "admit", model=self.cfg.name,
-                          request=req.id, slot=slot, promptTokens=len(ids))
+                          request=req.id, slot=slot, promptTokens=len(ids),
+                          cachedTokens=cached)
         self._update_kv_gauges()
         return True
 
     def _update_kv_gauges(self) -> None:
         free = self.alloc.free_pages
+        cached = self.alloc.cached_pages
         _KV_PAGES_FREE.set(free, model=self.cfg.name)
-        _KV_PAGES_USED.set(self.config.num_pages - free, model=self.cfg.name)
+        _KV_PAGES_CACHED.set(cached, model=self.cfg.name)
+        # "used" = pages referenced by live requests; cached-but-evictable
+        # pages are their own series so dashboards don't read a warm cache
+        # as pool pressure
+        _KV_PAGES_USED.set(self.config.num_pages - free - cached,
+                           model=self.cfg.name)
+        total = self.alloc.hits + self.alloc.misses
+        if total:
+            _PREFIX_HIT_RATE.set(self.alloc.hits / total, model=self.cfg.name)
 
     def _expand_image_tokens(self, ids: list[int], n_images: int) -> list[int]:
         """Expand image placeholders to num_patches copies each (the splice
@@ -786,10 +900,15 @@ class InferenceEngine:
 
     def _dispatch_prefill(self, slot: int, ids: list[int],
                           row_list: list[int], upd: dict[str, Any],
-                          images: list[str] | None = None) -> None:
+                          images: list[str] | None = None,
+                          cached: int = 0) -> None:
         """The device half of admission — everything a multi-host follower
         must replay identically: sampler row update + prefill dispatch.
-        All inputs are plain host values (the admit plan record)."""
+        All inputs are plain host values (the admit plan record). `cached`
+        (page-aligned, from match_prefix) marks the prompt prefix whose KV
+        pages are already installed in `row_list`: those tokens skip the
+        model forward (window bookkeeping only) and chunked prefill starts
+        at the first uncached token."""
         self.sampling = SamplingParams(**{
             f.name: getattr(self.sampling, f.name).at[slot].set(upd[f.name])
             for f in dataclasses.fields(SamplingParams)
@@ -799,12 +918,24 @@ class InferenceEngine:
         # counts[slot] is cleared INSIDE prefill_fn / prefill_chunk_fn —
         # no host-side clear here (it would be a dead full-row rewrite)
         row = jnp.asarray(row_list, jnp.int32)
-        if self._use_chunked and len(ids) > self._chunk_len:
+        if cached or (self._use_chunked and len(ids) > self._chunk_len):
             # chunked prefill: repeated invocations of ONE fixed-shape
             # program against the growing cached prefix — no per-length
             # traces, no padding to a distant bucket (VERDICT.md #4)
             c = self._chunk_len
-            for s0 in range(0, len(ids), c):
+            for s0 in range(0, cached, c):
+                # cached region: repeat-penalty window/counts bookkeeping
+                # only (no model forward, no page writes) so the sampler
+                # state a warm request decodes with is bit-identical to
+                # the cold path's
+                part = ids[s0 : min(s0 + c, cached)]
+                padded = jnp.asarray(part + [0] * (c - len(part)), jnp.int32)
+                (self.window, self.wlen, self.counts) = self._window_seed_fn(
+                    self.sampling, self.window, self.wlen, self.counts,
+                    padded, jnp.int32(s0), jnp.int32(len(part)),
+                    jnp.int32(slot),
+                )
+            for s0 in range(cached, len(ids), c):
                 part = ids[s0 : s0 + c]
                 padded = jnp.asarray(part + [0] * (c - len(part)), jnp.int32)
                 embeds = None
@@ -852,6 +983,7 @@ class InferenceEngine:
                 int(rec["slot"]), [int(i) for i in rec["ids"]],
                 [int(p) for p in rec["row"]], dict(rec["sp"]),
                 images=list(rec.get("images") or []) or None,
+                cached=int(rec.get("cached", 0)),
             )
         elif op == "block":
             self._dispatch_block(int(rec["k"]))
@@ -918,6 +1050,7 @@ class InferenceEngine:
             context=list(st.ids),
             done_reason=reason,
             prompt_eval_count=st.prompt_len,
+            cached_tokens=st.cached_tokens,
             prompt_eval_duration_ns=st.t_prefill_ns,
             eval_count=len(st.generated),
             eval_duration_ns=(now - st.t_first_decode) if st.t_first_decode else 0,
@@ -928,7 +1061,19 @@ class InferenceEngine:
             self.active = self.active.at[slot].set(False)
             if self.plan_sink is not None:  # after-success; see _try_admit
                 self.plan_sink({"op": "deact", "slot": slot})
-        self.alloc.free(slot)
+        # Release pages into the prefix-cache reuse LRU, registering full
+        # pages of the final context (prompt + generated). The LAST token
+        # is excluded: a token's KV is written when it is INPUT to the next
+        # decode step, and for the final sampled token that step may not
+        # have been dispatched — every earlier position is provably written
+        # (its successor was sampled and ingested). An "error" finish may
+        # leave poisoned device state, so its pages are never registered
+        # (reset_device_state rebuilds the allocator wholesale anyway).
+        # Vision requests never register either: their KV encodes spliced
+        # pixel embeddings that identical token ids (image-token runs) do
+        # not capture, so a token-chain key would collide across images.
+        register = reason != "error" and not st.req.images
+        self.alloc.free(slot, st.ids[:-1] if register else None)
         self._update_kv_gauges()
         del self._slots[slot]
         self._free_slots.append(slot)
@@ -1292,4 +1437,11 @@ class InferenceEngine:
             "freeSlots": len(self._free_slots),
             "kvPagesFree": self.alloc.free_pages
             if not self.embedding_only else None,
+            "kvPagesCached": self.alloc.cached_pages
+            if not self.embedding_only else None,
+            "prefixCache": {
+                "hits": self.alloc.hits, "misses": self.alloc.misses,
+                "evictions": self.alloc.evictions,
+                "cowCopies": self.alloc.cow_copies,
+            } if not self.embedding_only else None,
         }

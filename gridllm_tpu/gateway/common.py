@@ -2,6 +2,8 @@
 
 from __future__ import annotations
 
+import hashlib
+import json as _json
 from typing import Any, Awaitable, Callable
 
 from aiohttp import web
@@ -13,6 +15,40 @@ from gridllm_tpu.utils.logging import get_logger
 from gridllm_tpu.utils.types import InferenceRequest, JobResult
 
 log = get_logger("gateway.common")
+
+
+def _truncate_part(v: Any, limit: int = 1024) -> Any:
+    """Bound a structured prefix-key part BEFORE serialization — a 500 KB
+    system message must not be json.dumps'd in full on the request hot
+    path just to keep its first kilobyte."""
+    if isinstance(v, str):
+        return v[:limit]
+    if isinstance(v, dict):
+        return {k: _truncate_part(x, limit) for k, x in v.items()}
+    if isinstance(v, (list, tuple)):
+        return [_truncate_part(x, limit) for x in v[:8]]
+    return v
+
+
+def prefix_key(model: str, *parts: Any) -> str:
+    """Stable content key for a request's reusable prompt prefix (ISSUE 3).
+
+    Hash of the model plus the rendered system prompt / leading message
+    content (first ~1 KiB per string) — enough to identify the shared
+    prefix of templated and multi-turn workloads WITHOUT the scheduler
+    ever seeing token ids. Stamped as metadata.prefixKey by the inference
+    routes; workers heartbeat the keys they recently served and worker
+    selection scores the overlap (prefix-affinity routing)."""
+    h = hashlib.blake2b(digest_size=8)
+    h.update(model.encode("utf-8", "replace"))
+    for p in parts:
+        h.update(b"\x1f")
+        if p is None:
+            continue
+        if not isinstance(p, str):
+            p = _json.dumps(_truncate_part(p), sort_keys=True, default=str)
+        h.update(p[:1024].encode("utf-8", "replace"))
+    return h.hexdigest()
 
 
 async def submit(req: InferenceRequest, scheduler: JobScheduler,

@@ -14,6 +14,7 @@ import uuid
 
 from aiohttp import web
 
+from gridllm_tpu.gateway.common import prefix_key
 from gridllm_tpu.gateway.errors import ApiError
 from gridllm_tpu.scheduler import JobScheduler, WorkerRegistry
 from gridllm_tpu.scheduler.scheduler import JobTimeoutError
@@ -44,6 +45,7 @@ def build_routes(registry: WorkerRegistry, scheduler: JobScheduler) -> list[web.
             priority=Priority(priority),
             timeout=body.get("timeout") or 300_000,
             metadata={"endpoint": "/inference", "requestType": "inference",
+                      "prefixKey": prefix_key(model, str(prompt)[:512]),
                       "submittedAt": iso_now()},
         )
         try:

@@ -35,7 +35,12 @@ from gridllm_tpu.gateway.convert import (
     to_ollama_generate,
     write_ndjson,
 )
-from gridllm_tpu.gateway.common import guarded_stream, response_dict, submit
+from gridllm_tpu.gateway.common import (
+    guarded_stream,
+    prefix_key,
+    response_dict,
+    submit,
+)
 from gridllm_tpu.gateway.errors import ApiError
 from gridllm_tpu.scheduler import JobScheduler, WorkerRegistry
 from gridllm_tpu.utils.logging import get_logger
@@ -183,6 +188,10 @@ def build_routes(registry: WorkerRegistry, scheduler: JobScheduler,
                 "raw": body.get("raw"),
                 "keep_alive": body.get("keep_alive"),
                 "context": body.get("context"),
+                # stable prefix identity (system prompt + leading prompt
+                # text) for the scheduler's prefix-affinity routing
+                "prefixKey": prefix_key(model, body.get("system"),
+                                        (prompt or "")[:512]),
                 "submittedAt": iso_now(),
             },
         )
@@ -240,6 +249,9 @@ def build_routes(registry: WorkerRegistry, scheduler: JobScheduler,
                 "requestType": "chat",   # fix: reference never set this (§2.8)
                 "think": body.get("think"),
                 "keep_alive": body.get("keep_alive"),
+                # system prompt + leading messages identify the reusable
+                # conversation prefix (prefix-affinity routing)
+                "prefixKey": prefix_key(model, messages[:2]),
                 "submittedAt": iso_now(),
             },
         )

@@ -26,7 +26,12 @@ from gridllm_tpu.gateway.convert import (
     to_openai_completion,
     write_sse,
 )
-from gridllm_tpu.gateway.common import guarded_stream, response_dict, submit
+from gridllm_tpu.gateway.common import (
+    guarded_stream,
+    prefix_key,
+    response_dict,
+    submit,
+)
 from gridllm_tpu.gateway.errors import OpenAIApiError
 from gridllm_tpu.scheduler import JobScheduler, WorkerRegistry
 from gridllm_tpu.utils.logging import get_logger
@@ -154,6 +159,7 @@ def build_routes(registry: WorkerRegistry, scheduler: JobScheduler,
                     "tool_choice": body.get("tool_choice"),
                     "user": body.get("user"),
                 },
+                "prefixKey": prefix_key(model, ollama_messages[:2]),
                 "submittedAt": iso_now(),
             },
         )
@@ -244,6 +250,7 @@ def build_routes(registry: WorkerRegistry, scheduler: JobScheduler,
                 "openaiEndpoint": "/v1/completions",
                 "requestType": "inference",
                 "ollamaEndpoint": "/api/generate",
+                "prefixKey": prefix_key(model, prompt[:512]),
                 "submittedAt": iso_now(),
             },
         )

@@ -22,7 +22,9 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import hashlib
 import os
+from collections import OrderedDict
 from functools import partial
 
 import jax
@@ -46,6 +48,38 @@ _KERNEL_DISPATCH = default_registry().counter(
 
 def record_kernel_path(op: str, kernel: bool) -> None:
     _KERNEL_DISPATCH.inc(op=op, path="pallas" if kernel else "jnp")
+
+
+# Automatic prefix caching (ISSUE 3): page-granular reuse accounting.
+# hit/miss are counted in PAGES of the prompt at admission time (a hit page
+# is prefill compute skipped, a miss page is prefill compute paid), so
+# hits / (hits + misses) is the prompt-page hit rate the engine exports as
+# gridllm_prefix_cache_hit_rate. evictions = cached pages reclaimed for
+# fresh allocations; cow_copies = tail pages that WERE cached but had to be
+# privately rebuilt because the request writes into them (the last-token /
+# partial-tail copy-on-write, realized as recompute-into-a-fresh-page).
+_PREFIX_HITS = default_registry().counter(
+    "gridllm_prefix_cache_hits_total",
+    "Prompt pages served from the prefix cache (prefill skipped), by model.",
+    ("model",),
+)
+_PREFIX_MISSES = default_registry().counter(
+    "gridllm_prefix_cache_misses_total",
+    "Prompt pages not found in the prefix cache (prefill paid), by model.",
+    ("model",),
+)
+_PREFIX_EVICTIONS = default_registry().counter(
+    "gridllm_prefix_cache_evictions_total",
+    "Cached prefix pages evicted (LRU) to satisfy fresh allocations, "
+    "by model.",
+    ("model",),
+)
+_PREFIX_COW = default_registry().counter(
+    "gridllm_prefix_cache_cow_copies_total",
+    "Cached tail pages privately rebuilt because the request writes into "
+    "them (copy-on-write of the partial tail page), by model.",
+    ("model",),
+)
 
 
 @functools.cache
@@ -416,22 +450,80 @@ def gather_kv(
     return pages_k.reshape(n, kvh, d), pages_v.reshape(n, kvh, d)
 
 
+def _page_chain_key(parent: bytes, tokens: list[int]) -> bytes:
+    """Content-address of one FULL page given its prefix: the hash chain
+    hash(parent_hash, page_token_ids). blake2b so collisions are
+    cryptographically negligible — a collision here would silently serve
+    another prompt's KV."""
+    h = hashlib.blake2b(parent, digest_size=16)
+    h.update(b" ".join(b"%d" % t for t in tokens))
+    return h.digest()
+
+
 class PageAllocator:
-    """Host-side free-list page allocator (plain Python, not traced).
+    """Host-side ref-counted page allocator (plain Python, not traced).
 
     Owns which pages back which slot; the device only sees the resulting
     int32 tables. O(1) alloc/free per page.
+
+    Automatic prefix caching (ISSUE 3): pages holding FULL pages of a
+    completed request's context are content-addressed by a hash chain
+    (key_i = hash(key_{i-1}, page_i_token_ids)) and, once their refcount
+    drops to zero, parked in an LRU of reusable blocks instead of the free
+    list. A new request matches its longest cached prefix page-by-page,
+    bumps refcounts, and shares those pages copy-free; fresh allocations
+    evict from the LRU only when the free list is empty. `cache_pages`
+    bounds the LRU (0 disables caching entirely — byte-identical to the
+    pre-cache allocator; a negative value means unbounded).
+
+    Sharing is page-aligned and read-only by construction: a matched page
+    is fully covered by the new request's prompt minus its last token (the
+    last token must run through the model to produce logits), prefill
+    starts writing at the page boundary after the match, and decode writes
+    land past the prompt — so a shared page is never written while shared,
+    and a refcount pins it against eviction for as long as any request
+    reads it.
     """
 
-    def __init__(self, num_pages: int, page_size: int, max_pages_per_slot: int):
+    def __init__(self, num_pages: int, page_size: int,
+                 max_pages_per_slot: int, cache_pages: int = 0,
+                 model: str = ""):
         self.page_size = page_size
         self.max_pages_per_slot = max_pages_per_slot
+        self.cache_pages = cache_pages
+        self.model = model or "unknown"
         self._free: list[int] = list(range(num_pages - 1, -1, -1))
         self._owned: dict[int, list[int]] = {}
+        self._refs: dict[int, int] = {}          # page → owners (≥ 1)
+        self._key_of: dict[int, bytes] = {}      # page → registered chain key
+        self._page_by_key: dict[bytes, int] = {}  # chain key → page
+        self._lru: OrderedDict[int, None] = OrderedDict()  # ref-0 cached pages
+        # match accounting staged per slot by match_prefix and committed by
+        # the matching alloc() — a pool-exhausted admission retry re-runs
+        # match_prefix, and counting there would tally the same prompt's
+        # pages once per retry
+        self._staged_stats: dict[int, tuple[int, int, bool]] = {}
+        # cumulative counters (mirrored into the obs registry); kept as
+        # plain ints so the engine can compute a hit rate without reading
+        # the registry back
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        self.cow_copies = 0
 
     @property
     def free_pages(self) -> int:
         return len(self._free)
+
+    @property
+    def cached_pages(self) -> int:
+        """Reusable (refcount-0, content-addressed) pages parked in the LRU."""
+        return len(self._lru)
+
+    @property
+    def reclaimable_pages(self) -> int:
+        """Pages a fresh allocation can obtain: free + evictable cached."""
+        return len(self._free) + len(self._lru)
 
     def pages_for(self, num_tokens: int) -> int:
         return -(-num_tokens // self.page_size)
@@ -440,30 +532,150 @@ class PageAllocator:
         """True iff a FRESH slot could ever hold num_tokens: within both the
         per-slot page cap (permanent) and the current free pool (transient)."""
         need = self.pages_for(num_tokens)
-        return need <= self.max_pages_per_slot and need <= len(self._free)
+        return need <= self.max_pages_per_slot and need <= self.reclaimable_pages
 
     def fits_slot_cap(self, num_tokens: int) -> bool:
         """Permanent-capacity check only (retrying can't fix a False)."""
         return self.pages_for(num_tokens) <= self.max_pages_per_slot
 
+    def _take_page(self) -> int | None:
+        if self._free:
+            return self._free.pop()
+        if self._lru:  # evict the least-recently-released cached block
+            page, _ = self._lru.popitem(last=False)
+            self._drop_key(page)
+            self.evictions += 1
+            _PREFIX_EVICTIONS.inc(model=self.model)
+            return page
+        return None
+
+    def _drop_key(self, page: int) -> None:
+        key = self._key_of.pop(page, None)
+        if key is not None and self._page_by_key.get(key) == page:
+            del self._page_by_key[key]
+
+    def match_prefix(self, slot: int, token_ids: list[int]) -> int:
+        """Pin the longest cached prefix of `token_ids` to a FRESH slot.
+
+        Walks the hash chain one full page at a time, bumping each matched
+        page's refcount (removing it from the eviction LRU) and appending
+        it to the slot's page list. The match is capped at the last page
+        boundary strictly below len(token_ids): the final token must be
+        recomputed to produce the sampled-token logits, so a fully-cached
+        prompt still prefills its tail. Returns the number of cached
+        TOKENS (a multiple of page_size; 0 when caching is off)."""
+        if self.cache_pages == 0:
+            return 0
+        owned = self._owned.setdefault(slot, [])
+        if owned:  # match only seeds a fresh slot
+            return 0
+        ps = self.page_size
+        max_full = min((len(token_ids) - 1) // ps, self.max_pages_per_slot)
+        key = b""
+        matched = 0
+        cow = False
+        for i in range(max_full):
+            key = _page_chain_key(key, token_ids[i * ps:(i + 1) * ps])
+            page = self._page_by_key.get(key)
+            if page is None:
+                break
+            self._lru.pop(page, None)
+            self._refs[page] = self._refs.get(page, 0) + 1
+            owned.append(page)
+            matched += 1
+        else:
+            # whole cap matched: if the NEXT full page is cached too, the
+            # request is about to write into a page the cache holds — the
+            # partial-tail copy-on-write (rebuilt privately by prefill)
+            if (max_full + 1) * ps <= len(token_ids):
+                tail_key = _page_chain_key(
+                    key, token_ids[max_full * ps:(max_full + 1) * ps])
+                cow = tail_key in self._page_by_key
+        # stage the accounting; the successful alloc() commits it (an
+        # admission that bounces off an exhausted pool retries this whole
+        # sequence and must not re-count the same prompt)
+        self._staged_stats[slot] = (
+            matched, self.pages_for(len(token_ids)), cow)
+        return matched * ps
+
+    def _commit_match_stats(self, slot: int) -> None:
+        staged = self._staged_stats.pop(slot, None)
+        if staged is None:
+            return
+        matched, prompt_pages, cow = staged
+        self.hits += matched
+        self.misses += prompt_pages - matched
+        if matched:
+            _PREFIX_HITS.inc(matched, model=self.model)
+        if prompt_pages - matched:
+            _PREFIX_MISSES.inc(prompt_pages - matched, model=self.model)
+        if cow:
+            self.cow_copies += 1
+            _PREFIX_COW.inc(model=self.model)
+
     def alloc(self, slot: int, num_tokens: int) -> list[int] | None:
         """Ensure `slot` owns enough pages for `num_tokens` total tokens.
         Returns the slot's full page list, or None if the pool is exhausted
         (caller must preempt/queue — mirrors the scheduler holding jobs when
-        no worker has capacity, reference JobScheduler.ts:176-204)."""
+        no worker has capacity, reference JobScheduler.ts:176-204). Pages
+        pinned by match_prefix count toward the total; fresh pages come
+        from the free list first, then evict the reuse LRU."""
         owned = self._owned.setdefault(slot, [])
         need = self.pages_for(num_tokens) - len(owned)
-        if need > len(self._free):
+        if need > self.reclaimable_pages:
             return None
         if need > self.max_pages_per_slot - len(owned):
             return None
         for _ in range(max(0, need)):
-            owned.append(self._free.pop())
+            page = self._take_page()
+            assert page is not None  # guarded by reclaimable check above
+            self._refs[page] = 1
+            owned.append(page)
+        self._commit_match_stats(slot)
         return owned
 
-    def free(self, slot: int) -> None:
-        for p in self._owned.pop(slot, []):
-            self._free.append(p)
+    def free(self, slot: int, token_ids: list[int] | None = None) -> None:
+        """Release a slot's pages. With `token_ids` (the request's final
+        context, prompt + generated — KV fully written on device), full
+        pages are first registered under their chain keys so future
+        requests can match them. Each page's refcount then drops; at zero a
+        registered page parks in the reuse LRU, an unregistered one returns
+        to the free list."""
+        self._staged_stats.pop(slot, None)  # uncommitted match: retry path
+        owned = self._owned.pop(slot, [])
+        if token_ids is not None and self.cache_pages != 0:
+            n_full = min(len(token_ids) // self.page_size, len(owned))
+            key = b""
+            for i in range(n_full):
+                key = _page_chain_key(
+                    key, token_ids[i * self.page_size:(i + 1) * self.page_size]
+                )
+                page = owned[i]
+                cur = self._page_by_key.get(key)
+                if cur is None and page not in self._key_of:
+                    # first holder of this content wins; a page already
+                    # registered under another key (matched from cache)
+                    # keeps its identity, duplicates stay unregistered and
+                    # fall back to the free list on release
+                    self._page_by_key[key] = page
+                    self._key_of[page] = key
+        for page in owned:
+            refs = self._refs.get(page, 1) - 1
+            if refs > 0:
+                self._refs[page] = refs
+                continue
+            self._refs.pop(page, None)
+            if page in self._key_of:
+                self._lru[page] = None  # most-recently released
+                cap = self.cache_pages
+                while cap > 0 and len(self._lru) > cap:
+                    old, _ = self._lru.popitem(last=False)
+                    self._drop_key(old)
+                    self.evictions += 1
+                    _PREFIX_EVICTIONS.inc(model=self.model)
+                    self._free.append(old)
+            else:
+                self._free.append(page)
 
     def table_row(self, slot: int) -> list[int]:
         owned = self._owned.get(slot, [])

@@ -175,6 +175,13 @@ class WorkerRegistry(EventEmitter):
         # only refresh liveness and surface error states.
         if data.get("status") == "error":
             info.status = "error"
+        # Prefix-affinity digest (ISSUE 3): the worker's recently-served
+        # prefix keys ride each heartbeat; bounded here so a misbehaving
+        # worker cannot bloat the registry hash
+        prefixes = data.get("prefixKeys")
+        if isinstance(prefixes, list):
+            # keys arrive oldest→newest; keep the newest when truncating
+            info.cachedPrefixes = [str(k) for k in prefixes[-64:]]
         # Persist so a restarted server doesn't see a stale lastHeartbeat and
         # evict live workers (reference hsets every beat too).
         await self.bus.hset(WORKERS_KEY, worker_id, info.model_dump_json())

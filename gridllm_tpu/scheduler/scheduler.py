@@ -566,7 +566,12 @@ class JobScheduler(EventEmitter):
         1. context fit — a worker whose layout for this model cannot hold
            the request's `num_ctx` loses to one that can;
         2. proportional load — currentJobs / maxConcurrentTasks (absolute
-           job counts are unfair between differently-sized workers);
+           job counts are unfair between differently-sized workers) —
+           minus the prefix-affinity bonus when the worker's heartbeat
+           digest contains the job's prefixKey (ISSUE 3): cached-prefix
+           overlap breaks load ties and outweighs load gaps up to
+           prefix_affinity_weight, but never the availability cap, so a
+           hot worker still sheds;
         3. layout headroom — more batch slots on the serving layout wins
            (a v5e-8 TP worker with 16 slots beats a single-chip 4-slot
            worker at equal relative load);
@@ -581,6 +586,8 @@ class JobScheduler(EventEmitter):
             num_ctx = int(opts.get("num_ctx") or 0)
         except (TypeError, ValueError):
             num_ctx = 0
+        prefix_key = (request.metadata or {}).get("prefixKey")
+        affinity_w = self.config.prefix_affinity_weight
 
         def score(w: WorkerInfo) -> tuple[int, float, int, int]:
             caps = w.capabilities
@@ -589,9 +596,12 @@ class JobScheduler(EventEmitter):
             )
             ctx_ok = layout is None or num_ctx <= 0 or num_ctx <= layout.maxSeqLen
             slots = layout.maxBatchSlots if layout is not None else 1
+            load = w.currentJobs / max(caps.maxConcurrentTasks, 1)
+            if prefix_key and affinity_w and prefix_key in w.cachedPrefixes:
+                load -= affinity_w
             return (
                 0 if ctx_ok else 1,
-                w.currentJobs / max(caps.maxConcurrentTasks, 1),
+                load,
                 -slots,
                 _TIER_RANK.get(caps.performanceTier, 1),
             )

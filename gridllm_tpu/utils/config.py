@@ -66,6 +66,13 @@ class SchedulerConfig(BaseModel):
     # TPU change: the reference polled a 1 s tick (JobScheduler.ts:128-135);
     # we dispatch event-driven, with this tick only as a fallback sweep.
     sweep_interval_ms: int = Field(1_000, gt=0)
+    # Prefix-affinity routing (ISSUE 3): a worker whose heartbeat digest
+    # contains the job's prefixKey gets this subtracted from its
+    # proportional-load score. Affinity never overrides the load cap
+    # (candidates are pre-filtered by availability) — it breaks ties and
+    # outweighs load differences up to this fraction, so a hot worker
+    # still sheds. 0 disables the term.
+    prefix_affinity_weight: float = Field(0.25, ge=0)
 
 
 class GatewayConfig(BaseModel):
@@ -235,6 +242,8 @@ def load_config() -> Config:
                 retry_delay_ms=_env("JOB_RETRY_DELAY", 5_000),
                 max_concurrent_jobs_per_worker=_env("MAX_CONCURRENT_JOBS_PER_WORKER", 1),
                 sweep_interval_ms=_env("SCHEDULER_SWEEP_INTERVAL", 1_000),
+                prefix_affinity_weight=_env(
+                    "GRIDLLM_PREFIX_AFFINITY_WEIGHT", 0.25),
             ),
             gateway=GatewayConfig(
                 host=_env("HOST", "0.0.0.0"),

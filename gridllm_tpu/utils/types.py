@@ -125,6 +125,12 @@ class WorkerInfo(_Model):
     registeredAt: float = Field(default_factory=time.time)
     totalJobsProcessed: int = 0
     connectionHealth: Literal["healthy", "degraded", "unhealthy"] = "healthy"
+    # TPU addition (ISSUE 3): compact digest of prefix keys this worker
+    # recently served — serving a request warms its engine's KV prefix
+    # cache, so these approximate "prefixes cached here". Refreshed from
+    # heartbeats; the scheduler scores cached-prefix overlap against a
+    # job's metadata.prefixKey (prefix-affinity routing).
+    cachedPrefixes: list[str] = Field(default_factory=list)
 
     def model_names(self) -> list[str]:
         return [m.name for m in self.capabilities.availableModels]

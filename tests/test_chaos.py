@@ -38,6 +38,7 @@ from gridllm_tpu.utils.config import (
     WorkerConfig,
 )
 from gridllm_tpu.utils.types import StreamChunk, iso_now
+from gridllm_tpu.worker.service import WorkerService
 
 from .helpers import FakeWorker
 

@@ -229,9 +229,18 @@ async def test_metrics_and_trace_through_real_engine(tiny_engine):
         # engine token counters (process-global registry)
         assert f'gridllm_engine_tokens_total{{model="{MODEL}",kind="decode"}}' in text
         assert f'gridllm_engine_tokens_total{{model="{MODEL}",kind="prefill"}}' in text
-        # KV page-pool gauges: pool fully free again after the request
+        # KV page-pool gauges: no pages referenced after the request; the
+        # prefix cache (ISSUE 3) may retain released pages as reusable, so
+        # free + cached must account for the whole pool
         assert f'gridllm_engine_kv_pages_used{{model="{MODEL}"}} 0' in text
-        assert f'gridllm_engine_kv_pages_free{{model="{MODEL}"}} 64' in text
+        free = cached = None
+        for line in text.splitlines():
+            if line.startswith(f'gridllm_engine_kv_pages_free{{model="{MODEL}"}}'):
+                free = float(line.rsplit(" ", 1)[1])
+            elif line.startswith(f'gridllm_engine_kv_pages_cached{{model="{MODEL}"}}'):
+                cached = float(line.rsplit(" ", 1)[1])
+        assert free is not None and cached is not None
+        assert free + cached == 64
         # kernel-vs-jnp dispatch counters (jnp fallback on the CPU backend)
         assert 'gridllm_kernel_dispatch_total{op="attention_decode",path="jnp"}' in text
         # engine step/occupancy histograms populated

@@ -1,0 +1,368 @@
+"""Automatic prefix caching (ISSUE 3).
+
+Allocator: ref-counted content-addressed pages, reuse LRU, eviction, COW
+accounting. Engine: identical prompts run cold vs warm produce byte-identical
+token streams (the warm run provably hitting the cache), eviction pressure
+mid-decode never touches pinned pages, and GRIDLLM_PREFIX_CACHE=0 restores
+the pre-cache allocator behavior exactly. Scheduler: prefix-affinity routing
+breaks ties and weighs, but never overrides load caps.
+"""
+
+import json
+import uuid
+
+import pytest
+
+from gridllm_tpu.engine import EngineConfig, GenerationRequest, InferenceEngine
+from gridllm_tpu.ops.kvcache import PageAllocator
+
+TINY = dict(
+    model="tiny-llama",
+    max_slots=4,
+    page_size=8,
+    num_pages=64,
+    max_pages_per_slot=8,
+    prefill_buckets=(16, 32),
+    prefill_chunk=16,
+)
+
+
+# ---------------------------------------------------------------------------
+# allocator
+# ---------------------------------------------------------------------------
+
+def test_allocator_match_refcount_and_registration():
+    a = PageAllocator(8, 4, 8, cache_pages=-1)
+    ids = list(range(10))  # 2 full pages + a partial tail
+    pages = a.alloc(0, 10)
+    assert len(pages) == 3
+    a.free(0, ids)
+    # the 2 full pages register and park in the LRU; the tail page frees
+    assert a.cached_pages == 2
+    assert a.free_pages == 6
+    # warm: the same prefix matches both full pages (capped below the last
+    # token — (10-1)//4 = 2 pages = 8 tokens), pinning them out of the LRU
+    cached = a.match_prefix(1, ids)
+    assert cached == 8
+    assert a.cached_pages == 0
+    owned = a.alloc(1, 10)
+    assert owned[:2] == pages[:2]  # shared copy-free
+    assert a.hits == 2 and a.misses == 1  # 3 prompt pages, 2 hit
+    a.free(1, ids)
+    assert a.cached_pages == 2  # released back into the LRU
+
+
+def test_allocator_divergent_prefix_does_not_match():
+    a = PageAllocator(8, 4, 8, cache_pages=-1)
+    ids = list(range(10))
+    a.alloc(0, 10)
+    a.free(0, ids)
+    other = [99] + ids[1:]  # first page differs → chain breaks at page 0
+    assert a.match_prefix(1, other) == 0
+
+
+def test_allocator_eviction_spares_pinned_pages_and_counts_cow():
+    a = PageAllocator(4, 4, 4, cache_pages=-1)
+    ids = [1, 2, 3, 4, 5, 6, 7, 8]  # exactly 2 full pages
+    first = a.alloc(0, 8)
+    a.free(0, ids)
+    assert a.cached_pages == 2
+    # warm match caps at (8-1)//4 = 1 page; the second page IS cached but
+    # must be privately rebuilt (the last token lives in it) → a COW copy
+    cached = a.match_prefix(1, ids)
+    assert cached == 4
+    assert a.alloc(1, 8) is not None  # admission succeeds → stats commit
+    assert a.cow_copies == 1
+    # pool pressure: 2 fresh pages wanted, 1 free + 1 evictable; the pinned
+    # page must survive, the unpinned cached page is evicted
+    assert a.alloc(2, 8) is not None
+    assert a.evictions == 1
+    assert first[0] in a.table_row(1)  # pinned page still backs slot 1
+    a.free(1)
+    a.free(2)
+    assert a.cached_pages == 1  # the still-registered pinned page returns
+
+
+def test_allocator_lru_cap_bounds_cached_pages():
+    a = PageAllocator(16, 4, 8, cache_pages=2)
+    for slot in range(3):
+        ids = [slot * 100 + i for i in range(8)]
+        a.alloc(slot, 8)
+        a.free(slot, ids)
+    assert a.cached_pages == 2
+    assert a.evictions == 4
+    assert a.free_pages == 14
+
+
+def test_allocator_match_stats_count_once_across_retries():
+    """A pool-exhausted admission bounces: match → alloc fails → free →
+    requeue → match again. The prompt's pages must be counted ONCE, at the
+    admission that actually succeeds — not once per retry."""
+    a = PageAllocator(4, 4, 8, cache_pages=-1)
+    ids = list(range(8))
+    a.alloc(0, 8)
+    a.free(0, ids)  # 2 cached pages
+    assert a.match_prefix(1, ids) == 4
+    assert a.alloc(1, 40) is None  # 10 pages wanted, pool has 4
+    a.free(1)  # engine unpins and requeues
+    assert a.hits == 0 and a.misses == 0  # nothing committed
+    assert a.match_prefix(1, ids) == 4
+    assert a.alloc(1, 8) is not None
+    assert a.hits == 1 and a.misses == 1  # counted exactly once
+
+
+def test_allocator_disabled_is_legacy_behavior():
+    a = PageAllocator(8, 4, 8)  # cache_pages=0 → prefix caching off
+    a.alloc(0, 10)
+    a.free(0, list(range(10)))
+    assert a.cached_pages == 0 and a.free_pages == 8
+    assert a.match_prefix(1, list(range(10))) == 0
+    assert a.hits == 0 and a.misses == 0
+
+
+# ---------------------------------------------------------------------------
+# engine: cold vs warm differential
+# ---------------------------------------------------------------------------
+
+def _gen(eng, rid, prompt, opts, sink=None):
+    return eng.generate(GenerationRequest(
+        id=rid, prompt=prompt, options=opts,
+        on_chunk=(lambda d, done, r: sink.append(d)) if sink is not None
+        else None,
+    ))
+
+
+def test_cold_vs_warm_identical_token_stream():
+    eng = InferenceEngine(EngineConfig(**TINY))
+    prompt = "abcdefgh" * 5  # 41 ids + BOS → 5 full pages of cached prefix
+    opts = {"temperature": 0.0, "num_predict": 8}
+    cold_chunks: list = []
+    warm_chunks: list = []
+    cold = _gen(eng, "cold", prompt, opts, cold_chunks)
+    assert eng.alloc.hits == 0 and cold.cached_tokens == 0
+    warm = _gen(eng, "warm", prompt, opts, warm_chunks)
+    assert eng.alloc.hits > 0, "warm run did not hit the prefix cache"
+    assert warm.cached_tokens > 0 and warm.cached_tokens % TINY["page_size"] == 0
+    assert warm.token_ids == cold.token_ids
+    assert warm.text == cold.text
+    assert "".join(warm_chunks) == "".join(cold_chunks)
+    # Ollama surface unchanged: prompt_eval_count stays the FULL prompt
+    assert warm.prompt_eval_count == cold.prompt_eval_count
+
+
+def test_cold_bucket_vs_warm_chunk_short_prompt_identical():
+    """Prompts shorter than prefill_chunk run cold through the bucketed
+    whole-prompt program but warm through the chunk program; greedy
+    outputs must still agree (the same numerical equivalence the existing
+    chunked-vs-single-shot prefill test relies on)."""
+    eng = InferenceEngine(EngineConfig(**{**TINY, "prefill_chunk": 64}))
+    prompt = "abcdefgh" * 5  # 42 ids ≤ chunk 64 → cold takes the bucket path
+    opts = {"temperature": 0.0, "num_predict": 8}
+    cold = _gen(eng, "c", prompt, opts)
+    warm = _gen(eng, "w", prompt, opts)
+    assert warm.cached_tokens > 0
+    assert warm.token_ids == cold.token_ids
+    assert warm.text == cold.text
+
+
+def test_warm_sampler_state_matches_cold_seeded_with_penalty():
+    """The repeat-penalty window spans the cached region (repeat_last_n >
+    uncached tail): warm must replay the cached tokens through the window
+    bookkeeping or seeded sampling would diverge from the cold path."""
+    eng = InferenceEngine(EngineConfig(**TINY))
+    prompt = "abcabcab" * 5  # repetitive → the penalty actually bites
+    opts = {"temperature": 0.9, "seed": 123, "num_predict": 10,
+            "repeat_penalty": 1.5, "repeat_last_n": 64}
+    cold = _gen(eng, "c", prompt, opts)
+    warm = _gen(eng, "w", prompt, opts)
+    assert warm.cached_tokens > 0
+    assert warm.token_ids == cold.token_ids
+
+
+def test_multiturn_context_reuses_previous_generation():
+    """Turn 2's prompt = turn 1's full context (Ollama multi-turn shape):
+    the cached pages cover prompt AND generated tokens of turn 1."""
+    eng = InferenceEngine(EngineConfig(**TINY))
+    opts = {"temperature": 0.0, "num_predict": 12}
+    t1 = _gen(eng, "t1", "abcdefgh" * 4, opts)
+    follow = GenerationRequest(id="t2", prompt_ids=list(t1.context) + [65, 66],
+                               options=opts)
+    t2 = eng.generate(follow)
+    # turn 1's context is 44+ tokens → at least 4 full pages reusable
+    assert t2.cached_tokens >= 4 * TINY["page_size"]
+    assert t2.done_reason in ("stop", "length")
+
+
+def test_evicted_cache_mid_decode_completes_correctly():
+    """Eviction pressure while a warm request decodes: refcounts pin its
+    matched pages, only unpinned cached pages are reclaimed, and the warm
+    output stays identical to the cold run."""
+    cfg = EngineConfig(**{**TINY, "num_pages": 20})
+    eng = InferenceEngine(cfg)
+    prompt_a = "abcdefgh" * 5
+    prompt_b = "hgfedcba" * 5
+    opts = {"temperature": 0.0, "num_predict": 8}
+    cold_a = _gen(eng, "cold-a", prompt_a, opts)
+    _gen(eng, "cold-b", prompt_b, opts)  # second cached chain (evictable)
+    results: dict = {}
+
+    def mk(name):
+        def cb(d, done, res):
+            if done:
+                results[name] = res
+        return cb
+
+    eng.submit(GenerationRequest(id="warm", prompt=prompt_a, options=opts,
+                                 on_chunk=mk("warm")))
+    for _ in range(3):  # admit + a few decode steps
+        eng.step()
+    evictions_before = eng.alloc.evictions
+    # pool-hungry stranger (no shared prefix) forces evictions mid-decode
+    eng.submit(GenerationRequest(id="filler", prompt="qrstuvwx" * 6,
+                                 options={"temperature": 0.0,
+                                          "num_predict": 12},
+                                 on_chunk=mk("filler")))
+    while len(results) < 2:
+        eng.step()
+    assert eng.alloc.evictions > evictions_before, (
+        "setup failed to exert eviction pressure")
+    assert results["warm"].cached_tokens > 0
+    assert results["warm"].token_ids == cold_a.token_ids
+
+
+def test_prefix_cache_disabled_is_pre_cache_behavior(monkeypatch):
+    eng = InferenceEngine(EngineConfig(**TINY, prefix_cache=False))
+    prompt = "abcdefgh" * 5
+    opts = {"temperature": 0.7, "seed": 9, "num_predict": 8}
+    r1 = _gen(eng, "a", prompt, opts)
+    r2 = _gen(eng, "b", prompt, opts)
+    assert r1.token_ids == r2.token_ids  # deterministic, both cold
+    assert r2.cached_tokens == 0
+    assert eng.alloc.hits == 0 and eng.alloc.misses == 0
+    assert eng.alloc.cached_pages == 0
+    assert eng.alloc.free_pages == TINY["num_pages"]  # all pages returned
+    # the env knob resolves the same way
+    monkeypatch.setenv("GRIDLLM_PREFIX_CACHE", "0")
+    env_off = InferenceEngine(EngineConfig(**TINY))
+    assert env_off._prefix_cache_cap == 0
+    monkeypatch.setenv("GRIDLLM_PREFIX_CACHE", "1")
+    monkeypatch.setenv("GRIDLLM_PREFIX_CACHE_PAGES", "7")
+    env_capped = InferenceEngine(EngineConfig(**TINY))
+    assert env_capped._prefix_cache_cap == 7
+    # a 0-page LRU means "no cache" at every layer, not "unbounded"
+    monkeypatch.setenv("GRIDLLM_PREFIX_CACHE_PAGES", "0")
+    env_zero = InferenceEngine(EngineConfig(**TINY))
+    assert env_zero._prefix_cache_cap == 0
+
+
+def test_prefill_metrics_split_cached_vs_computed():
+    from gridllm_tpu.obs import default_registry
+
+    eng = InferenceEngine(EngineConfig(**TINY))
+    counter = default_registry().get("gridllm_engine_tokens_total")
+    prompt = "abcdefgh" * 5
+    opts = {"temperature": 0.0, "num_predict": 4}
+    _gen(eng, "a", prompt, opts)
+    before = counter.value(model="tiny-llama", kind="prefill_cached")
+    warm = _gen(eng, "b", prompt, opts)
+    after = counter.value(model="tiny-llama", kind="prefill_cached")
+    assert after - before == warm.cached_tokens > 0
+
+
+# ---------------------------------------------------------------------------
+# scheduler: prefix-affinity routing
+# ---------------------------------------------------------------------------
+
+async def test_prefix_affinity_breaks_ties_not_load_caps():
+    from gridllm_tpu.bus import InMemoryBus
+    from gridllm_tpu.scheduler import JobScheduler, WorkerRegistry
+    from gridllm_tpu.utils.types import InferenceRequest
+
+    from .helpers import FakeWorker, fast_config
+
+    bus = InMemoryBus(key_prefix="T:")
+    await bus.connect()
+    cfg = fast_config()
+    registry = WorkerRegistry(bus, cfg)
+    scheduler = JobScheduler(bus, registry, cfg)
+    await registry.initialize()
+    await scheduler.initialize()
+    w1 = FakeWorker(bus, "w1", ["m1"], max_concurrent=4)
+    w2 = FakeWorker(bus, "w2", ["m1"], max_concurrent=4)
+    await w1.start()
+    await w2.start()
+    await bus.flush()
+    # w2 heartbeats a prefix digest (the real WorkerService ships this from
+    # its completed-jobs LRU)
+    await bus.publish("worker:heartbeat", json.dumps({
+        "workerId": "w2", "status": "online", "currentJobs": 0,
+        "prefixKeys": ["k1", "k2"]}))
+    await bus.flush()
+    assert registry.get_worker("w2").cachedPrefixes == ["k1", "k2"]
+
+    def request(**md):
+        return InferenceRequest(id=f"j-{uuid.uuid4().hex[:6]}", model="m1",
+                                prompt="hi", metadata=md)
+
+    try:
+        # tie on load → affinity wins (without it, insertion order gives w1)
+        assert scheduler._select_worker(request()).workerId == "w1"
+        picked = scheduler._select_worker(request(prefixKey="k1"))
+        assert picked.workerId == "w2"
+        # load gap beyond the affinity weight → the hot worker sheds
+        registry.get_worker("w2").currentJobs = 3  # load 0.75 vs 0.0
+        assert scheduler._select_worker(
+            request(prefixKey="k1")).workerId == "w1"
+        # at capacity the worker is not even a candidate
+        registry.get_worker("w2").currentJobs = 4
+        registry.get_worker("w1").currentJobs = 0
+        assert scheduler._select_worker(
+            request(prefixKey="k1")).workerId == "w1"
+    finally:
+        await w1.stop(announce=False)
+        await w2.stop(announce=False)
+        await scheduler.shutdown()
+        await registry.shutdown()
+        await bus.disconnect()
+
+
+async def test_worker_prefix_digest_gated_on_cache_enabled():
+    """With the engine's prefix cache off there are no pages to route
+    toward: the worker must not advertise prefix keys (the scheduler's
+    affinity term would otherwise skew routing with zero prefill saved)."""
+    from gridllm_tpu.bus import InMemoryBus
+    from gridllm_tpu.utils.config import WorkerConfig
+    from gridllm_tpu.worker.service import WorkerService
+
+    class Req:
+        model = "tiny-llama"
+        metadata = {"prefixKey": "k1"}
+
+    bus = InMemoryBus(key_prefix="T:")
+    await bus.connect()
+    try:
+        off = WorkerService(
+            bus, {"tiny-llama": InferenceEngine(
+                EngineConfig(**TINY, prefix_cache=False))}, WorkerConfig())
+        off._note_prefix_key(Req())
+        assert not off._prefix_keys
+        on = WorkerService(
+            bus, {"tiny-llama": InferenceEngine(EngineConfig(**TINY))},
+            WorkerConfig())
+        on._note_prefix_key(Req())
+        assert list(on._prefix_keys) == ["k1"]
+    finally:
+        await bus.disconnect()
+
+
+def test_gateway_prefix_key_stable_and_distinct():
+    from gridllm_tpu.gateway.common import prefix_key
+
+    a = prefix_key("m", "sys", "prompt text")
+    assert a == prefix_key("m", "sys", "prompt text")
+    assert a != prefix_key("m", "other sys", "prompt text")
+    assert a != prefix_key("m2", "sys", "prompt text")
+    assert a != prefix_key("m", None, "prompt text")
+    # structured parts (chat messages) hash stably too
+    msgs = [{"role": "system", "content": "s"}, {"role": "user", "content": "u"}]
+    assert prefix_key("m", msgs) == prefix_key("m", list(msgs))
