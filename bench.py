@@ -14,6 +14,13 @@ figures for each model. vs_baseline = measured_aggregate / anchor.
 
 Usage: python bench.py [--model llama3.2:3b] [--requests 8] [--tokens 128]
        [--tiny] (tiny-llama on CPU, smoke test)
+
+Perf trajectory (ISSUE 4): ``--emit BENCH_rNN.json`` writes a standardized
+machine-readable result record (schema gridllm-bench/v1: p50/p95 TTFT, ITL,
+tok/s, steady-state recompile count from the jit tripwire, peak HBM);
+``--compare old.json`` checks the current run against a previous record and
+exits nonzero on a >10% regression in any shared metric — the perf gate CI
+runs (.github/workflows/tier1.yml perf-smoke).
 """
 
 from __future__ import annotations
@@ -131,6 +138,44 @@ async def run_bench(model: str, n_requests: int, n_tokens: int,
         )
     finally:
         await _teardown_stack(bus, registry, scheduler, worker)
+
+
+def _p95(values: list[float]) -> float | None:
+    if not values:
+        return None
+    vs = sorted(values)
+    return vs[min(len(vs) - 1, max(0, -(-95 * len(vs) // 100) - 1))]
+
+
+def _perf_sidecar() -> dict:
+    """Recompile + peak-HBM accounting from the obs perf layer (ISSUE 4),
+    read BEFORE teardown while the engine's arrays and memory probe are
+    still live. recompiles_steady > 0 in a fixed-shape bench run means
+    shape bucketing regressed — the perf-smoke CI gate asserts it is 0."""
+    from gridllm_tpu.obs import memory_snapshot, recompile_totals
+
+    rec = recompile_totals()
+    peak = 0
+    source = "none"
+    for dev in memory_snapshot()["devices"].values():
+        for key, src in (("peakBytesInUse", "allocator_peak"),
+                         ("bytesInUse", "allocator_in_use"),
+                         ("totalLiveBytes", "end_of_run_live")):
+            cand = dev.get(key)
+            if cand:
+                if int(cand) > peak:
+                    peak, source = int(cand), src
+                break
+    return {
+        "recompiles_warmup": rec["warmup"],
+        "recompiles_steady": rec["steady"],
+        "recompiles_by_fn": rec["byFn"],
+        "peak_hbm_bytes": peak,
+        # honesty marker: only "allocator_peak" (TPU/GPU memory_stats) is
+        # a true high-water mark; CPU backends report end-of-run live
+        # bytes, which cannot see transient mid-decode spikes
+        "peak_hbm_source": source,
+    }
 
 
 def _stage_stats(tracer, request_ids) -> dict:
@@ -262,15 +307,18 @@ async def _run_bench_inner(client_ctx, engine, model, n_requests, n_tokens,
         slo_attainment = inter.get("attainment")
         if inter.get("goodputTokens") is not None:
             goodput_tok_s = inter["goodputTokens"] / wall
+    p95 = _p95(ttfts)
     return {
         "tok_s": tokens_out[0] / wall,
         "p50_ttft_ms": statistics.median(ttfts) * 1000,
+        "p95_ttft_ms": p95 * 1000 if p95 is not None else None,
         "p50_itl_ms": statistics.median(itls) if itls else None,
         "tokens": tokens_out[0],
         "wall_s": wall,
         "stages": stages,
         "slo_attainment": slo_attainment,
         "goodput_tok_s": goodput_tok_s,
+        "perf": _perf_sidecar(),
         "weights": "real-checkpoint" if ckpt else "random-weights synthetic",
     }
 
@@ -398,7 +446,10 @@ async def run_shared_prefix_bench(model: str, n_requests: int,
         cold_rate = cdh / (cdh + cdm) if (cdh + cdm) else 0.0
         cold["p50_ttft_ms"] = statistics.median(cold_ttfts) * 1000
         warm["p50_ttft_ms"] = statistics.median(warm_ttfts) * 1000
+        warm_p95 = _p95(warm_ttfts)
         return {
+            "p95_ttft_ms": warm_p95 * 1000 if warm_p95 is not None else None,
+            "perf": _perf_sidecar(),
             "tok_s": warm["tok_s"],
             "tokens": cold["tokens"] + warm["tokens"],
             "wall_s": cold["wall_s"] + warm["wall_s"],
@@ -460,10 +511,88 @@ async def run_embed_bench(model: str, n_requests: int,
         t0 = time.perf_counter()
         await asyncio.gather(*(one() for _ in range(n_requests)))
         wall = time.perf_counter() - t0
-        return {"qps": done[0] / wall, "texts": done[0], "wall_s": wall}
+        return {"qps": done[0] / wall, "texts": done[0], "wall_s": wall,
+                "perf": _perf_sidecar()}
     finally:
         await _teardown_stack(bus, registry, scheduler, worker,
                               client=client)
+
+
+BENCH_SCHEMA = "gridllm-bench/v1"
+
+# regression direction per metric: the compare gate flags a >threshold
+# move the WRONG way; metrics absent from either record are skipped
+HIGHER_BETTER = ("tok_s", "qps", "goodput_tok_s", "slo_attainment",
+                 "ttft_speedup", "prefix_cache_hit_rate")
+LOWER_BETTER = ("p50_ttft_ms", "p95_ttft_ms", "p50_itl_ms",
+                "peak_hbm_bytes")
+
+
+def build_record(scenario: str, args, payload: dict, r: dict) -> dict:
+    """The standardized machine-readable bench result (--emit): one stable
+    schema so BENCH_rNN.json files form a comparable perf trajectory."""
+    metrics: dict = {}
+    for key in HIGHER_BETTER + LOWER_BETTER:
+        val = payload.get(key, r.get(key))
+        if isinstance(val, (int, float)):
+            metrics[key] = round(float(val), 4)
+    perf = r.get("perf") or {}
+    metrics["recompiles_steady"] = int(perf.get("recompiles_steady", 0))
+    if perf.get("peak_hbm_bytes"):
+        metrics["peak_hbm_bytes"] = int(perf["peak_hbm_bytes"])
+    return {
+        "peak_hbm_source": perf.get("peak_hbm_source", "none"),
+        "schema": BENCH_SCHEMA,
+        "createdAt": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "scenario": scenario,
+        "model": args.model,
+        "platform": payload.get("platform"),
+        "degraded": payload.get("degraded", False),
+        "config": {"requests": args.requests, "tokens": args.tokens,
+                   "slots": args.slots, "prompt_len": args.prompt_len},
+        "metrics": metrics,
+        "recompiles_by_fn": perf.get("recompiles_by_fn") or {},
+        "payload": payload,
+    }
+
+
+def compare_records(old: dict, new: dict,
+                    threshold: float = 0.10) -> tuple[list[str], list[str]]:
+    """(regressions, notes) between two bench records. Apples-to-apples
+    only: scenario/model/platform mismatches skip the comparison with a
+    note instead of flagging nonsense regressions (a degraded CPU
+    substitute run must not 'regress' a real TPU baseline)."""
+    notes: list[str] = []
+    for field in ("scenario", "model", "platform"):
+        if old.get(field) != new.get(field):
+            notes.append(
+                f"baseline {field} mismatch ({old.get(field)!r} vs "
+                f"{new.get(field)!r}) — comparison skipped")
+            return [], notes
+    if old.get("schema") != new.get("schema"):
+        notes.append(f"schema drift: {old.get('schema')} vs "
+                     f"{new.get('schema')} — comparing shared metrics only")
+    regressions: list[str] = []
+    om, nm = old.get("metrics") or {}, new.get("metrics") or {}
+    for key in HIGHER_BETTER:
+        if key in om and key in nm and om[key] > 0:
+            if nm[key] < om[key] * (1 - threshold):
+                regressions.append(
+                    f"{key}: {om[key]:g} -> {nm[key]:g} "
+                    f"({(nm[key] / om[key] - 1) * 100:+.1f}%)")
+    for key in LOWER_BETTER:
+        if key in om and key in nm and om[key] > 0:
+            if nm[key] > om[key] * (1 + threshold):
+                regressions.append(
+                    f"{key}: {om[key]:g} -> {nm[key]:g} "
+                    f"({(nm[key] / om[key] - 1) * 100:+.1f}%)")
+    old_rc = om.get("recompiles_steady")
+    new_rc = nm.get("recompiles_steady")
+    if old_rc is not None and new_rc is not None and new_rc > old_rc:
+        # any NEW steady-state recompile is a regression — there is no
+        # 10% grace for a signal whose healthy value is zero
+        regressions.append(f"recompiles_steady: {old_rc} -> {new_rc}")
+    return regressions, notes
 
 
 def probe_backend(tries: int = 2, timeout_s: float = 240.0) -> tuple[str, list[str]]:
@@ -527,6 +656,15 @@ def main() -> int:
     ap.add_argument("--profile", metavar="DIR", default=None,
                     help="capture a jax.profiler trace of the measured "
                          "window into DIR (SURVEY §5.1)")
+    ap.add_argument("--emit", metavar="PATH", default=None,
+                    help="write the standardized bench record "
+                         "(gridllm-bench/v1) to PATH, e.g. BENCH_r06.json "
+                         "— the machine-readable perf trajectory (ISSUE 4)")
+    ap.add_argument("--compare", metavar="PATH", default=None,
+                    help="compare this run against a previous --emit "
+                         "record; exit nonzero on a >10%% regression")
+    ap.add_argument("--regression-threshold", type=float, default=0.10,
+                    help="fractional regression tolerance for --compare")
     args = ap.parse_args()
     if args.embed and args.model == ap.get_default("model"):
         args.model = "all-minilm"
@@ -662,14 +800,32 @@ def main() -> int:
         attempts.append({"stage": "run",
                          "error": f"{type(e).__name__}: {e}",
                          "traceback": tb[-3:]})
-        emit({
+        err_payload = {
             "metric": metric_name, "value": 0.0,
             "unit": "embeddings/s" if args.embed else "tok/s",
             "vs_baseline": 0.0, "error": f"{type(e).__name__}: {e}",
             "attempts": attempts, "degraded": degraded,
             "fallback": fallback,
-        })
-        return 0  # JSON line emitted — that is the contract
+        }
+        if args.emit:
+            # the perf gate reads the record file — a crashed run must
+            # leave one (with the error and no metrics) rather than
+            # silently skipping the emit
+            try:
+                with open(args.emit, "w") as f:
+                    json.dump({
+                        "schema": BENCH_SCHEMA, "scenario": "error",
+                        "model": args.model, "error": err_payload["error"],
+                        "metrics": {}, "payload": err_payload,
+                    }, f, indent=2, sort_keys=True)
+                    f.write("\n")
+            except OSError:
+                pass
+        emit(err_payload)
+        # the one-JSON-line driver contract wants rc 0; a --emit/--compare
+        # PERF GATE run must instead fail loudly — a gate that goes green
+        # on a crashed benchmark is worse than no gate
+        return 1 if (args.emit or args.compare) else 0
     payload = {
         "metric": metric_name,
         "value": round(value, 2),
@@ -709,8 +865,40 @@ def main() -> int:
         payload["fallback"] = fallback
     if attempts:
         payload["attempts"] = attempts
+    # perf introspection always rides the driver line when measured —
+    # steady-state recompiles and peak HBM are headline health signals
+    perf_side = r.get("perf")
+    if perf_side:
+        payload["recompiles_steady"] = perf_side["recompiles_steady"]
+        if perf_side.get("peak_hbm_bytes"):
+            payload["peak_hbm_bytes"] = perf_side["peak_hbm_bytes"]
+    scenario = ("embed" if args.embed
+                else "shared-prefix" if args.shared_prefix else "generate")
+    record = build_record(scenario, args, payload, r)
+    regressions: list = []
+    if args.compare:
+        # a missing/corrupt baseline (first run of a CI gate, truncated
+        # artifact) is a note, never a crash — the one-JSON-line driver
+        # contract holds and the gate passes until a real baseline exists
+        try:
+            with open(args.compare) as f:
+                baseline = json.load(f)
+        except (OSError, ValueError) as e:
+            baseline = None
+            notes = [f"baseline unreadable ({type(e).__name__}: {e}) — "
+                     "comparison skipped"]
+        if baseline is not None:
+            regressions, notes = compare_records(
+                baseline, record, threshold=args.regression_threshold)
+        payload["compare"] = {"baseline": args.compare,
+                              "regressions": regressions, "notes": notes}
+        record["compare"] = payload["compare"]
+    if args.emit:
+        with open(args.emit, "w") as f:
+            json.dump(record, f, indent=2, sort_keys=True)
+            f.write("\n")
     emit(payload)
-    return 0
+    return 1 if regressions else 0
 
 
 if __name__ == "__main__":

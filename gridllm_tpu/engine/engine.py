@@ -55,6 +55,12 @@ from gridllm_tpu.engine.tokenizer import DetokState, Tokenizer, get_tokenizer
 from gridllm_tpu.models import llama
 from gridllm_tpu.models.configs import ModelConfig, get_config
 from gridllm_tpu.obs import SIZE_BUCKETS, default_flight_recorder, default_registry
+from gridllm_tpu.obs.perf import (
+    DEVICE_STEP_SECONDS,
+    DISPATCH_SECONDS,
+    HOST_SCHED_SECONDS,
+    RecompileTripwire,
+)
 from gridllm_tpu.ops.kvcache import PagedKVCache, PageAllocator
 from gridllm_tpu.ops.sampling import (
     SamplingParams,
@@ -312,7 +318,17 @@ class InferenceEngine:
         self._free_slots = list(range(config.max_slots - 1, -1, -1))
         # dispatch pipeline state (runner thread / step()):
         self._gen = 0                     # generation counter of dispatched blocks
-        self._inflight: deque[tuple[int, Any, int]] = deque()  # (gen, toks, k)
+        # (gen, toks, k, dispatch perf_counter ts)
+        self._inflight: deque[tuple[int, Any, int, float]] = deque()
+        # recompile tripwire (obs/perf.py): every jitted entry point is
+        # wrapped; armed after the first naturally completed request, at
+        # which point any new compile signature is a flagged steady-state
+        # recompile (counter + flight-recorder event with the shapes)
+        self.perf = RecompileTripwire(context=self.cfg.name)
+        self._perf_armed = False
+        # step-time decomposition state (runner thread only)
+        self._t_prev_fetch: float | None = None
+        self._t_ingest_done: float | None = None
         self._ctl: deque[str] = deque()   # cross-thread cancel requests (ids)
         self._work = threading.Condition()
         self._runner: threading.Thread | None = None
@@ -489,6 +505,8 @@ class InferenceEngine:
         with self.dispatch_lock:
             self._slots.clear()
             self._inflight.clear()
+            self._t_prev_fetch = None  # recovery wall must not read as
+            self._t_ingest_done = None  # device/host pace
             self._free_slots = list(range(self.config.max_slots - 1, -1, -1))
             self._init_device_state()
             self._update_kv_gauges()
@@ -499,11 +517,16 @@ class InferenceEngine:
         mc = self.cfg
         # pooled hidden states for the embeddings path — batched [B, T],
         # jit-compiled (one program per (batch-bucket, len-bucket) pair)
-        self._embed_fn = jax.jit(
+        # armable=False: embed compiles per (batch-bucket, len-bucket)
+        # pair ON DEMAND — a decoder model's first embed request can land
+        # long after generation warms, and flagging that bounded,
+        # legitimate compile as a steady-state recompile would page on
+        # healthy behavior (same for the vision pair below)
+        self._embed_fn = self.perf.wrap("embed", jax.jit(
             lambda params, tokens, lens: self.mod.hidden_states(
                 params, mc, tokens, seq_lens=lens, mesh=self.mesh
             )
-        )
+        ), armable=False)
         if self.embedding_only:
             return
 
@@ -636,21 +659,31 @@ class InferenceEngine:
                 mc.vocab_size,
             )
 
-        self._window_seed_fn = window_seed_fn
-        self._prefill_fn = prefill_fn
-        self._prefill_chunk_fn = prefill_chunk_fn
+        self._window_seed_fn = self.perf.wrap("window_seed", window_seed_fn)
+        # vision models legitimately double the prefill signature space
+        # post-warmup: an image request adds the embeds leaf to the same
+        # bucket a text request compiled without it, so armed prefill
+        # probes would flag the first image request as a steady-state
+        # recompile. Decode stays armed — the hot loop's shapes are
+        # vision-independent.
+        text_only = not self.cfg.vision
+        self._prefill_fn = self.perf.wrap("prefill", prefill_fn,
+                                          armable=text_only)
+        self._prefill_chunk_fn = self.perf.wrap("prefill_chunk",
+                                                prefill_chunk_fn,
+                                                armable=text_only)
         if self.cfg.vision:
             # vision path (llava family): encode_images per image-count
             # (jit caches per shape — image counts are tiny), splice per
             # (bucket, image-count) pair
-            self._encode_fn = jax.jit(
+            self._encode_fn = self.perf.wrap("encode_images", jax.jit(
                 lambda params, px: self.mod.encode_images(params, mc, px)
-            )
-            self._splice_fn = jax.jit(
+            ), armable=False)
+            self._splice_fn = self.perf.wrap("splice_embeds", jax.jit(
                 lambda params, toks, ie, off: self.mod.splice_embeds(
                     params, mc, toks, ie, off
                 )
-            )
+            ), armable=False)
         # ring attention (sp) runs whole-prompt prefill; the chunked path
         # reads the paged prefix instead and has no sp variant yet
         self._use_chunked = attn is None
@@ -660,7 +693,7 @@ class InferenceEngine:
         self._chunk_len = max(
             ps, (min(self.config.prefill_chunk, self.max_context) // ps) * ps
         )
-        self._decode_block_fn = decode_block_fn
+        self._decode_block_fn = self.perf.wrap("decode_block", decode_block_fn)
 
     # ------------------------------------------------------------ admission
 
@@ -1080,6 +1113,14 @@ class InferenceEngine:
         _FLIGHTREC.record("engine", "finish", model=self.cfg.name,
                           request=st.req.id, slot=slot, reason=reason,
                           tokens=len(st.generated))
+        if not self._perf_armed and reason in ("stop", "length"):
+            # first naturally completed request ⇒ the prefill/decode
+            # programs its shapes needed are compiled — steady state from
+            # here; new signatures are flagged (legit new-bucket compiles
+            # still happen, bounded by |buckets|, and stay under the
+            # storm budget)
+            self._perf_armed = True
+            self.perf.arm()
         if st.req.on_chunk:
             st.req.on_chunk(last_delta, True, res)
 
@@ -1093,12 +1134,18 @@ class InferenceEngine:
                                   gen=self._gen, k=k,
                                   slots=len(self._slots),
                                   pending=len(self._pending))
+            t0 = time.perf_counter()
             (out, self.tokens, self.cache, self.counts, self.window,
              self.wlen, self.sampling) = self._decode_block_fn(
                 self.params, self.cache, self.tokens, self.active,
                 self.counts, self.window, self.wlen, self.sampling, k=k,
             )
-            self._inflight.append((self._gen, out, k))
+            now = time.perf_counter()
+            # dispatch-to-device: trace/lower/enqueue wall time — the call
+            # returns before the device finishes; a spike here is usually
+            # a recompile (pairs with gridllm_recompiles_total)
+            DISPATCH_SECONDS.observe(now - t0, model=self.cfg.name)
+            self._inflight.append((self._gen, out, k, now))
             if self.plan_sink is not None:  # after-success; see _try_admit
                 self.plan_sink({"op": "block", "k": k})
 
@@ -1148,13 +1195,32 @@ class InferenceEngine:
         while self._try_admit():
             pass
         if not self._slots:
+            self._t_prev_fetch = None
             return bool(self._pending)
         self._dispatch_block(1)
-        gen, out, _ = self._inflight.popleft()
+        gen, out, blk, t_disp = self._inflight.popleft()
         t0 = time.perf_counter()
-        self._ingest_block(gen, np.asarray(jax.device_get(out)))
+        raw = np.asarray(jax.device_get(out))
+        self._observe_device_step(t_disp, blk)
+        self._ingest_block(gen, raw)
         _STEP_DURATION.observe(time.perf_counter() - t0, model=self.cfg.name)
         return True
+
+    def _observe_device_step(self, t_disp: float, k: int) -> None:
+        """Per-step on-device time estimate, pipelined-dispatch aware:
+        with another block already in flight when this fetch completed,
+        the device never idled between blocks, so consecutive fetch
+        completions pace at the device's block time; with the pipeline
+        drained, dispatch→fetch wall is the honest (queue-inclusive)
+        upper bound. Called right after the device_get returns."""
+        now = time.perf_counter()
+        prev = self._t_prev_fetch
+        self._t_prev_fetch = now
+        if prev is not None and self._inflight:
+            dev = (now - prev) / max(k, 1)
+        else:
+            dev = (now - t_disp) / max(k, 1)
+        DEVICE_STEP_SECONDS.observe(dev, model=self.cfg.name)
 
     # ------------------------------------------------------------- runner
 
@@ -1206,6 +1272,7 @@ class InferenceEngine:
                                   model=self.cfg.name, error=str(e)[:200],
                                   streak=fail_streak + 1)
                 self._inflight.clear()
+                self._t_prev_fetch = None
                 self.abort_all(f"engine failure: {e}")
                 try:
                     self.reset_device_state()
@@ -1239,19 +1306,38 @@ class InferenceEngine:
         admitted = 0
         while admitted < budget and self._try_admit():
             admitted += 1
+        if admitted:
+            # a prefill ran between decode blocks: the next fetch delta
+            # would span it and book prefill wall time as device pace —
+            # fall back to dispatch→fetch for the next block instead
+            self._t_prev_fetch = None
         if not self._slots:
+            self._t_prev_fetch = None
+            self._t_ingest_done = None
             return
         k = self.config.decode_block
+        # host-scheduling gap since the previous block's ingest finished
+        # — control drain, admission (incl. prefill dispatch), stream
+        # callbacks — amortized per fused step so it compares 1:1 with
+        # gridllm_engine_device_step_seconds (the host-stall alert and
+        # dashboard plot them against each other)
+        if self._t_ingest_done is not None:
+            HOST_SCHED_SECONDS.observe(
+                (time.perf_counter() - self._t_ingest_done) / max(k, 1),
+                model=self.cfg.name)
         while len(self._inflight) < max(1, self.config.pipeline_depth):
             self._dispatch_block(k)
-        gen, out, blk = self._inflight.popleft()
+        gen, out, blk, t_disp = self._inflight.popleft()
         t0 = time.perf_counter()
-        self._ingest_block(gen, np.asarray(jax.device_get(out)))
+        raw = np.asarray(jax.device_get(out))
+        self._observe_device_step(t_disp, blk)
+        self._ingest_block(gen, raw)
         # fetch+ingest wall time per fused step; in steady state the fetch
         # of block N overlaps block N+1's compute, so this is the honest
         # per-step pace the pipeline sustains
         _STEP_DURATION.observe(
             (time.perf_counter() - t0) / max(blk, 1), model=self.cfg.name)
+        self._t_ingest_done = time.perf_counter()
 
     # ---------------------------------------------------------- public API
 
@@ -1444,4 +1530,52 @@ class InferenceEngine:
                 "evictions": self.alloc.evictions,
                 "cowCopies": self.alloc.cow_copies,
             } if not self.embedding_only else None,
+            "jit": self.perf.state(),
         }
+
+    def memory_arrays(self) -> dict[str, Any]:
+        """Live device buffers + allocator math for the memory probe
+        (obs/perf.py memory_snapshot): weight and KV-pool arrays by
+        identity (the snapshot classifies jax.live_arrays() against
+        them), plus JSON-safe page-pool accounting. Reads mutable state
+        without the dispatch lock, same contract as batch_state()."""
+        weights = [a for a in jax.tree_util.tree_leaves(self.params)
+                   if hasattr(a, "nbytes")]
+        out: dict[str, Any] = {"weights": weights, "kv": [], "alloc": None}
+        if self.embedding_only:
+            return out
+        cache = self.cache
+        out["kv"] = [cache.k, cache.v, cache.page_table, cache.lengths]
+        c, mc = self.config, self.cfg
+        kv_bytes = cache.k.nbytes + cache.v.nbytes
+        bpp = kv_bytes / max(c.num_pages, 1)
+        used = c.num_pages - self.alloc.free_pages - self.alloc.cached_pages
+        live_tokens = sum(len(st.ids) for st in list(self._slots.values()))
+        dpool = cache.k.shape[-1]
+        capacity_tokens = used * c.page_size
+        out["alloc"] = {
+            "numPages": c.num_pages,
+            "pageSize": c.page_size,
+            "pagesUsed": used,
+            "pagesCached": self.alloc.cached_pages,
+            "pagesFree": self.alloc.free_pages,
+            "bytesPerPage": int(bpp),
+            "usedBytes": int(used * bpp),
+            "cachedBytes": int(self.alloc.cached_pages * bpp),
+            "freeBytes": int(self.alloc.free_pages * bpp),
+            # lane padding multiplies KV bytes for d<128 models under the
+            # kernel path (_pool_head_dim) — this is that overhead's share
+            "lanePadOverheadBytes": int(
+                kv_bytes * (1 - mc.head_dim_ / dpool)) if dpool else 0,
+            "liveTokens": live_tokens,
+            # internal fragmentation of the live allocation: capacity
+            # reserved at admission (num_predict headroom + tail pages)
+            # not yet holding tokens. Clamped at 0: prefix-cache sharing
+            # counts a shared page ONCE in pagesUsed while every sharer's
+            # tokens land in liveTokens, so the ratio can exceed 1 in the
+            # warm steady state — that is sharing, not fragmentation.
+            "fragmentation": (
+                max(0.0, round(1 - live_tokens / capacity_tokens, 4))
+                if capacity_tokens else 0.0),
+        }
+        return out

@@ -1,13 +1,22 @@
 """Grammar-constrained JSON decoding: a pushdown automaton over JSON
 syntax drives a per-step vocabulary mask (VERDICT r04 #3).
 
+**EXPERIMENTAL — NOT INTEGRATED.** Nothing imports this module today:
+the sampler (ops/sampling.py) has NO ``allowed``-mask hook, and the
+worker's ``format:"json"`` path (worker/prompting.py) enforces JSON via
+instruction injection + post-extraction only. Until an engine-side
+per-step mask hook exists, the hard-parse guarantee this module could
+provide is NOT delivered — do not assume constrained decoding is active.
+The module is kept import-clean (a collection-level test enforces it) as
+the grammar groundwork for that future hook.
+
 Ollama guarantees `format:"json"` output parses by masking logits with a
 llama.cpp GBNF grammar; the reference inherited that guarantee via
 passthrough (client/src/services/OllamaService.ts:197-226). This module
 is the TPU-native analogue: the PDA runs on the host (it is inherently
-sequential in the sampled tokens), producing a boolean [V] mask the
-engine ships to the device sampler (ops/sampling.py `allowed`) before
-each constrained step. Masks are cached by PDA *state signature* — a
+sequential in the sampled tokens), producing a boolean [V] mask that a
+future device-sampler mask hook would consume before each constrained
+step. Masks are cached by PDA *state signature* — a
 token can pop at most as many containers as it has closing characters,
 so validity depends only on the mode, the literal/number sub-state, and
 the top max_pops stack entries; signatures repeat heavily across steps

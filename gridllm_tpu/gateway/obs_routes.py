@@ -11,6 +11,13 @@
 - ``GET /admin/dump``: the flight-recorder post-mortem artifact
   (obs/flightrec.py): event rings, active traces, SLO snapshot, registry
   and engine state, plus any retained auto dumps from hang/crash detection.
+- ``GET /admin/memory``: per-device weights/KV/workspace breakdown with
+  headroom + fragmentation (obs/perf.py). Covers THIS process's devices:
+  in single-process stacks (bench, tests) that includes the engines; in a
+  split deployment the worker health port serves the engine-side view.
+- ``POST /admin/profile?seconds=N``: start an on-demand jax.profiler
+  capture into the bounded artifact dir; returns the path immediately.
+  409 while a capture is already running.
 - ``metrics_middleware``: request count by route/method/status and
   end-to-end latency histogram by route. Route labels use the matched
   route's canonical pattern (``/inference/{job_id}/status``), never the raw
@@ -113,9 +120,37 @@ def build_routes(scheduler: JobScheduler) -> list[web.RouteDef]:
     async def dump(request: web.Request) -> web.Response:
         return web.json_response(build_dump(scheduler, reason="on_demand"))
 
+    async def memory(request: web.Request) -> web.Response:
+        from gridllm_tpu.obs import memory_snapshot
+
+        # to_thread: the live_arrays walk is synchronous work that grows
+        # with the number of live buffers
+        return web.json_response(await asyncio.to_thread(memory_snapshot))
+
+    async def profile(request: web.Request) -> web.Response:
+        return await start_profile_capture(request)
+
     return [
         web.get("/metrics", metrics),
         web.get("/admin/trace/{request_id}", trace),
         web.get("/admin/slo", slo),
         web.get("/admin/dump", dump),
+        web.get("/admin/memory", memory),
+        web.post("/admin/profile", profile),
     ]
+
+
+async def start_profile_capture(request: web.Request) -> web.Response:
+    """``POST /admin/profile?seconds=N``: start a background jax.profiler
+    capture; the response carries the artifact path so the caller can
+    fetch/open it after `seconds`. Validation, the busy conflict, and
+    the engine-less-process refusal live in obs/perf.py — the worker
+    health port serves the same helper without importing gateway code."""
+    from gridllm_tpu.obs.perf import handle_profile_request
+
+    # to_thread: starting a capture prunes old artifact dirs and calls
+    # start_trace — blocking filesystem/profiler work that must not
+    # stall the event loop serving streams and health checks
+    status, payload = await asyncio.to_thread(
+        handle_profile_request, request.query.get("seconds"))
+    return web.json_response(payload, status=status)

@@ -5,8 +5,12 @@ encoding) and tracer.py (stitched per-request span timelines).
 Interpretation layer (ISSUE 2): slo.py (per-class objectives, attainment,
 burn rates, goodput), watchdog.py (per-phase hang detection), flightrec.py
 (black-box event rings + post-mortem dump artifacts).
+Performance introspection (ISSUE 4): perf.py (recompile tripwire,
+device-memory accounting, step-time decomposition instruments, on-demand
+jax.profiler capture).
 
-Pure stdlib — no prometheus_client, no OpenTelemetry.
+Pure stdlib — no prometheus_client, no OpenTelemetry; perf.py imports
+jax lazily so control-plane processes stay light.
 """
 
 from gridllm_tpu.obs.flightrec import (
@@ -27,6 +31,16 @@ from gridllm_tpu.obs.metrics import (
     default_registry,
     render_registries,
 )
+from gridllm_tpu.obs.perf import (
+    CaptureBusy,
+    ProfilerCapture,
+    RecompileTripwire,
+    default_profiler,
+    memory_snapshot,
+    recompile_totals,
+    register_memory_probe,
+    unregister_memory_probe,
+)
 from gridllm_tpu.obs.slo import SLOEngine, classify_request
 from gridllm_tpu.obs.tracer import (
     TRACE_CHANNEL_PREFIX,
@@ -40,12 +54,15 @@ __all__ = [
     "LATENCY_BUCKETS",
     "PROMETHEUS_CONTENT_TYPE",
     "SIZE_BUCKETS",
+    "CaptureBusy",
     "Counter",
     "FlightRecorder",
     "Gauge",
     "HangWatchdog",
     "Histogram",
     "MetricsRegistry",
+    "ProfilerCapture",
+    "RecompileTripwire",
     "SLOEngine",
     "Span",
     "TRACE_CHANNEL_PREFIX",
@@ -53,9 +70,14 @@ __all__ = [
     "build_dump",
     "classify_request",
     "default_flight_recorder",
+    "default_profiler",
     "default_registry",
+    "memory_snapshot",
+    "recompile_totals",
     "register_engine_probe",
+    "register_memory_probe",
     "render_registries",
     "trace_channel",
     "unregister_engine_probe",
+    "unregister_memory_probe",
 ]

@@ -113,6 +113,13 @@ class Counter(_Metric):
         with self._lock:
             return sum(self._values.values())
 
+    def items(self) -> list[tuple[dict[str, str], float]]:
+        """Point-in-time samples as ({label: value}, count) pairs —
+        the public iteration surface (obs/perf.py recompile_totals)."""
+        with self._lock:
+            snap = list(self._values.items())
+        return [(dict(zip(self.labelnames, key)), v) for key, v in snap]
+
     def _render_samples(self) -> list[str]:
         with self._lock:
             items = sorted(self._values.items())

@@ -1,0 +1,713 @@
+"""Performance introspection layer (ISSUE 4).
+
+The obs stack through ISSUE 2 says *whether* requests meet their SLOs;
+this module instruments the three dominant TPU-side reasons they don't:
+
+1. **Recompile tripwire** (:class:`RecompileTripwire` / :class:`JitProbe`)
+   — wraps the engine's jitted entry points and fingerprints every call's
+   abstract signature (array shapes/dtypes — the shape-bucket and
+   donated-arg-layout proxy jit keys on — plus static args). A signature
+   never seen before means XLA compiled a new program. Compiles while the
+   probe is *unarmed* are expected warmup (bucket compiles, first block);
+   once armed (the engine arms itself after its first completed request),
+   every new signature is a **steady-state recompile**: counted in
+   ``gridllm_recompiles_total{fn,reason}``, logged to the flight recorder
+   with the offending shapes, and — past a per-window budget — escalated
+   to a watchdog-style *recompile storm* diagnosis.
+2. **Device-memory accounting** (:func:`memory_snapshot`) — splits each
+   device's live HBM into weights / KV pool / workspace from
+   ``jax.live_arrays()`` classified against engine-registered memory
+   probes, plus allocator-derived KV math (cold vs cached pages,
+   lane-padding overhead, reserved-capacity fragmentation). Served at
+   ``GET /admin/memory`` and exported as
+   ``gridllm_device_memory_bytes{device,kind}`` gauges via a registry
+   collector, with headroom/limit gauges where the backend reports
+   allocator stats (TPU; CPU reports live bytes only).
+3. **On-demand profiler capture** (:class:`ProfilerCapture`) —
+   ``POST /admin/profile?seconds=N`` starts a ``jax.profiler`` trace into
+   a bounded artifact directory (``GRIDLLM_PROFILE_DIR``, oldest captures
+   pruned past ``GRIDLLM_PROFILE_KEEP``) and returns the path; the hang
+   watchdog auto-triggers a short capture on decode-step hangs so the
+   trace covers the wedge, not its aftermath.
+
+The step-time decomposition histograms (host scheduling vs dispatch vs
+on-device step) are registered here and driven by the engine's runner
+loop — see engine/engine.py.
+
+jax is imported lazily (function-level): importing this module — and
+therefore ``gridllm_tpu.obs`` — must stay cheap for control-plane-only
+processes. Pure stdlib otherwise.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import threading
+import time
+from collections import deque
+from typing import Any, Callable
+
+from gridllm_tpu.obs.flightrec import default_flight_recorder
+from gridllm_tpu.obs.metrics import default_registry
+from gridllm_tpu.utils.logging import get_logger
+
+log = get_logger("obs.perf")
+
+_OBS = default_registry()
+
+# -- recompile tripwire instruments -----------------------------------------
+
+RECOMPILES_TOTAL = _OBS.counter(
+    "gridllm_recompiles_total",
+    "XLA compiles observed by the jit tripwire, by wrapped fn and reason "
+    "(warmup = before the engine's first completed request; new_shape / "
+    "new_static / new_signature = steady-state recompiles — each one is "
+    "also a flight-recorder event carrying the offending shapes).",
+    ("fn", "reason"),
+)
+RECOMPILE_STORMS_TOTAL = _OBS.counter(
+    "gridllm_recompile_storms_total",
+    "Recompile-storm diagnoses: steady-state recompiles exceeded the "
+    "per-window budget (GRIDLLM_RECOMPILE_BUDGET per "
+    "GRIDLLM_RECOMPILE_WINDOW seconds).",
+)
+
+# -- step-time decomposition (engine runner drives these) -------------------
+# Sub-ms-focused buckets: decode steps on a healthy TPU are 1-50 ms; the
+# long tail is exactly what these histograms exist to catch.
+STEP_PHASE_BUCKETS = (
+    0.0002, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+    0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 15.0, 60.0,
+)
+HOST_SCHED_SECONDS = _OBS.histogram(
+    "gridllm_engine_host_sched_seconds",
+    "Host-side gap between finishing one decode block's ingest and "
+    "dispatching the next (admission, tokenize, stream callbacks, control "
+    "drain), AMORTIZED PER FUSED STEP so it compares 1:1 with "
+    "gridllm_engine_device_step_seconds, by model. Growth here is a host "
+    "stall, not a device problem.",
+    ("model",), buckets=STEP_PHASE_BUCKETS,
+)
+DISPATCH_SECONDS = _OBS.histogram(
+    "gridllm_engine_dispatch_seconds",
+    "Wall time for a fused decode block's jitted call to RETURN (trace + "
+    "lower + enqueue; the device keeps computing after). A spike here "
+    "usually means a recompile — pair with gridllm_recompiles_total.",
+    ("model",), buckets=STEP_PHASE_BUCKETS,
+)
+DEVICE_STEP_SECONDS = _OBS.histogram(
+    "gridllm_engine_device_step_seconds",
+    "Estimated on-device time per fused decode step, by model. With the "
+    "dispatch pipeline saturated this is the delta between consecutive "
+    "block fetch completions (device-bound pace); otherwise dispatch-to-"
+    "fetch wall time (upper bound including queue wait).",
+    ("model",), buckets=STEP_PHASE_BUCKETS,
+)
+
+# -- device-memory gauges ----------------------------------------------------
+
+DEVICE_MEMORY_BYTES = _OBS.gauge(
+    "gridllm_device_memory_bytes",
+    "Live device memory by kind: weights (model params), kv_pool (paged "
+    "KV cache + tables), workspace (all other live arrays — activations, "
+    "sampler state, staging buffers). Classified per jax.live_arrays() "
+    "against engine memory probes at scrape time.",
+    ("device", "kind"),
+)
+DEVICE_MEMORY_HEADROOM = _OBS.gauge(
+    "gridllm_device_memory_headroom_bytes",
+    "Allocator-reported free device memory (bytes_limit - bytes_in_use); "
+    "only present on backends exposing memory_stats (TPU/GPU).",
+    ("device",),
+)
+DEVICE_MEMORY_LIMIT = _OBS.gauge(
+    "gridllm_device_memory_limit_bytes",
+    "Allocator-reported device memory limit; only present on backends "
+    "exposing memory_stats (TPU/GPU).",
+    ("device",),
+)
+
+
+# Deliberately laxer than utils/config._env: these are read lazily on
+# telemetry paths (per steady-state recompile, per capture), where a
+# malformed env var must degrade to the default, never raise — config
+# load's fail-fast SystemExit semantics would turn a typo'd budget into
+# an outage of the thing doing the diagnosing.
+def jax_loaded() -> bool:
+    """Whether this process already imported jax. Every perf path that
+    would otherwise import jax checks this first: in an engine-less
+    control-plane process (split-deployment gateway) a surprise backend
+    init is seconds of stall at best and, on a TPU host whose worker
+    holds the exclusive libtpu claim, a hang — scrapes, snapshots, and
+    captures must refuse or no-op instead."""
+    import sys
+
+    return "jax" in sys.modules
+
+
+def _env_float(name: str, default: float) -> float:
+    raw = os.environ.get(name)
+    try:
+        return float(raw) if raw else default
+    except ValueError:
+        return default
+
+
+def _env_int(name: str, default: int) -> int:
+    raw = os.environ.get(name)
+    try:
+        return int(raw) if raw else default
+    except ValueError:
+        return default
+
+
+# ---------------------------------------------------------------------------
+# recompile tripwire
+# ---------------------------------------------------------------------------
+
+
+def _leaf_signature(leaves: list[Any]) -> tuple[tuple[Any, ...], tuple[str, ...]]:
+    """(array avals, static reprs) for one call's flattened args. Arrays
+    contribute (shape, dtype) — the jit cache key's shape-bucket /
+    donated-layout proxy; everything else (python ints, bools, static
+    kwargs) contributes its repr."""
+    avals: list[Any] = []
+    statics: list[str] = []
+    for leaf in leaves:
+        shape = getattr(leaf, "shape", None)
+        dtype = getattr(leaf, "dtype", None)
+        if shape is not None and dtype is not None:
+            avals.append((tuple(shape), str(dtype)))
+        else:
+            statics.append(repr(leaf))
+    return tuple(avals), tuple(statics)
+
+
+class JitProbe:
+    """One wrapped jitted callable. Transparent pass-through plus
+    signature bookkeeping; the owning :class:`RecompileTripwire` gets told
+    about every first-seen signature."""
+
+    def __init__(self, name: str, fn: Callable, tripwire: "RecompileTripwire",
+                 armable: bool = True):
+        self.name = name
+        self._fn = fn
+        self._tripwire = tripwire
+        # armable=False: probes whose whole compile surface is explicitly
+        # bucket-bounded and demand-driven (embed batch/len buckets,
+        # vision image counts) — their first-use compiles can land long
+        # after the generation path warms, so flagging them would page on
+        # healthy behavior. They still count under reason="warmup".
+        self.armable = armable
+        self.armed = False
+        # signature bookkeeping is guarded: the embed probe is called
+        # from concurrent asyncio.to_thread workers while the runner
+        # thread drives decode — an unguarded check-then-add would
+        # double-count the same first-seen signature
+        self._sig_lock = threading.Lock()
+        # full signature → first-seen; plus the two projections used to
+        # classify WHAT changed when a new signature appears
+        self._seen: set[tuple] = set()
+        self._seen_avals: set[tuple] = set()
+        self._seen_statics: set[tuple] = set()
+        # identity-memo for the first positional arg: every engine entry
+        # point passes the (large, shape-stable) params tree first, and
+        # re-flattening its hundreds of leaves per decode-block dispatch
+        # would tax the hot path and inflate DISPATCH_SECONDS. One
+        # (obj, sig) tuple so cross-thread reads are never torn; the
+        # strong ref makes the `is` check immune to id reuse.
+        self._memo: tuple[Any, tuple] | None = None
+        self.compiles = 0
+        self.steady_recompiles = 0
+
+    def arm(self) -> None:
+        """Enter steady state: every new signature from here on is a
+        flagged recompile, not expected warmup."""
+        self.armed = True
+
+    def __getattr__(self, name):
+        # transparent wrapper: jit-object introspection (_cache_size,
+        # lower, ...) must keep working through the probe
+        fn = self.__dict__.get("_fn")
+        if fn is None:  # mid-__init__ / copy protocols
+            raise AttributeError(name)
+        return getattr(fn, name)
+
+    def _signature(self, args, kwargs) -> tuple[tuple, tuple]:
+        """(avals, statics) for this call. Always computed as arg0's
+        leaves followed by the rest's, so memo hits and misses produce
+        identical keys for identical calls."""
+        import jax
+
+        flatten = jax.tree_util.tree_flatten
+        if not args:
+            return _leaf_signature(flatten(kwargs)[0])
+        memo = self._memo
+        if memo is not None and memo[0] is args[0]:
+            avals0, statics0 = memo[1]
+        else:
+            avals0, statics0 = _leaf_signature(flatten(args[0])[0])
+            self._memo = (args[0], (avals0, statics0))
+        avals_r, statics_r = _leaf_signature(flatten((args[1:], kwargs))[0])
+        return avals0 + avals_r, statics0 + statics_r
+
+    def __call__(self, *args, **kwargs):
+        avals, statics = self._signature(args, kwargs)
+        key = (avals, statics)
+        with self._sig_lock:
+            new = key not in self._seen
+            if new:
+                reason = self._note_compile(avals, statics, key)
+        if new and reason != "warmup":
+            self._tripwire._on_steady_recompile(self, reason, avals, statics)
+        return self._fn(*args, **kwargs)
+
+    def _note_compile(self, avals, statics, key) -> str:
+        """Record a first-seen signature (caller holds _sig_lock).
+
+        A probe's very FIRST signature is always ``warmup`` even when
+        armed: a program must compile once to exist, and some entry
+        points legitimately run for the first time only after the engine
+        warms (window_seed needs a prefix-cache hit, which requires a
+        COMPLETED request — the very event that arms the tripwire;
+        chunked prefill needs the first long prompt). Only a SECOND
+        signature on an armed probe is evidence of shape leakage."""
+        self.compiles += 1
+        if not self.armed or not self._seen:
+            reason = "warmup"
+        elif statics in self._seen_statics and avals not in self._seen_avals:
+            reason = "new_shape"
+        elif avals in self._seen_avals and statics not in self._seen_statics:
+            reason = "new_static"
+        else:
+            reason = "new_signature"
+        self._seen.add(key)
+        self._seen_avals.add(avals)
+        self._seen_statics.add(statics)
+        RECOMPILES_TOTAL.inc(fn=self.name, reason=reason)
+        if reason != "warmup":
+            self.steady_recompiles += 1
+        return reason
+
+
+class RecompileTripwire:
+    """Per-engine probe set + process-wide storm detection. Engines build
+    one (``InferenceEngine._build_fns``), wrap each jitted entry point,
+    and arm it after their first completed request; storms are judged
+    across ALL tripwires in the process (a per-engine budget would let N
+    co-hosted engines each storm just under it)."""
+
+    # shared across instances: storms are a process-level pathology
+    _storm_lock = threading.Lock()
+    _storm_events: deque[float] = deque(maxlen=256)
+    _last_storm_ts = 0.0
+
+    def __init__(self, context: str = ""):
+        self.context = context  # e.g. the model name, for events/logs
+        self._probes: dict[str, JitProbe] = {}
+
+    def wrap(self, name: str, fn: Callable, armable: bool = True) -> JitProbe:
+        probe = JitProbe(name, fn, self, armable=armable)
+        self._probes[name] = probe
+        return probe
+
+    def arm(self) -> None:
+        for probe in self._probes.values():
+            if probe.armable:
+                probe.arm()
+
+    @property
+    def armed(self) -> bool:
+        return any(p.armed for p in self._probes.values())
+
+    def state(self) -> dict[str, Any]:
+        return {
+            name: {"compiles": p.compiles,
+                   "steadyRecompiles": p.steady_recompiles,
+                   "armed": p.armed,
+                   "signatures": len(p._seen)}
+            for name, p in self._probes.items()
+        }
+
+    def _on_steady_recompile(self, probe: JitProbe, reason: str,
+                             avals, statics) -> None:
+        # compact shape string: enough to identify the offending program
+        # without dumping a 300-leaf params tree into the ring
+        shapes = ",".join(f"{s}/{d}" for s, d in avals[:12])
+        if len(avals) > 12:
+            shapes += f",…+{len(avals) - 12}"
+        default_flight_recorder().record(
+            "engine", "recompile", fn=probe.name, reason=reason,
+            context=self.context, nArrays=len(avals), shapes=shapes,
+            statics=";".join(statics[:8]),
+        )
+        log.warning("steady-state recompile", fn=probe.name, reason=reason,
+                    context=self.context, shapes=shapes)
+        budget = _env_int("GRIDLLM_RECOMPILE_BUDGET", 4)
+        window = _env_float("GRIDLLM_RECOMPILE_WINDOW", 60.0)
+        now = time.monotonic()
+        with RecompileTripwire._storm_lock:
+            ev = RecompileTripwire._storm_events
+            ev.append(now)
+            while ev and now - ev[0] > window:
+                ev.popleft()
+            storm = (len(ev) > budget
+                     and now - RecompileTripwire._last_storm_ts > window / 2)
+            if storm:
+                RecompileTripwire._last_storm_ts = now
+        if storm:
+            RECOMPILE_STORMS_TOTAL.inc()
+            diagnosis = {"windowS": window, "budget": budget,
+                         "recompilesInWindow": len(ev),
+                         "lastFn": probe.name, "lastReason": reason,
+                         "lastShapes": shapes}
+            default_flight_recorder().record(
+                "engine", "recompile_storm", **diagnosis)
+            log.error("recompile storm: steady-state recompiles exceed "
+                      "budget — shape bucketing is broken or inputs are "
+                      "unbucketed", **diagnosis)
+
+
+def recompile_totals() -> dict[str, Any]:
+    """Process-wide compile counts from the tripwire counter, split into
+    warmup vs steady-state (bench --emit reads this; the CI perf-smoke
+    gate asserts steady == 0)."""
+    out = {"total": 0, "warmup": 0, "steady": 0, "byFn": {}}
+    for labels, count in RECOMPILES_TOTAL.items():
+        fn, reason = labels["fn"], labels["reason"]
+        count = int(count)
+        out["total"] += count
+        if reason == "warmup":
+            out["warmup"] += count
+        else:
+            out["steady"] += count
+        per = out["byFn"].setdefault(fn, {"warmup": 0, "steady": 0})
+        per["warmup" if reason == "warmup" else "steady"] += count
+    return out
+
+
+# ---------------------------------------------------------------------------
+# device-memory accounting
+# ---------------------------------------------------------------------------
+# Engines register a *memory probe* (worker/service.py, one per service)
+# returning, per model, the live weight/KV arrays plus allocator math —
+# mirroring the flight recorder's engine probes so the snapshot path never
+# imports or locks engine internals.
+
+_memory_probes: dict[str, Callable[[], dict[str, Any]]] = {}
+_memory_probes_lock = threading.Lock()
+
+
+def register_memory_probe(name: str, fn: Callable[[], dict[str, Any]]) -> None:
+    with _memory_probes_lock:
+        _memory_probes[name] = fn
+
+
+def unregister_memory_probe(name: str) -> None:
+    with _memory_probes_lock:
+        _memory_probes.pop(name, None)
+
+
+def _device_label(device: Any) -> str:
+    return f"{device.platform}:{device.id}"
+
+
+def memory_snapshot() -> dict[str, Any]:
+    """Point-in-time device-memory breakdown (``GET /admin/memory``).
+
+    Walks ``jax.live_arrays()`` once, attributing each array's per-shard
+    bytes to its device as weights / kv_pool / workspace by identity
+    against the registered memory probes; workspace is everything not
+    claimed, so the three kinds sum to the measured live total exactly.
+    Adds allocator-reported in-use/limit/headroom where the backend
+    exposes memory_stats (TPU/GPU; CPU has none) and per-model KV math
+    from the page allocator (cold vs cached pages, lane-padding overhead,
+    reserved-capacity fragmentation).
+
+    In a process that never imported jax this returns an empty snapshot
+    with a note instead of initializing a backend (see jax_loaded)."""
+    if not jax_loaded():
+        return {"generatedAt": time.time(), "devices": {}, "models": {},
+                "note": "jax not initialized in this process — query the "
+                        "worker health port for the engine-side view"}
+    import jax
+
+    with _memory_probes_lock:
+        probes = dict(_memory_probes)
+    models: dict[str, Any] = {}
+    weight_ids: set[int] = set()
+    kv_ids: set[int] = set()
+    # shape+dtype fallback for KV attribution: the decode block DONATES
+    # and rebinds engine.cache, so under load the live pool arrays can be
+    # successors of the ones the probe captured (same shapes, new ids) —
+    # id-only matching would misread the whole pool as workspace exactly
+    # when the server is busy. Weights are never donated; ids suffice.
+    kv_shapes: set[tuple] = set()
+    for probe_name, fn in probes.items():
+        try:
+            for model, info in fn().items():
+                weights = info.get("weights") or []
+                kv = info.get("kv") or []
+                weight_ids.update(id(a) for a in weights)
+                kv_ids.update(id(a) for a in kv)
+                # only the rank≥4 pool arrays (k/v: [L,P,ps,KVH,D]) —
+                # they carry ~all the bytes and their shape is
+                # unambiguous; low-rank tables/lengths share shapes with
+                # sampler state and stay id-matched
+                kv_shapes.update(
+                    (tuple(a.shape), str(a.dtype)) for a in kv
+                    if hasattr(a, "shape") and len(a.shape) >= 4)
+                entry = dict(info.get("alloc") or {})
+                entry["weightsBytes"] = sum(
+                    getattr(a, "nbytes", 0) for a in weights)
+                entry["kvPoolBytes"] = sum(
+                    getattr(a, "nbytes", 0) for a in kv)
+                entry["probe"] = probe_name
+                models[model] = entry
+        except Exception as e:  # noqa: BLE001 — snapshots must assemble
+            models[f"{probe_name}:error"] = {"error": str(e)}
+
+    devices: dict[str, dict[str, Any]] = {}
+
+    def dev_entry(label: str) -> dict[str, Any]:
+        return devices.setdefault(label, {
+            "weightsBytes": 0, "kvPoolBytes": 0, "workspaceBytes": 0,
+            "totalLiveBytes": 0,
+        })
+
+    for arr in jax.live_arrays():
+        try:
+            if id(arr) in weight_ids:
+                kind = "weightsBytes"
+            elif id(arr) in kv_ids or (
+                    (tuple(arr.shape), str(arr.dtype)) in kv_shapes):
+                kind = "kvPoolBytes"
+            else:
+                kind = "workspaceBytes"
+            for shard in arr.addressable_shards:
+                entry = dev_entry(_device_label(shard.device))
+                nbytes = getattr(shard.data, "nbytes", 0)
+                entry[kind] += nbytes
+                entry["totalLiveBytes"] += nbytes
+        except Exception:  # noqa: BLE001 — deleted mid-walk (donation race)
+            continue
+
+    for device in jax.local_devices():
+        entry = dev_entry(_device_label(device))
+        stats = None
+        try:
+            stats = device.memory_stats()
+        except Exception:  # noqa: BLE001 — backend without allocator stats
+            stats = None
+        if stats:
+            in_use = stats.get("bytes_in_use")
+            limit = (stats.get("bytes_limit")
+                     or stats.get("bytes_reservable_limit"))
+            entry["bytesInUse"] = in_use
+            entry["bytesLimit"] = limit
+            entry["peakBytesInUse"] = stats.get("peak_bytes_in_use")
+            if in_use is not None and limit:
+                entry["headroomBytes"] = max(limit - in_use, 0)
+                largest = stats.get("largest_free_block_bytes")
+                free = limit - in_use
+                if largest is not None and free > 0:
+                    # external fragmentation: how much of the free HBM is
+                    # NOT reachable as one contiguous block
+                    entry["fragmentation"] = round(1 - largest / free, 4)
+        else:
+            entry["bytesInUse"] = None
+            entry["bytesLimit"] = None
+            entry["headroomBytes"] = None
+    return {
+        "generatedAt": time.time(),
+        "devices": devices,
+        "models": models,
+    }
+
+
+def _memory_collector() -> None:
+    """Registry collector: refresh the device-memory gauges from a fresh
+    snapshot at scrape time (point-in-time-correct, like the scheduler's
+    queue-depth collectors). Skips entirely in processes that never
+    imported jax — a scrape must not initialize a backend."""
+    if not jax_loaded():
+        return
+    snap = memory_snapshot()
+    for label, entry in snap["devices"].items():
+        DEVICE_MEMORY_BYTES.set(entry["weightsBytes"],
+                                device=label, kind="weights")
+        DEVICE_MEMORY_BYTES.set(entry["kvPoolBytes"],
+                                device=label, kind="kv_pool")
+        DEVICE_MEMORY_BYTES.set(entry["workspaceBytes"],
+                                device=label, kind="workspace")
+        if entry.get("headroomBytes") is not None:
+            DEVICE_MEMORY_HEADROOM.set(entry["headroomBytes"], device=label)
+        if entry.get("bytesLimit"):
+            DEVICE_MEMORY_LIMIT.set(entry["bytesLimit"], device=label)
+
+
+# Registered once at import: scrapes of any process importing the engine
+# get the gauges; processes with no live arrays pay one cheap walk.
+_OBS.add_collector("perf.device_memory", _memory_collector)
+
+
+# ---------------------------------------------------------------------------
+# on-demand profiler capture
+# ---------------------------------------------------------------------------
+
+
+class CaptureBusy(RuntimeError):
+    """A profiler capture is already running (jax allows one trace at a
+    time per process)."""
+
+
+class ProfilerCapture:
+    """Bounded on-demand ``jax.profiler`` captures.
+
+    ``capture(seconds)`` starts a trace into a fresh subdirectory of the
+    artifact root (``GRIDLLM_PROFILE_DIR``, default
+    ``/tmp/gridllm-profiles``), spawns a daemon timer that stops it after
+    ``seconds``, prunes the oldest captures past ``GRIDLLM_PROFILE_KEEP``
+    (default 4), and returns the path immediately — the caller (an HTTP
+    handler or the hang watchdog) never blocks for the capture window.
+    Open the result with TensorBoard (``tensorboard --logdir <path>``,
+    profile plugin) or Perfetto (``xprof``/trace viewer); see README
+    "Profiling & performance introspection"."""
+
+    MAX_SECONDS = 120.0
+
+    def __init__(self, base_dir: str | None = None, keep: int | None = None):
+        self._base_dir = base_dir
+        self._keep = keep
+        self._lock = threading.Lock()
+        self._active: dict[str, Any] | None = None
+        self.captures: list[dict[str, Any]] = []  # bounded history
+
+    @property
+    def base_dir(self) -> str:
+        return (self._base_dir
+                or os.environ.get("GRIDLLM_PROFILE_DIR")
+                or "/tmp/gridllm-profiles")
+
+    @property
+    def keep(self) -> int:
+        return self._keep if self._keep is not None else _env_int(
+            "GRIDLLM_PROFILE_KEEP", 4)
+
+    @property
+    def active(self) -> dict[str, Any] | None:
+        with self._lock:
+            return dict(self._active) if self._active else None
+
+    def _prune(self) -> None:
+        base = self.base_dir
+        try:
+            # only the module's own trace-* capture dirs are prunable —
+            # GRIDLLM_PROFILE_DIR may point at a shared directory, and
+            # deleting unrelated entries there would be catastrophic
+            entries = sorted(
+                e for e in os.listdir(base)
+                if e.startswith("trace-")
+                and os.path.isdir(os.path.join(base, e))
+            )
+        except OSError:
+            return
+        for stale in entries[:max(0, len(entries) - self.keep)]:
+            shutil.rmtree(os.path.join(base, stale), ignore_errors=True)
+
+    def capture(self, seconds: float, reason: str = "on_demand") -> dict[str, Any]:
+        """Start a capture; returns {path, seconds, reason, startedAt}.
+        Raises :class:`CaptureBusy` when one is already running."""
+        seconds = min(max(float(seconds), 0.05), self.MAX_SECONDS)
+        safe_reason = "".join(
+            c if c.isalnum() or c in "-_" else "-" for c in reason)[:48]
+        path = os.path.join(
+            self.base_dir, f"trace-{int(time.time() * 1000)}-{safe_reason}")
+        with self._lock:
+            if self._active is not None:
+                raise CaptureBusy(
+                    f"capture already running: {self._active['path']}")
+            import jax
+
+            os.makedirs(path, exist_ok=True)
+            self._prune()
+            jax.profiler.start_trace(path)
+            info = {"path": path, "seconds": seconds, "reason": reason,
+                    "startedAt": time.time()}
+            self._active = info
+        default_flight_recorder().record("engine", "profile_capture",
+                                         path=path, seconds=seconds,
+                                         reason=reason)
+        threading.Thread(target=self._finish_after, args=(seconds,),
+                         name="profiler-capture", daemon=True).start()
+        return dict(info)
+
+    def _finish_after(self, seconds: float) -> None:
+        time.sleep(seconds)
+        self.stop()
+
+    def stop(self) -> dict[str, Any] | None:
+        """Stop the active capture (idempotent; also the timer's path).
+        The trace flush runs OUTSIDE the lock: writing a large trace can
+        take seconds, and a concurrent capture() on the event loop must
+        get an immediate CaptureBusy/answer, not block on the flush.
+        Claiming ``_active`` under the lock first keeps stop idempotent
+        and leaves exactly one thread responsible for the flush; a
+        capture() arriving mid-flush correctly sees "busy" until the
+        post-flush bookkeeping clears it."""
+        with self._lock:
+            info = self._active
+            if info is None or info.get("stopping"):
+                return None  # no capture, or another thread owns the flush
+            info["stopping"] = True
+        try:
+            import jax
+
+            jax.profiler.stop_trace()
+        except Exception as e:  # noqa: BLE001 — a failed stop must not
+            info["error"] = str(e)  # wedge the endpoint forever
+        with self._lock:
+            self._active = None
+            info.pop("stopping", None)
+            info["endedAt"] = time.time()
+            self.captures.append(dict(info))
+            del self.captures[:-16]
+        return dict(info)
+
+
+_PROFILER = ProfilerCapture()
+
+
+def default_profiler() -> ProfilerCapture:
+    """The process-global capture manager (HTTP endpoints + watchdog)."""
+    return _PROFILER
+
+
+def handle_profile_request(seconds_raw: str | None) -> tuple[int, dict[str, Any]]:
+    """Transport-agnostic body of ``POST /admin/profile?seconds=N``:
+    (http_status, json_payload). Shared by the gateway admin surface and
+    the worker health port so neither re-implements validation, the
+    busy conflict, or the no-jax guard (which refuses rather than
+    synchronously initializing a backend in a control-plane process).
+    Does blocking work (dir pruning, start_trace) — async HTTP handlers
+    must call it via ``asyncio.to_thread``."""
+    if not jax_loaded():
+        return 501, {"error": "no jax runtime in this process — POST the "
+                              "worker health port's /admin/profile for an "
+                              "engine-side capture",
+                     "code": "NO_JAX_RUNTIME"}
+    raw = seconds_raw if seconds_raw is not None else "5"
+    try:
+        seconds = float(raw)
+    except ValueError:
+        return 400, {"error": f"seconds must be a number, got {raw!r}",
+                     "code": "BAD_REQUEST"}
+    if not 0 < seconds <= ProfilerCapture.MAX_SECONDS:
+        return 400, {"error": f"seconds must be in "
+                              f"(0, {ProfilerCapture.MAX_SECONDS:g}]",
+                     "code": "BAD_REQUEST"}
+    try:
+        return 200, default_profiler().capture(seconds, reason="on_demand")
+    except CaptureBusy as e:
+        return 409, {"error": str(e), "code": "CAPTURE_BUSY"}

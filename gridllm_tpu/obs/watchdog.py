@@ -194,6 +194,16 @@ class HangWatchdog:
             self._flagged[job_id] = phase
             self._hangs_total.inc(phase=phase)
             diagnosis = self._diagnose(hang)
+            if phase == "decode-step":
+                # a stream that stalled mid-decode means the engine is
+                # wedged RIGHT NOW — a short profiler capture of the next
+                # few seconds shows what the device (or the host hold-up)
+                # is doing, which no post-hoc dump can. to_thread: the
+                # capture start does blocking work (dir prune,
+                # start_trace) that must not stall the sweep loop.
+                profile = await asyncio.to_thread(self._profile_hang, phase)
+                if profile is not None:
+                    diagnosis["profile"] = profile
             hang["diagnosis"] = diagnosis
             sched.tracer.event(
                 job_id, "watchdog.hang", phase=phase,
@@ -217,6 +227,31 @@ class HangWatchdog:
             if self.config.requeue and phase in ("prefill", "decode-step"):
                 await self._abort_and_requeue(job_id)
         return acted
+
+    def _profile_hang(self, phase: str) -> dict[str, Any] | None:
+        """Best-effort short jax.profiler capture on a decode-step hang
+        (config.profile_on_hang_s; 0 disables). Busy/failed captures are
+        swallowed — profiling is evidence-gathering, never a reason the
+        hang handling itself fails. In split deployments this profiles
+        the gateway process (diagnosis-limited); the engine-side capture
+        lives on the worker health port's POST /admin/profile."""
+        seconds = self.config.profile_on_hang_s
+        if not seconds:
+            return None
+        from gridllm_tpu.obs.perf import default_profiler, jax_loaded
+
+        if not jax_loaded():
+            # engine-less control-plane process (split deployment): a
+            # trace of nothing is not worth a backend init in the
+            # watchdog loop. The worker health port's POST /admin/profile
+            # is the engine-side capture.
+            return None
+        try:
+            return default_profiler().capture(seconds,
+                                              reason=f"hang-{phase}")
+        except Exception as e:  # noqa: BLE001
+            log.warning("hang profiler capture skipped", error=str(e))
+            return None
 
     async def _abort_and_requeue(self, job_id: str) -> None:
         """Cancel the wedged assignment on its worker (best-effort — a

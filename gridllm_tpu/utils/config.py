@@ -179,6 +179,16 @@ class WatchdogConfig(BaseModel):
     # abort + requeue hung ACTIVE jobs (reason "hang"); queue-phase hangs
     # are diagnosis-only (there is nothing to requeue)
     requeue: bool = True
+    # on a decode-step hang, auto-start a short jax.profiler capture
+    # (obs/perf.py) so the trace covers the wedge itself; 0 (default)
+    # disables — OPT-IN via GRIDLLM_WATCHDOG_PROFILE_S because the
+    # capture's stop-flush serializes profiler data while holding the
+    # GIL for seconds, which can starve heartbeats/streams mid-incident
+    # and turn a surgical hang-requeue into a worker-crash orphaning.
+    # Only meaningful when the engine runs in THIS process (bench,
+    # single-process deploys) — split deployments use the worker health
+    # port's POST /admin/profile instead.
+    profile_on_hang_s: float = Field(0.0, ge=0)
 
 
 class ObsConfig(BaseModel):
@@ -286,6 +296,8 @@ def load_config() -> Config:
                     decode_stall_ms=_env(
                         "GRIDLLM_WATCHDOG_DECODE_STALL", 60_000),
                     requeue=_env("GRIDLLM_WATCHDOG_REQUEUE", True),
+                    profile_on_hang_s=_env(
+                        "GRIDLLM_WATCHDOG_PROFILE_S", 0.0),
                 ),
                 flightrec_capacity=_env("GRIDLLM_FLIGHTREC_CAPACITY", 256),
             ),

@@ -167,11 +167,30 @@ def build_health_app(service: WorkerService) -> web.Application:
         }
         return web.json_response(artifact)
 
+    async def memory(_):
+        # engine-side device-memory breakdown (obs/perf.py): THIS process
+        # holds the weights and KV pools, so this is the authoritative
+        # weights/KV/workspace + headroom view in split deployments.
+        # to_thread: the live_arrays walk is synchronous.
+        from gridllm_tpu.obs import memory_snapshot
+
+        return web.json_response(await asyncio.to_thread(memory_snapshot))
+
+    async def profile(request):
+        from gridllm_tpu.obs.perf import handle_profile_request
+
+        # to_thread: capture start does blocking dir-prune/start_trace
+        # work — the health port must keep answering liveness probes
+        status, payload = await asyncio.to_thread(
+            handle_profile_request, request.query.get("seconds"))
+        return web.json_response(payload, status=status)
+
     app.add_routes([
         web.get("/health", health), web.get("/health/live", live),
         web.get("/health/ready", ready), web.get("/health/system", system),
         web.get("/worker/status", status), web.get("/metrics", metrics),
-        web.get("/admin/dump", dump),
+        web.get("/admin/dump", dump), web.get("/admin/memory", memory),
+        web.post("/admin/profile", profile),
     ])
     return app
 

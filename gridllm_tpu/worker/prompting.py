@@ -285,7 +285,14 @@ def _first_json_value(s: str) -> tuple[Any, int, int]:
 
 
 def json_instruction(fmt: Any) -> str:
-    """The soft constraint appended for format requests."""
+    """The soft constraint appended for format requests.
+
+    NOTE: this instruction + extract_json below are the ENTIRE
+    ``format:"json"`` enforcement today. engine/jsonmask.py holds an
+    experimental grammar PDA for true per-step constrained decoding, but
+    it is NOT wired — the sampler has no vocabulary-mask hook — so output
+    that parses is best-effort, not guaranteed (see jsonmask's module
+    docstring before assuming otherwise)."""
     if isinstance(fmt, dict):
         return (
             "\nRespond ONLY with JSON matching this JSON schema, with no "

@@ -295,8 +295,15 @@ async def test_watchdog_detects_decode_stall_and_requeues():
         phase="decode-step") >= 1
 
     # the job was cancelled on the wedged worker and requeued (orphan path,
-    # reason hang) — a healthy worker then serves it to completion
-    await bus.flush()
+    # reason hang) — a healthy worker then serves it to completion.
+    # Polled: hang handling now yields between detection and requeue (the
+    # decode-step auto profiler capture runs via to_thread), so the
+    # counter can be visible a beat before the cancellation publish.
+    for _ in range(100):
+        await bus.flush()
+        if wedged.cancelled:
+            break
+        await asyncio.sleep(0.05)
     assert wedged.cancelled  # cancellation delivered
     healthy = FakeWorker(bus, "w-ok", ["m2", "m1"],
                          stream_tokens=["a", "b"])
