@@ -471,6 +471,134 @@ async def run_shared_prefix_bench(model: str, n_requests: int,
                               client=client)
 
 
+async def run_spec_bench(model: str, n_requests: int, n_tokens: int,
+                         max_slots: int, spec_k: int) -> dict:
+    """Speculative-decoding A/B (ISSUE 5): the SAME repetitive-completion
+    workload with speculation off, then on. Templated/repetitive output is
+    the n-gram drafter's home turf — the workload asks for verbatim
+    repetition and runs greedy with repeat_penalty disabled so repetition
+    is not artificially damped. Reports both arms' ITL + tok/s plus the
+    spec arm's acceptance rate and emitted tokens per verify step (> 1 =
+    speculation is paying for its verify overhead)."""
+    import os
+
+    import aiohttp
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from gridllm_tpu.engine import EngineConfig, InferenceEngine
+    from gridllm_tpu.worker.main import resolve_checkpoint
+
+    ckpt, tok = resolve_checkpoint(
+        os.environ.get("GRIDLLM_CHECKPOINT_DIR"), model
+    )
+    # tiny CPU models cap context at 256 byte-tokens — the prompt must
+    # leave room for the measured decode or every stream dies at capacity
+    reps = 2 if model.startswith("tiny") else 5
+    prompt = ("Repeat the policy clause verbatim, forever: the quick brown "
+              "fox jumps over the lazy dog; ") * reps
+    opts = {"temperature": 0, "repeat_penalty": 1.0,
+            "num_predict": n_tokens}
+
+    async def arm(spec_on: bool) -> dict:
+        engine = InferenceEngine(EngineConfig(
+            model=model, checkpoint_path=ckpt, tokenizer=tok,
+            max_slots=max_slots, page_size=64,
+            num_pages=max(256, max_slots * 48), max_pages_per_slot=48,
+            prefill_buckets=(256, 1024),
+            spec_decode=spec_on, spec_k=spec_k,
+        ))
+        bus, registry, scheduler, app, worker = await _build_stack(
+            engine, model)
+        client = None
+        try:
+            await worker.start()
+            await asyncio.sleep(0.1)
+            client = TestClient(TestServer(app))
+            await client.start_server()
+            warm = await client.post("/ollama/api/generate", json={
+                "model": model, "prompt": prompt, "stream": False,
+                "options": {**opts, "num_predict": 4},
+            }, timeout=aiohttp.ClientTimeout(total=240))
+            assert warm.status == 200, await warm.text()
+            s0 = dict(engine.spec_stats)
+            ttfts: list[float] = []
+            itls: list[float] = []
+            tokens_out = [0]
+
+            async def one(i: int) -> None:
+                t0 = time.perf_counter()
+                t_first = t_last = None
+                async with client.post("/ollama/api/generate", json={
+                    "model": model, "prompt": f"[{i}] {prompt}",
+                    "options": dict(opts),
+                }) as resp:
+                    assert resp.status == 200, await resp.text()
+                    async for line in resp.content:
+                        if not line.strip():
+                            continue
+                        now = time.perf_counter()
+                        if t_first is None:
+                            t_first = now
+                            ttfts.append(now - t0)
+                        t_last = now
+                        frame = json.loads(line)
+                        if frame.get("done"):
+                            n = frame.get("eval_count") or 0
+                            tokens_out[0] += n
+                            if n > 1 and t_first is not None:
+                                itls.append(
+                                    (t_last - t_first) / (n - 1) * 1000)
+
+            t0 = time.perf_counter()
+            await asyncio.gather(*(one(i) for i in range(n_requests)))
+            wall = time.perf_counter() - t0
+            st = engine.spec_stats
+            d = {k: st[k] - s0[k] for k in st}
+            out = {
+                "tok_s": tokens_out[0] / wall,
+                "p50_ttft_ms": statistics.median(ttfts) * 1000,
+                "p50_itl_ms": statistics.median(itls) if itls else None,
+                "tokens": tokens_out[0],
+                "wall_s": wall,
+                "spec": d,
+            }
+            if spec_on:
+                # the spec arm is the LAST engine alive — read the perf
+                # sidecar (recompiles across BOTH arms, peak HBM) here
+                out["perf"] = _perf_sidecar()
+            return out
+        finally:
+            await _teardown_stack(bus, registry, scheduler, worker,
+                                  client=client)
+
+    off = await arm(False)
+    on = await arm(True)
+    spec = on["spec"]
+    acc_rate = (spec["accepted"] / spec["proposed"]
+                if spec["proposed"] else 0.0)
+    tok_per_step = (spec["emitted"] / spec["steps"]
+                    if spec["steps"] else 0.0)
+    return {
+        "tok_s": on["tok_s"],
+        "tok_s_spec_off": off["tok_s"],
+        "p50_ttft_ms": on["p50_ttft_ms"],
+        "p50_itl_ms": on["p50_itl_ms"],
+        "p50_itl_ms_spec_off": off["p50_itl_ms"],
+        "itl_speedup": (off["p50_itl_ms"] / on["p50_itl_ms"]
+                        if off["p50_itl_ms"] and on["p50_itl_ms"] else None),
+        "spec_acceptance_rate": round(acc_rate, 4),
+        "spec_tokens_per_step": round(tok_per_step, 4),
+        "spec_steps": spec["steps"],
+        "spec_proposed": spec["proposed"],
+        "spec_accepted": spec["accepted"],
+        "tokens": off["tokens"] + on["tokens"],
+        "wall_s": off["wall_s"] + on["wall_s"],
+        "perf": on.get("perf"),
+        "weights": "real-checkpoint" if ckpt
+        else "random-weights synthetic",
+    }
+
+
 async def run_embed_bench(model: str, n_requests: int,
                           batch: int = 64, rounds: int = 8) -> dict:
     """Embeddings QPS through the full stack (BASELINE config #5):
@@ -523,7 +651,9 @@ BENCH_SCHEMA = "gridllm-bench/v1"
 # regression direction per metric: the compare gate flags a >threshold
 # move the WRONG way; metrics absent from either record are skipped
 HIGHER_BETTER = ("tok_s", "qps", "goodput_tok_s", "slo_attainment",
-                 "ttft_speedup", "prefix_cache_hit_rate")
+                 "ttft_speedup", "prefix_cache_hit_rate",
+                 "spec_acceptance_rate", "spec_tokens_per_step",
+                 "itl_speedup")
 LOWER_BETTER = ("p50_ttft_ms", "p95_ttft_ms", "p50_itl_ms",
                 "peak_hbm_bytes")
 
@@ -595,20 +725,27 @@ def compare_records(old: dict, new: dict,
     return regressions, notes
 
 
-def probe_backend(tries: int = 2, timeout_s: float = 240.0) -> tuple[str, list[str]]:
+def probe_backend(tries: int = 1, timeout_s: float = 60.0) -> tuple[str, list[str]]:
     """Check that jax can initialize its default backend WITHOUT importing jax
     in this process (an in-process TPU init that hangs would take the whole
     bench down with it — exactly what burned round 1, BENCH_r01.json rc=1).
 
-    Probes in a subprocess with a hard timeout, bounded retries. Returns
-    (platform, diagnostics). On persistent failure returns ("cpu", diags)
+    Probes in a subprocess with a hard timeout. Fail-fast (ISSUE 5
+    satellite): BENCH_r05 burned 2 × 240 s of every run on "backend init
+    timed out" before falling back to CPU, so the probe is now ONE cheap
+    device-count check with a short timeout — a healthy TPU (or TPU relay)
+    enumerates its devices well inside 60 s, and a hung runtime goes
+    straight to the fallback, with the skip recorded in the structured
+    health fields (the returned diags land in the payload's `attempts`).
+    Returns (platform, diagnostics). On failure returns ("cpu", diags)
     after pinning JAX_PLATFORMS=cpu in this process's env so the subsequent
     in-process import is guaranteed not to touch the broken accelerator."""
     import os
     import subprocess
 
     diags: list[str] = []
-    code = "import jax; print('PLATFORM=' + jax.devices()[0].platform)"
+    code = ("import jax; print('PLATFORM=' + jax.devices()[0].platform + "
+            "' devices=%d' % jax.device_count())")
     for attempt in range(1, tries + 1):
         try:
             out = subprocess.run(
@@ -617,15 +754,18 @@ def probe_backend(tries: int = 2, timeout_s: float = 240.0) -> tuple[str, list[s
             )
             for line in out.stdout.splitlines():
                 if line.startswith("PLATFORM="):
-                    plat = line.split("=", 1)[1]
-                    diags.append(f"attempt {attempt}: backend ok ({plat})")
+                    plat = line.split("=", 1)[1].split()[0]
+                    diags.append(f"attempt {attempt}: backend ok ({line[9:]})")
                     return plat, diags
             tail = (out.stderr or out.stdout).strip().splitlines()[-3:]
             diags.append(f"attempt {attempt}: rc={out.returncode} {' | '.join(tail)}")
         except subprocess.TimeoutExpired:
-            diags.append(f"attempt {attempt}: backend init timed out after {timeout_s}s")
-        time.sleep(5.0)
-    diags.append("falling back to JAX_PLATFORMS=cpu")
+            diags.append(f"attempt {attempt}: backend init timed out after "
+                         f"{timeout_s}s")
+        if attempt < tries:
+            time.sleep(5.0)
+    diags.append("accelerator probe failed — skipping straight to "
+                 "JAX_PLATFORMS=cpu fallback")
     os.environ["JAX_PLATFORMS"] = "cpu"
     return "cpu", diags
 
@@ -651,6 +791,14 @@ def main() -> int:
     ap.add_argument("--prefix-len", type=int, default=1200,
                     help="shared system-prompt length in characters "
                          "(--shared-prefix only)")
+    ap.add_argument("--spec", action="store_true",
+                    help="speculative-decoding A/B: the same repetitive-"
+                         "completion workload spec-off then spec-on; "
+                         "reports ITL + tok/s for both arms, acceptance "
+                         "rate, and emitted tokens per verify step "
+                         "(ISSUE 5)")
+    ap.add_argument("--spec-k", type=int, default=4,
+                    help="speculation depth K for the --spec scenario")
     ap.add_argument("--tiny", action="store_true",
                     help="tiny-llama CPU smoke test")
     ap.add_argument("--profile", metavar="DIR", default=None,
@@ -674,6 +822,9 @@ def main() -> int:
         ap.error("--profile is only supported on the generate bench")
     if args.embed and args.shared_prefix:
         ap.error("--shared-prefix is a generate scenario; drop --embed")
+    if args.spec and (args.embed or args.shared_prefix):
+        ap.error("--spec is its own generate scenario; drop "
+                 "--embed/--shared-prefix")
 
     # structured run health (ISSUE 2 satellite — replaces the ||-joined
     # error string): `attempts` logs every stage that failed along the way,
@@ -704,7 +855,9 @@ def main() -> int:
         jax.config.update("jax_platforms", "cpu")
         requested = args.model
         args.model = "tiny-bert" if args.embed else "tiny-llama"
-        args.tokens = min(args.tokens, 16)
+        # the spec scenario needs enough decode steps for the output to
+        # enter its repetitive regime before acceptance can show
+        args.tokens = min(args.tokens, 48 if args.spec else 16)
         args.prompt_len = 20
         # the shared prefix must still span several KV pages (64-token
         # pages, byte tokenizer) or there is nothing to cache
@@ -742,6 +895,19 @@ def main() -> int:
                 f"({args.model}, shared-prefix scenario, {args.requests} "
                 f"streams × {args.prefix_len}-char system prompt, "
                 f"{r['weights']})"
+            )
+        elif args.spec:
+            r = asyncio.run(run_spec_bench(
+                args.model, args.requests, args.tokens, args.slots,
+                args.spec_k,
+            ))
+            baseline = A100_OLLAMA_TOK_S.get(args.model, 0.0)
+            value, unit = r["tok_s"], "tok/s"
+            metric_name = (
+                f"spec-on output tokens/sec via /ollama/api/generate "
+                f"({args.model}, speculative-decoding A/B, n-gram "
+                f"K={args.spec_k}, {args.requests} streams, repetitive "
+                f"workload, {r['weights']})"
             )
         else:
             import os as _os
@@ -835,7 +1001,26 @@ def main() -> int:
         "wall_s": round(r["wall_s"], 2),
         "degraded": degraded,
     }
-    if args.shared_prefix:
+    if args.spec:
+        # the speculation headline: the A/B ITL delta plus the acceptance
+        # numbers that explain it — folded into the --emit record so
+        # --compare flags acceptance/ITL regressions (a collapse to
+        # acceptance ≈ 0 means drafting is pure verify overhead)
+        if r.get("p50_itl_ms") is not None:
+            payload["p50_itl_ms"] = round(r["p50_itl_ms"], 2)
+        if r.get("p50_itl_ms_spec_off") is not None:
+            payload["p50_itl_ms_spec_off"] = round(
+                r["p50_itl_ms_spec_off"], 2)
+        if r.get("itl_speedup") is not None:
+            payload["itl_speedup"] = round(r["itl_speedup"], 3)
+        payload["tok_s_spec_off"] = round(r["tok_s_spec_off"], 2)
+        payload["spec_acceptance_rate"] = r["spec_acceptance_rate"]
+        payload["spec_tokens_per_step"] = r["spec_tokens_per_step"]
+        payload["spec_steps"] = r["spec_steps"]
+        payload["spec_proposed"] = r["spec_proposed"]
+        payload["spec_accepted"] = r["spec_accepted"]
+        payload["tokens"] = r["tokens"]
+    elif args.shared_prefix:
         # the prefix-cache headline: warm TTFT must beat cold, and the
         # warm round's prompt-page hit rate proves the cache did the work
         payload["p50_ttft_ms_cold"] = round(r["p50_ttft_ms_cold"], 1)
@@ -873,7 +1058,8 @@ def main() -> int:
         if perf_side.get("peak_hbm_bytes"):
             payload["peak_hbm_bytes"] = perf_side["peak_hbm_bytes"]
     scenario = ("embed" if args.embed
-                else "shared-prefix" if args.shared_prefix else "generate")
+                else "shared-prefix" if args.shared_prefix
+                else "spec" if args.spec else "generate")
     record = build_record(scenario, args, payload, r)
     regressions: list = []
     if args.compare:

@@ -24,6 +24,18 @@ compute path was `client/src/services/OllamaService.ts` HTTP calls). Design
   device and folds it into the step state; the host first sees it in the
   NEXT block's row 0 (blocks return [K+1, S] — input tokens + K sampled),
   matched by a per-slot dispatch-generation tag.
+- Speculative decoding (ISSUE 5, default on via GRIDLLM_SPEC_DECODE):
+  the host drafts up to GRIDLLM_SPEC_K candidate tokens per slot
+  (prompt-lookup n-gram, ops/spec.py), ONE batched verify forward
+  (mod.verify_step) scores the whole [S, K+1] candidate block against
+  the paged prefix, and the accept/reject kernel (ops/sampling.py
+  spec_accept) keeps the longest accepted prefix + one corrected token
+  — 1..K+1 tokens per step, greedy streams byte-identical to spec-off,
+  sampled streams exactly rejection-sampled. Candidate KV is written
+  optimistically and rolled back by length (ops/kvcache.py). The spec
+  path fetches every verify step (the next draft depends on this step's
+  tokens), trading the block pipeline for multi-token steps — the win
+  when the model forward dominates step time and the workload repeats.
 - Ollama semantics honored at this layer: sampler option surface (via
   ops/sampling), `seed` determinism per request (unseeded requests draw a
   random seed host-side — seed 0 is NOT a fixed default), real timing
@@ -61,13 +73,19 @@ from gridllm_tpu.obs.perf import (
     HOST_SCHED_SECONDS,
     RecompileTripwire,
 )
-from gridllm_tpu.ops.kvcache import PagedKVCache, PageAllocator
+from gridllm_tpu.ops.kvcache import (
+    PagedKVCache,
+    PageAllocator,
+    rollback_to_length,
+)
 from gridllm_tpu.ops.sampling import (
     SamplingParams,
     sample_tokens,
+    spec_accept,
     window_push,
     window_set_slot,
 )
+from gridllm_tpu.ops.spec import make_drafter
 from gridllm_tpu.parallel.mesh import MeshConfig, build_mesh
 from gridllm_tpu.parallel.sharding import shard_cache, shard_params
 from gridllm_tpu.utils.logging import get_logger
@@ -114,6 +132,34 @@ _PREFIX_HIT_RATE = _OBS.gauge(
     "Cumulative prompt-page prefix-cache hit rate (hits / (hits+misses)), "
     "by model.",
     ("model",),
+)
+# speculative decoding (ISSUE 5): draft-token accounting. proposed =
+# drafts sent to a verify step, accepted = drafts the model agreed with,
+# rejected = proposed - accepted (a draft discarded because an EARLIER one
+# missed counts as rejected too — it was wasted verify work either way).
+# The per-step histogram is the acceptance-collapse signal: spec on with
+# rate ≈ 0 means drafting is pure overhead (prometheus alert).
+_SPEC_PROPOSED = _OBS.counter(
+    "gridllm_spec_proposed_tokens_total",
+    "Draft tokens proposed to speculative verify steps, by model.",
+    ("model",),
+)
+_SPEC_ACCEPTED = _OBS.counter(
+    "gridllm_spec_accepted_tokens_total",
+    "Draft tokens accepted by speculative verify steps, by model.",
+    ("model",),
+)
+_SPEC_REJECTED = _OBS.counter(
+    "gridllm_spec_rejected_tokens_total",
+    "Draft tokens rejected (or discarded past the first miss) by "
+    "speculative verify steps, by model.",
+    ("model",),
+)
+_SPEC_ACCEPT_RATE = _OBS.histogram(
+    "gridllm_spec_acceptance_rate",
+    "Per-verify-step draft acceptance rate (accepted/proposed, over steps "
+    "with at least one proposed draft), by model.",
+    ("model",), buckets=(0.0, 0.1, 0.25, 0.5, 0.75, 0.9, 1.0),
 )
 # flight recorder (obs/flightrec.py): lifecycle events land in the "engine"
 # ring; block dispatches are SAMPLED (one record per _FLIGHT_SAMPLE
@@ -188,6 +234,18 @@ class EngineConfig:
     # semantics as PageAllocator.cache_pages; negative → unbounded).
     prefix_cache: bool | None = None
     prefix_cache_pages: int | None = None
+    # speculative decoding (ISSUE 5): n-gram (prompt-lookup) drafting +
+    # batched K-token verification. None → env GRIDLLM_SPEC_DECODE
+    # (default on; "0" disables — exact legacy decode path). spec_k is the
+    # speculation depth K (drafted tokens verified per step; the verify
+    # block is [S, K+1] — candidates plus the committed last token), FIXED
+    # per process so every shape stays static and the recompile tripwire
+    # stays green. None → GRIDLLM_SPEC_K (default 4); 0 also disables.
+    # Greedy streams are byte-identical spec-on vs spec-off; sampled
+    # streams keep the target distribution via rejection sampling
+    # (ops/sampling.py spec_accept).
+    spec_decode: bool | None = None
+    spec_k: int | None = None
 
 
 @dataclasses.dataclass
@@ -218,6 +276,10 @@ class GenerationResult:
     eval_duration_ns: int = 0
     load_duration_ns: int = 0
     total_duration_ns: int = 0
+    # speculative decoding (ISSUE 5): drafts proposed/accepted for this
+    # request's verify steps (both 0 with speculation off)
+    spec_proposed: int = 0
+    spec_accepted: int = 0
     retryable: bool = True  # meaningful when done_reason == "error"
     # when done_reason == "error": the failure message. `text` stays the
     # partial output actually generated, so a streaming client's concatenated
@@ -230,7 +292,7 @@ class _Slot:
     __slots__ = (
         "req", "ids", "prompt_len", "generated", "detok", "text", "emitted_len",
         "num_predict", "stop_seqs", "eos_ids", "capacity", "joined_gen",
-        "cached_tokens",
+        "cached_tokens", "spec_proposed", "spec_accepted",
         "t_start", "t_prefill_ns", "t_first_decode", "t_last_ingest",
     )
 
@@ -248,6 +310,8 @@ class _Slot:
         self.eos_ids = eos_ids
         self.capacity = capacity         # max total tokens this slot may hold
         self.cached_tokens = 0           # prompt tokens reused from the prefix cache
+        self.spec_proposed = 0           # drafts sent to verify steps
+        self.spec_accepted = 0           # drafts the model accepted
         # dispatch generation of the FIRST decode block that will see this
         # slot: its row 0 (block-input tokens) carries the prefill-sampled
         # token; blocks with a lower generation predate the slot (or belong
@@ -326,6 +390,13 @@ class InferenceEngine:
         # recompile (counter + flight-recorder event with the shapes)
         self.perf = RecompileTripwire(context=self.cfg.name)
         self._perf_armed = False
+        # speculative decoding (ISSUE 5): depth resolved in _build_fns
+        # (it needs the resolved family module); 0 = off. spec_stats are
+        # cumulative host-side totals (bench + batch_state read them).
+        self._spec_k = 0
+        self._drafter = None
+        self.spec_stats = {"steps": 0, "proposed": 0, "accepted": 0,
+                           "emitted": 0}
         # step-time decomposition state (runner thread only)
         self._t_prev_fetch: float | None = None
         self._t_ingest_done: float | None = None
@@ -433,6 +504,23 @@ class InferenceEngine:
             raw = os.environ.get("GRIDLLM_PREFIX_CACHE_PAGES", "")
             pages = int(raw) if raw else -1
         return max(pages, -1)
+
+    def _resolve_spec_k(self) -> int:
+        """Speculation depth K (0 = off). EngineConfig overrides env;
+        GRIDLLM_SPEC_DECODE=0 disables, GRIDLLM_SPEC_K sets the depth
+        (default 4 — a [S, 5] verify block). Fixed per process: K is a
+        static jit arg, so a single verify program serves steady state."""
+        on = self.config.spec_decode
+        if on is None:
+            on = os.environ.get("GRIDLLM_SPEC_DECODE", "1").lower() not in (
+                "0", "off", "false")
+        if not on:
+            return 0
+        k = self.config.spec_k
+        if k is None:
+            raw = os.environ.get("GRIDLLM_SPEC_K", "")
+            k = int(raw) if raw else 4
+        return max(int(k), 0)
 
     def _pool_head_dim(self) -> int:
         """Page-pool head dim: lane-padded to 128 when the Pallas kernels
@@ -694,6 +782,61 @@ class InferenceEngine:
             ps, (min(self.config.prefill_chunk, self.max_context) // ps) * ps
         )
         self._decode_block_fn = self.perf.wrap("decode_block", decode_block_fn)
+
+        # Speculative decoding (ISSUE 5): one verify step = ONE batched
+        # forward over each slot's [K+1] candidate block (committed last
+        # token + K host-drafted candidates) + the accept/reject kernel +
+        # the KV rollback commit — all inside one jit call. Emits 1..K+1
+        # tokens per slot per dispatch. K is static (fixed per process) so
+        # the program compiles once; the recompile tripwire wraps it like
+        # every other entry point.
+        spec_k = self._resolve_spec_k()
+        if not hasattr(mod, "verify_step"):
+            # pp>1 routes decode through parallel/pipeline.py, which has
+            # no verify schedule yet — serve exact non-speculative decode
+            # rather than failing at the first request
+            if spec_k:
+                log.info("speculative decoding disabled: no verify_step "
+                         "for this decode path", model=mc.name)
+            self._spec_k = 0
+        else:
+            self._spec_k = spec_k
+            if spec_k:
+                self._drafter = make_drafter()
+            # the verify program is built even with speculation off so a
+            # multi-host follower can replay a liaison's "verify" plan ops
+            # regardless of its own env (K comes from the record; nothing
+            # compiles unless a verify is actually dispatched)
+
+            @partial(jax.jit, static_argnames=("k1",),
+                     donate_argnums=(1, 2, 4, 5, 6, 7))
+            def verify_block_fn(params, cache, tokens, active, counts,
+                                window, wlen, sp, drafts, dlen, *, k1):
+                # candidates [S, K+1]: col 0 is the device's committed
+                # last token — the host never needs to know it (a freshly
+                # admitted slot's prefill sample stays device-side, same
+                # no-sync admission contract as the block path)
+                cand = jnp.concatenate([tokens[:, None], drafts], axis=1)
+                logits, cache = mod.verify_step(
+                    params, mc, cand, cache, active, mesh=self.mesh
+                )
+                out, n_emit, last, counts, window, wlen, sp = spec_accept(
+                    logits, cand, dlen, sp, counts, window, wlen, active,
+                    mc.vocab_size,
+                )
+                tokens = jnp.where(active, last, tokens)
+                # commit accepted length; rejected candidate rows roll back
+                cache = rollback_to_length(
+                    cache,
+                    jnp.minimum(cache.lengths + n_emit, cache.max_context),
+                )
+                # block protocol: [K+2, S] — row 0 = block-input tokens
+                # (a just-admitted slot's prefill sample), rows 1..K+1 the
+                # emitted tokens, valid up to n_emit per slot
+                block = jnp.concatenate([cand[:, :1].T, out])
+                return block, n_emit, tokens, cache, counts, window, wlen, sp
+
+            self._verify_fn = self.perf.wrap("verify_block", verify_block_fn)
 
     # ------------------------------------------------------------ admission
 
@@ -1021,6 +1164,14 @@ class InferenceEngine:
         elif op == "block":
             self._dispatch_block(int(rec["k"]))
             self._inflight.clear()  # replay never fetches
+        elif op == "verify":
+            # drafts are plain host ints in the record, so follower device
+            # state evolves bit-identically to the liaison's
+            self._dispatch_verify(
+                np.asarray(rec["drafts"], np.int32),
+                np.asarray(rec["dlen"], np.int32),
+            )
+            self._inflight.clear()  # replay never fetches
         elif op == "deact":
             self.active = self.active.at[int(rec["slot"])].set(False)
         elif op == "embed":
@@ -1089,6 +1240,8 @@ class InferenceEngine:
             eval_duration_ns=(now - st.t_first_decode) if st.t_first_decode else 0,
             load_duration_ns=self.load_duration_ns,
             total_duration_ns=now - st.t_start,
+            spec_proposed=st.spec_proposed,
+            spec_accepted=st.spec_accepted,
         )
         with self.dispatch_lock:
             self.active = self.active.at[slot].set(False)
@@ -1149,6 +1302,118 @@ class InferenceEngine:
             if self.plan_sink is not None:  # after-success; see _try_admit
                 self.plan_sink({"op": "block", "k": k})
 
+    def _dispatch_verify(self, drafts: np.ndarray, dlen: np.ndarray) -> None:
+        """Dispatch one speculative verify block: [S, K] host drafts (+
+        per-slot valid count) against the device's committed last tokens.
+        No host sync — the fetch happens in _step_spec."""
+        with self.dispatch_lock:
+            _BATCH_OCCUPANCY.observe(len(self._slots), model=self.cfg.name)
+            self._gen += 1
+            if self._gen % _FLIGHT_SAMPLE == 0:
+                _FLIGHTREC.record("engine", "verify", model=self.cfg.name,
+                                  gen=self._gen, k=int(drafts.shape[1]),
+                                  slots=len(self._slots),
+                                  drafted=int(dlen.sum()),
+                                  pending=len(self._pending))
+            t0 = time.perf_counter()
+            (block, n_emit, self.tokens, self.cache, self.counts,
+             self.window, self.wlen, self.sampling) = self._verify_fn(
+                self.params, self.cache, self.tokens, self.active,
+                self.counts, self.window, self.wlen, self.sampling,
+                jnp.asarray(drafts, jnp.int32), jnp.asarray(dlen, jnp.int32),
+                k1=int(drafts.shape[1]) + 1,  # from the record: follower
+            )                                 # replay may differ from env K
+            now = time.perf_counter()
+            DISPATCH_SECONDS.observe(now - t0, model=self.cfg.name)
+            self._inflight.append((self._gen, (block, n_emit), 1, now))
+            if self.plan_sink is not None:  # after-success; see _try_admit
+                self.plan_sink({"op": "verify", "drafts": drafts.tolist(),
+                                "dlen": dlen.tolist()})
+
+    def _step_spec(self) -> None:
+        """One speculative iteration: draft per slot from host-visible
+        history, dispatch the verify block, fetch, ingest the ragged
+        accept counts. Serial by construction — the next step's drafts
+        depend on this step's emitted tokens, so there is no block
+        pipeline to hide the fetch behind; speculation pays that back by
+        emitting up to K+1 tokens per fetch."""
+        k = self._spec_k
+        drafts = np.zeros((self.config.max_slots, k), np.int32)
+        dlen = np.zeros((self.config.max_slots,), np.int32)
+        for slot, st in list(self._slots.items()):
+            if st.joined_gen > self._gen:
+                continue  # first token still device-side — nothing to extend
+            prop = self._drafter.draft(st.ids, k)
+            if prop and st.num_predict >= 0:
+                # don't draft past num_predict: the host would discard the
+                # overshoot anyway, and counting it would skew acceptance
+                prop = prop[:max(st.num_predict - len(st.generated) - 1, 0)]
+            if prop:
+                dlen[slot] = len(prop)
+                drafts[slot, :len(prop)] = prop
+        self._dispatch_verify(drafts, dlen)
+        gen, (block, n_emit), _blk, t_disp = self._inflight.popleft()
+        t0 = time.perf_counter()
+        raw = np.asarray(jax.device_get(block))
+        n_np = np.asarray(jax.device_get(n_emit))
+        self._observe_device_step(t_disp, 1)
+        self._ingest_spec(gen, raw, n_np, dlen)
+        _STEP_DURATION.observe(time.perf_counter() - t0, model=self.cfg.name)
+
+    def _ingest_spec(self, gen: int, tok_np: np.ndarray,
+                     n_emit: np.ndarray, dlen: np.ndarray) -> None:
+        """Ragged-block ingest: per slot, rows 1..n_emit[slot] of the
+        fetched [K+2, S] block are real emitted tokens (row 0 is the
+        block-input protocol row — a just-admitted slot's prefill sample);
+        rows past n_emit are rejected-draft junk and never touch host
+        state. Stop sequences / EOS / num_predict run per token inside
+        _ingest, so a stop landing mid-span truncates exactly as the
+        sequential path would."""
+        now = time.perf_counter_ns()
+        wall = time.time()
+        ingested = 0
+        emitted_t = 0  # verify-emitted rows only (row 0 is a prefill sample)
+        proposed_t = accepted_t = 0
+        for slot, st in list(self._slots.items()):
+            if st.joined_gen > gen:
+                continue
+            first_row = 0 if st.joined_gen == gen else 1
+            if first_row == 0:
+                st.t_prefill_ns = now - st.t_start
+            if not st.t_first_decode:
+                st.t_first_decode = now
+            st.t_last_ingest = wall
+            n = int(n_emit[slot])
+            prop = int(dlen[slot])
+            acc = max(n - 1, 0)
+            st.spec_proposed += prop
+            st.spec_accepted += acc
+            proposed_t += prop
+            accepted_t += acc
+            for r in range(first_row, min(n, tok_np.shape[0] - 1) + 1):
+                self._ingest(slot, st, int(tok_np[r, slot]))
+                ingested += 1
+                emitted_t += 1 if r >= 1 else 0
+                if slot not in self._slots:
+                    break  # finished mid-span; later rows are post-stop junk
+        if ingested:
+            _TOKENS_TOTAL.inc(ingested, model=self.cfg.name, kind="decode")
+        m = self.cfg.name
+        if proposed_t:
+            _SPEC_PROPOSED.inc(proposed_t, model=m)
+            _SPEC_ACCEPT_RATE.observe(accepted_t / proposed_t, model=m)
+        if accepted_t:
+            _SPEC_ACCEPTED.inc(accepted_t, model=m)
+        if proposed_t - accepted_t:
+            _SPEC_REJECTED.inc(proposed_t - accepted_t, model=m)
+        stats = self.spec_stats
+        stats["steps"] += 1
+        stats["proposed"] += proposed_t
+        stats["accepted"] += accepted_t
+        # row-0 tokens are prefill samples riding the block protocol, not
+        # verify output — only rows >= 1 count toward tokens-per-step
+        stats["emitted"] += emitted_t
+
     def _ingest_block(self, gen: int, tok_np: np.ndarray) -> None:
         """Feed one fetched [k+1, S] token block through per-token
         bookkeeping. Row 0 = block-input tokens: consumed only by slots
@@ -1197,6 +1462,9 @@ class InferenceEngine:
         if not self._slots:
             self._t_prev_fetch = None
             return bool(self._pending)
+        if self._spec_k:
+            self._step_spec()
+            return True
         self._dispatch_block(1)
         gen, out, blk, t_disp = self._inflight.popleft()
         t0 = time.perf_counter()
@@ -1314,6 +1582,18 @@ class InferenceEngine:
         if not self._slots:
             self._t_prev_fetch = None
             self._t_ingest_done = None
+            return
+        if self._spec_k:
+            # speculative serving: one verify block per iteration, fetched
+            # immediately (the next step's drafts depend on this step's
+            # tokens, so the block pipeline can't apply — acceptance > 1
+            # token/step is what pays the un-hidden fetch back)
+            if self._t_ingest_done is not None:
+                HOST_SCHED_SECONDS.observe(
+                    time.perf_counter() - self._t_ingest_done,
+                    model=self.cfg.name)
+            self._step_spec()
+            self._t_ingest_done = time.perf_counter()
             return
         k = self.config.decode_block
         # host-scheduling gap since the previous block's ingest finished
@@ -1530,6 +1810,9 @@ class InferenceEngine:
                 "evictions": self.alloc.evictions,
                 "cowCopies": self.alloc.cow_copies,
             } if not self.embedding_only else None,
+            "specDecode": {
+                "k": self._spec_k, **self.spec_stats,
+            } if self._spec_k else None,
             "jit": self.perf.state(),
         }
 

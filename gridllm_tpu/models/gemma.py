@@ -45,10 +45,12 @@ from gridllm_tpu.ops.attention import (
     attention_prefill,
     attention_prefix_chunk,
     paged_attention_decode,
+    paged_attention_verify,
 )
 from gridllm_tpu.ops.kvcache import (
     PagedKVCache,
     write_decode_all,
+    write_multi_all,
     write_prefill_all,
 )
 from gridllm_tpu.ops.layers import apply_rope, precompute_rope
@@ -365,6 +367,47 @@ def decode_step(
     return logits, PagedKVCache(
         k=k_pool, v=v_pool, page_table=cache.page_table,
         lengths=new_lengths, page_size=cache.page_size,
+    )
+
+
+def verify_step(
+    params: Params,
+    cfg: ModelConfig,
+    tokens: jnp.ndarray,
+    cache: PagedKVCache,
+    active: jnp.ndarray,
+    mlp=None,
+    mesh=None,
+) -> tuple[jnp.ndarray, PagedKVCache]:
+    """Speculative-verify forward (llama.verify_step contract): T candidate
+    tokens per slot in one pass, KV written optimistically, lengths left
+    for the engine's rollback_to_length commit. Softcap and the per-layer
+    sliding windows thread through paged_attention_verify exactly as they
+    do through the decode path."""
+    del mlp
+    s, t = tokens.shape
+    x = _embed_in(params, cfg, tokens)  # [S, T, E]
+    base = cache.lengths
+    pos = base[:, None] + jnp.arange(t, dtype=jnp.int32)[None]
+
+    def attn_fn(q, k, v, win, li):
+        return paged_attention_verify(
+            q, cache.k, cache.v, cache.page_table, base, cache.page_size,
+            k_cur=k, v_cur=v, layer=li, use_pallas=cfg.use_pallas,
+            logit_softcap=cfg.attn_logit_softcap, window=win, mesh=mesh,
+        ).reshape(s, t, -1)
+
+    x, k_new, v_new = _scan_layers(params, cfg, x, pos, attn_fn)
+    x = _gnorm(x, params["final_norm"], cfg.rms_eps)
+    logits = _unembed(cfg, params, x)  # [S, T, V]
+
+    k_pool, v_pool = write_multi_all(
+        cache.k, cache.v, k_new, v_new, cache.page_table, pos, active,
+        cache.page_size, use_pallas=cfg.use_pallas, mesh=mesh,
+    )
+    return logits, PagedKVCache(
+        k=k_pool, v=v_pool, page_table=cache.page_table,
+        lengths=base, page_size=cache.page_size,
     )
 
 

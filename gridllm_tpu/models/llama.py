@@ -28,10 +28,12 @@ from gridllm_tpu.ops.attention import (
     attention_prefill,
     attention_prefix_chunk,
     paged_attention_decode,
+    paged_attention_verify,
 )
 from gridllm_tpu.ops.kvcache import (
     PagedKVCache,
     write_decode_all,
+    write_multi_all,
     write_prefill_all,
 )
 from gridllm_tpu.ops.quant import qdot
@@ -515,6 +517,94 @@ def decode_step(
     cache = PagedKVCache(
         k=k_pool, v=v_pool, page_table=cache.page_table,
         lengths=new_lengths, page_size=cache.page_size,
+    )
+    return logits, cache
+
+
+def verify_layers(
+    layers: Params,
+    cfg: ModelConfig,
+    x: jnp.ndarray,
+    k_pool: jnp.ndarray,
+    v_pool: jnp.ndarray,
+    page_table: jnp.ndarray,
+    base_lengths: jnp.ndarray,
+    page_size: int,
+    mlp: MlpFn = _mlp,
+    mesh=None,
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Speculative-verify layer scan: T candidate tokens for ALL slots at
+    once against each slot's paged prefix (ISSUE 5). x: [S, T, E];
+    base_lengths: [S] cached-prefix length per slot (candidate i sits at
+    absolute position base_lengths[s] + i). Returns (x out, k_new
+    [L, S, T, KVH, D], v_new) — pool writes are the caller's, same
+    deferred-write discipline as decode_layers."""
+    s, t = x.shape[:2]
+    inv_freq = precompute_rope(cfg.head_dim_, cfg.rope_theta, cfg.rope_scaling)
+    pos = base_lengths[:, None] + jnp.arange(t, dtype=jnp.int32)[None]
+    n = jax.tree.leaves(layers)[0].shape[0]
+
+    def layer(x, xs):
+        lp, li = xs
+        hx = rms_norm(x, lp["attn_norm"], cfg.rms_eps)
+        q, k, v = _qkv(cfg, lp, hx)  # q: [S, T, H, D], k/v: [S, T, KVH, D]
+        q = apply_rope(q, pos, inv_freq)
+        k = apply_rope(k, pos, inv_freq)
+        # pool holds each slot's prefix only; the candidates' K/V are
+        # overlaid in-register and written ONCE after the scan (full pool
+        # as closure + layer index — see decode_layers)
+        att = paged_attention_verify(
+            q, k_pool, v_pool, page_table, base_lengths, page_size,
+            k_cur=k, v_cur=v, layer=li, use_pallas=cfg.use_pallas,
+            window=cfg.sliding_window, mesh=mesh,
+        ).reshape(s, t, -1)
+        x = x + qdot(att, lp["wo"], precision=_precision(x))
+        hx = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
+        return x + mlp(lp, hx), (k, v)
+
+    x, (k_new, v_new) = jax.lax.scan(
+        layer, x, (layers, jnp.arange(n, dtype=jnp.int32))
+    )
+    return x, k_new, v_new
+
+
+def verify_step(
+    params: Params,
+    cfg: ModelConfig,
+    tokens: jnp.ndarray,
+    cache: PagedKVCache,
+    active: jnp.ndarray,
+    mlp: MlpFn = _mlp,
+    mesh=None,
+) -> tuple[jnp.ndarray, PagedKVCache]:
+    """One speculative-verify forward for ALL slots (ISSUE 5). tokens:
+    [S, T] candidate blocks (col 0 = each slot's committed last token,
+    cols 1..T-1 = drafted candidates), active: [S] bool. Returns (logits
+    [S, T, V] fp32 — row j is the distribution after consuming candidates
+    0..j — and the cache with the candidates' KV written OPTIMISTICALLY at
+    positions lengths[s]..lengths[s]+T-1 but lengths UNCHANGED: the engine
+    commits the accepted length afterwards via
+    ops.kvcache.rollback_to_length, which drops rejected rows)."""
+    _check_supported(cfg)
+    s, t = tokens.shape
+    x = params["embed"][tokens]  # [S, T, E]
+    base = cache.lengths
+    positions = base[:, None] + jnp.arange(t, dtype=jnp.int32)[None]
+
+    x, k_new, v_new = verify_layers(
+        params["layers"], cfg, x, cache.k, cache.v, cache.page_table,
+        base, cache.page_size, mlp, mesh=mesh,
+    )
+    x = rms_norm(x, params["final_norm"], cfg.rms_eps)
+    logits = _unembed(cfg, params, x)  # [S, T, V]
+
+    k_pool, v_pool = write_multi_all(
+        cache.k, cache.v, k_new, v_new, cache.page_table, positions, active,
+        cache.page_size, use_pallas=cfg.use_pallas, mesh=mesh,
+    )
+    cache = PagedKVCache(
+        k=k_pool, v=v_pool, page_table=cache.page_table,
+        lengths=base, page_size=cache.page_size,
     )
     return logits, cache
 

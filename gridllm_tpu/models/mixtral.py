@@ -310,6 +310,23 @@ def decode_step(
     )
 
 
+def verify_step(
+    params: Params,
+    cfg: ModelConfig,
+    tokens: jnp.ndarray,
+    cache: PagedKVCache,
+    active: jnp.ndarray,
+    mesh=None,
+) -> tuple[jnp.ndarray, PagedKVCache]:
+    """Speculative-verify forward (llama.verify_step contract) with the
+    MoE feed-forward routed per candidate token — _moe_mlp is leading-dim
+    agnostic, so the [S, T, E] verify stream routes like prefill's."""
+    return llama.verify_step(
+        params, cfg, tokens, cache, active, mlp=_mlp_for(cfg, mesh),
+        mesh=mesh,
+    )
+
+
 # ---------------------------------------------------------------------------
 # HF weight conversion (layout contract with transformers MixtralForCausalLM)
 # ---------------------------------------------------------------------------

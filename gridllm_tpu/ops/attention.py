@@ -34,6 +34,7 @@ from gridllm_tpu.ops.kvcache import (
 
 __all__ = [
     "attention_prefill", "paged_attention_decode", "attention_prefix_chunk",
+    "paged_attention_verify",
     "attention_prefill_ref", "paged_attention_decode_ref",
     "_env_mode", "_pallas_mode",  # re-export: policy lives in ops/kvcache.py
 ]
@@ -398,6 +399,134 @@ def attention_prefix_chunk(
         precision=jax.lax.Precision.HIGHEST,
     )
     return out.reshape(1, t, h, d).astype(q.dtype)
+
+
+def paged_attention_verify(
+    q: jnp.ndarray,
+    k_pages: jnp.ndarray,
+    v_pages: jnp.ndarray,
+    page_table: jnp.ndarray,
+    lengths: jnp.ndarray,
+    page_size: int,
+    k_cur: jnp.ndarray,
+    v_cur: jnp.ndarray,
+    layer: jnp.ndarray | None = None,
+    use_pallas: bool | None = None,
+    logit_softcap: float = 0.0,
+    window: jnp.ndarray | int = 0,
+    mesh=None,
+) -> jnp.ndarray:
+    """Batched multi-token decode attention — the speculative-verify step
+    (ISSUE 5): S slots × T candidate tokens each, attending the slot's
+    paged prefix plus the candidates before them.
+
+    q: [S, T, H, D] (candidate queries, post-rope); k_cur/v_cur:
+    [S, T, KVH, D] (the candidates' fresh K/V, not yet in the pool);
+    lengths: [S] cached-prefix length per slot — candidate i of slot s
+    sits at absolute position lengths[s] + i. Returns [S, T, H, D].
+
+    Kernel path: per-slot dispatch through attention_prefix_chunk with
+    start = lengths[s] and total_len = lengths[s] + T — chunked prefill
+    against a cached prefix IS verify attention with every chunk row
+    valid, so the paged-prefix streaming kernel (runtime start/total
+    scalars, lane-padded pools, meshed shard_map) is reused wholesale;
+    the slot loop is static and T tiny (spec_k + 1). A fused
+    ragged-verify kernel (one grid over slots, the Ragged Paged Attention
+    shape) can replace the loop later without touching callers.
+
+    jnp path: ONE batched reference (vmap over slots of the dense prefix
+    gather) — tracing S separate chunk fallbacks per layer would bloat
+    CPU compiles S-fold for the same math.
+    """
+    t = q.shape[1]
+    use, interpret = _pallas_mode(use_pallas)
+    mode, _ax = kernel_mesh_axis(mesh, k_cur.shape[2], q.shape[2])
+    if use and mode != "ref":
+        outs = [
+            attention_prefix_chunk(
+                q[i][None], k_pages, v_pages, page_table[i], lengths[i],
+                lengths[i] + t, page_size, k_cur=k_cur[i], v_cur=v_cur[i],
+                layer=layer, use_pallas=use_pallas,
+                logit_softcap=logit_softcap, window=window, mesh=mesh,
+            )
+            for i in range(q.shape[0])
+        ]
+        return jnp.concatenate(outs, axis=0)
+    record_kernel_path("attention_verify", False)
+    return paged_attention_verify_ref(
+        q, k_pages, v_pages, page_table, lengths, page_size, k_cur, v_cur,
+        layer=layer, logit_softcap=logit_softcap, window=window,
+    )
+
+
+def paged_attention_verify_ref(
+    q: jnp.ndarray,
+    k_pages: jnp.ndarray,
+    v_pages: jnp.ndarray,
+    page_table: jnp.ndarray,
+    lengths: jnp.ndarray,
+    page_size: int,
+    k_cur: jnp.ndarray,
+    v_cur: jnp.ndarray,
+    layer: jnp.ndarray | None = None,
+    logit_softcap: float = 0.0,
+    window: jnp.ndarray | int = 0,
+) -> jnp.ndarray:
+    """Batched verify-attention reference: vmap over slots of the dense
+    per-slot gather + candidate overlay + causal mask — the same math as
+    attention_prefix_chunk's fallback with start = lengths[s] and every
+    candidate row valid. Pools may be one layer [P, ps, KVH, D] or the
+    full [L, P, ps, KVH, D] stack with `layer` selecting (pass from
+    inside a layer scan). Returns [S, T, H, D]."""
+    s, t, h, d = q.shape
+    kvh = k_pages.shape[-2]
+    g = h // kvh
+    scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32))
+    w = jnp.asarray(window, jnp.int32)
+    if k_pages.ndim == 5:
+        li = jnp.int32(0) if layer is None else layer
+        k_pages = jax.lax.dynamic_index_in_dim(k_pages, li, keepdims=False)
+        v_pages = jax.lax.dynamic_index_in_dim(v_pages, li, keepdims=False)
+
+    def one_slot(qi, row, start, kc, vc):
+        ks, vs = gather_kv(k_pages, v_pages, row, page_size)  # [N, KVH, D]
+        # overlay the candidates at absolute positions [start, start+T):
+        # pad by T rows so the update stays in bounds at the capacity
+        # edge (padded rows are sliced off again; the out-of-capacity
+        # case is a finished slot whose output is discarded)
+        pad = jnp.zeros((t, kvh, ks.shape[-1]), ks.dtype)
+        n = ks.shape[0]
+        ks = jax.lax.dynamic_update_slice(
+            jnp.concatenate([ks, pad]), kc.astype(ks.dtype), (start, 0, 0)
+        )[:n]
+        vs = jax.lax.dynamic_update_slice(
+            jnp.concatenate([vs, pad]), vc.astype(vs.dtype), (start, 0, 0)
+        )[:n]
+        qf = qi.astype(jnp.float32).reshape(t, kvh, g, d)
+        q_pos = start + jnp.arange(t)
+        k_pos = jnp.arange(n)
+        total = start + t
+        dist = q_pos[:, None] - k_pos[None, :]
+        mask = (
+            (dist >= 0) & ((w <= 0) | (dist < w))
+            & (k_pos[None, :] < total)
+        )
+        logits = jnp.einsum(
+            "tkgd,nkd->kgtn", qf, ks.astype(jnp.float32),
+            precision=jax.lax.Precision.HIGHEST,
+        ) * scale
+        logits = _softcap(logits, logit_softcap)
+        logits = jnp.where(mask[None, None], logits, _NEG_INF)
+        probs = jnp.exp(logits - logits.max(axis=-1, keepdims=True))
+        probs = probs / probs.sum(axis=-1, keepdims=True)
+        out = jnp.einsum(
+            "kgtn,nkd->tkgd", probs, vs.astype(jnp.float32),
+            precision=jax.lax.Precision.HIGHEST,
+        )
+        return out.reshape(t, h, d)
+
+    out = jax.vmap(one_slot)(q, page_table, lengths, k_cur, v_cur)
+    return out.astype(q.dtype)
 
 
 def _softcap(logits: jnp.ndarray, cap: float) -> jnp.ndarray:

@@ -381,6 +381,96 @@ def write_decode_all(
     return k_pages, v_pages
 
 
+def write_multi_all(
+    k_pages: jnp.ndarray,
+    v_pages: jnp.ndarray,
+    k_new: jnp.ndarray,
+    v_new: jnp.ndarray,
+    page_table: jnp.ndarray,
+    positions: jnp.ndarray,
+    active: jnp.ndarray,
+    page_size: int,
+    use_pallas: bool | None = None,
+    mesh=None,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Multi-token append: write T consecutive tokens per slot across ALL
+    layers at once (the speculative-verify KV write, ISSUE 5).
+
+    k_pages/v_pages: [L, P, ps, KVH, D]; k_new/v_new: [L, S, T, KVH, D];
+    positions: [S, T] absolute write position per (slot, candidate);
+    active: [S] bool — inactive slots are dropped entirely. Every hazard
+    (inactive slot, past-capacity position, unmapped page) masks to the
+    out-of-bounds sentinel exactly like write_decode_all.
+
+    The write is OPTIMISTIC: all T candidate rows land in the pool before
+    accept/reject is known. Rejected rows are dropped afterwards by
+    rollback_to_length — pure length bookkeeping, no data movement.
+
+    Kernel path: the (slot, candidate) pairs flatten to S*T independent
+    rows, which is exactly paged_write_decode's contract (one [KVH, D]
+    row per destination, destinations never colliding — positions within
+    a slot are consecutive and distinct, pages are slot-exclusive).
+    """
+    k_new, v_new = _pad_new_lanes(k_pages, k_new, v_new)
+    n_layers, s, t = k_new.shape[:3]
+    pos = positions.reshape(-1)
+    slot_of = jnp.repeat(jnp.arange(page_table.shape[0], dtype=jnp.int32), t)
+    page_idx = _safe_page_idx(
+        lambda p: page_table[slot_of, p], pos, jnp.repeat(active, t),
+        page_size, page_table.shape[1], k_pages.shape[1],
+    )
+    offset = pos % page_size
+    k_flat = k_new.reshape(n_layers, s * t, *k_new.shape[3:])
+    v_flat = v_new.reshape(n_layers, s * t, *v_new.shape[3:])
+    use, interpret = _pallas_mode(use_pallas)
+    mode, ax = kernel_mesh_axis(mesh, k_new.shape[3])
+    if use and mode != "ref" and (interpret or k_pages.shape[-1] % 128 == 0):
+        from gridllm_tpu.ops.pallas_kernels import paged_write_decode
+
+        record_kernel_path("write_multi", True)
+        kernel = partial(paged_write_decode, interpret=interpret)
+        if mode == "wrap":
+            from jax.sharding import PartitionSpec as P
+
+            kernel = _wrap_write_kernel(mesh, ax, kernel,
+                                        (P(None), P(None)))
+        return kernel(k_pages, v_pages, k_flat, v_flat, page_idx, offset)
+    record_kernel_path("write_multi", False)
+    k_pages = k_pages.at[:, page_idx, offset].set(k_flat, mode="drop")
+    v_pages = v_pages.at[:, page_idx, offset].set(v_flat, mode="drop")
+    return k_pages, v_pages
+
+
+def rollback_to_length(cache: PagedKVCache,
+                       new_lengths: jnp.ndarray) -> PagedKVCache:
+    """Truncate each slot's valid KV to `new_lengths` — the speculative
+    ROLLBACK (ISSUE 5): after a verify step optimistically wrote K+1
+    candidate rows (write_multi_all), the accepted length is committed
+    here and every rejected row is dropped.
+
+    Dropping is pure bookkeeping, exact by the pool's own invariants:
+
+    - reads: every attention path masks keys at k_pos >= lengths[slot]
+      (plus the in-register overlay), so rolled-back rows are invisible —
+      the same mechanism that guards stale data in owned-but-unwritten
+      page tails;
+    - writes: the next decode/verify step writes at the committed
+      lengths, overwriting the junk rows in place;
+    - prefix cache (PR 3): verify writes only touch positions >= the
+      slot's prompt length, strictly past any refcount-shared prefix page
+      (shared pages are fully covered by prompt-minus-last-token), so a
+      rollback can never corrupt — or expose junk through — a page another
+      request shares. Host-side page ownership is untouched: pages are
+      allocated to slot capacity at admission and registered for reuse
+      only from the final HOST-visible context (engine._finish), which
+      never includes rolled-back tokens.
+    """
+    return PagedKVCache(
+        k=cache.k, v=cache.v, page_table=cache.page_table,
+        lengths=new_lengths, page_size=cache.page_size,
+    )
+
+
 def write_prefill_all(
     k_pages: jnp.ndarray,
     v_pages: jnp.ndarray,

@@ -69,17 +69,18 @@ def _slot_gumbel(seed: jnp.ndarray, step: jnp.ndarray, k: int) -> jnp.ndarray:
     return jax.random.gumbel(key, (k,), jnp.float32)
 
 
-def sample_tokens(
+def _sampler_dists(
     logits: jnp.ndarray,
     params: SamplingParams,
-    token_counts: jnp.ndarray | None = None,
-) -> jnp.ndarray:
-    """Sample one token per slot. logits: [S, V] → [S] int32.
-
-    token_counts ([S, V] int32, optional): occurrence counts of tokens in
-    each slot's context, for repeat_penalty (CTRL-style: positive logits
-    divided, negative multiplied).
-    """
+    token_counts: jnp.ndarray | None,
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """The shared sampler chain: repeat penalty → top-K extraction →
+    truncation masks → temperature. Returns (greedy [S], idx [S, topk],
+    keep [S, topk], scaled [S, topk]) where the effective sampling
+    distribution is softmax(scaled) restricted to `keep`, over the token
+    ids in `idx`. sample_tokens and spec_accept (the speculative
+    accept/reject kernel) both build on this so the verified target
+    distribution is EXACTLY the one the plain decode path samples from."""
     logits = logits.astype(jnp.float32)
 
     if token_counts is not None:
@@ -110,11 +111,152 @@ def sample_tokens(
 
     temp = jnp.maximum(params.temperature, 1e-6)[:, None]
     scaled = vals / temp
+    return greedy, idx, keep, scaled
+
+
+def sample_tokens(
+    logits: jnp.ndarray,
+    params: SamplingParams,
+    token_counts: jnp.ndarray | None = None,
+) -> jnp.ndarray:
+    """Sample one token per slot. logits: [S, V] → [S] int32.
+
+    token_counts ([S, V] int32, optional): occurrence counts of tokens in
+    each slot's context, for repeat_penalty (CTRL-style: positive logits
+    divided, negative multiplied).
+    """
+    greedy, idx, keep, scaled = _sampler_dists(logits, params, token_counts)
+    topk = idx.shape[-1]
     gumbel = jax.vmap(lambda s, t: _slot_gumbel(s, t, topk))(params.seed, params.step)
     choice = jnp.argmax(jnp.where(keep, scaled + gumbel, -jnp.inf), axis=-1)
     sampled = jnp.take_along_axis(idx, choice[:, None], axis=-1)[:, 0].astype(jnp.int32)
 
     return jnp.where(params.temperature <= 0.0, greedy, sampled)
+
+
+# ---------------------------------------------------------------------------
+# speculative decoding: batched accept/reject over a candidate block
+# ---------------------------------------------------------------------------
+
+
+def _spec_keys(seed: jnp.ndarray, step: jnp.ndarray, topk: int):
+    """Per-slot (uniform, gumbel[topk]) draws for one emitted-token index.
+    Derived from the SAME (seed, step) chain sample_tokens uses, but
+    sub-folded — the spec path needs two draws per emitted token (accept
+    test + fallback sample), so sampled spec-on streams are deterministic
+    per (seed, step) yet not bit-equal to spec-off (the target
+    DISTRIBUTION is preserved exactly; only greedy streams are
+    byte-identical, which is the documented contract)."""
+    key = jax.random.fold_in(jax.random.PRNGKey(seed), step)
+    u = jax.random.uniform(jax.random.fold_in(key, 1), (), jnp.float32)
+    g = jax.random.gumbel(jax.random.fold_in(key, 2), (topk,), jnp.float32)
+    return u, g
+
+
+def spec_accept(
+    logits: jnp.ndarray,      # [S, K1, V] fp32 — verify-forward logits
+    candidates: jnp.ndarray,  # [S, K1] — col 0 = committed last token,
+                              # cols 1..K1-1 = drafted candidates
+    dlen: jnp.ndarray,        # [S] i32 — valid drafts per slot (0..K1-1)
+    params: SamplingParams,
+    counts: jnp.ndarray,      # [S, V] i32 repeat-penalty counts
+    window: jnp.ndarray,      # [S, W] i32 repeat-penalty window
+    wlen: jnp.ndarray,        # [S] i32
+    active: jnp.ndarray,      # [S] bool
+    vocab: int,
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray,
+           jnp.ndarray, SamplingParams]:
+    """Keep the longest accepted candidate prefix plus one corrected token.
+
+    logits[s, j] is the model's next-token distribution AFTER consuming
+    candidates[s, :j+1]; the scan below walks j = 0..K1-1, at each step
+    emitting exactly one token for every still-"alive" slot:
+
+    - greedy (temperature <= 0): the emitted token is argmax of the
+      penalized logits — identical to the sequential decode path — and the
+      slot stays alive iff the next draft equals it. Greedy spec-on
+      streams are therefore byte-identical to spec-off.
+    - sampled: exact rejection sampling against the n-gram drafter's
+      point-mass proposal q = δ(draft): accept the draft with probability
+      p(draft) under the FULL truncated/penalized/temperature-scaled
+      target distribution; on rejection, sample the corrected token from
+      the target with the draft masked out (the normalized residual
+      max(p - q, 0)). This preserves the target distribution exactly.
+    - once a draft is rejected (or drafts run out), the step emits its
+      corrected/bonus token and the slot leaves the span.
+
+    Repeat-penalty bookkeeping runs INSIDE the scan via the same
+    window_push the decode block uses, so counts/window evolve exactly as
+    a sequential run's would — position j's distribution sees every token
+    emitted at positions < j. params.step advances by the true number of
+    emitted tokens per slot (n_emit), keeping the (seed, step) rng chain
+    aligned with the emitted stream.
+
+    Returns (out [K1, S] emitted tokens — row j valid iff j < n_emit[s];
+    n_emit [S] in [1, K1] for active slots, 0 for inactive; new_tokens [S]
+    — the last emitted token per slot, the next block's input; counts;
+    window; wlen; params with step advanced)."""
+    s, k1, _ = logits.shape
+    topk = min(TOPK, logits.shape[-1])
+    greedy_mode = params.temperature <= 0.0
+    # draft checked at scan step j is candidates[:, j+1]; the last step
+    # never has one (bonus-token position)
+    drafts_next = jnp.concatenate(
+        [candidates[:, 1:], jnp.zeros((s, 1), candidates.dtype)], axis=1
+    )
+
+    def body(carry, j):
+        counts, window, wlen, emitted, alive = carry
+        lg = jax.lax.dynamic_index_in_dim(logits, j, axis=1, keepdims=False)
+        greedy, idx, keep, scaled = _sampler_dists(lg, params, counts)
+        d = jax.lax.dynamic_index_in_dim(
+            drafts_next, j, axis=1, keepdims=False
+        ).astype(jnp.int32)
+        has_draft = j < dlen
+
+        # -- sampled path: rejection sampling vs the point-mass proposal
+        u, gum = jax.vmap(lambda sd, st: _spec_keys(sd, st, topk))(
+            params.seed, params.step + emitted
+        )
+        probs = jax.nn.softmax(jnp.where(keep, scaled, -jnp.inf), axis=-1)
+        is_d = keep & (idx == d[:, None])
+        p_d = jnp.sum(jnp.where(is_d, probs, 0.0), axis=-1)
+        # fallback (residual) sample: target with the rejected draft masked
+        fb_keep = keep & ~(has_draft[:, None] & is_d)
+        any_fb = jnp.any(fb_keep, axis=-1)
+        choice = jnp.argmax(jnp.where(fb_keep, scaled + gum, -jnp.inf), axis=-1)
+        fallback = jnp.take_along_axis(
+            idx, choice[:, None], axis=-1
+        )[:, 0].astype(jnp.int32)
+        # ~any_fb: the draft is the ONLY kept token, so p(draft) = 1 and a
+        # float-rounding reject would have nothing to fall back on
+        s_acc = has_draft & ((u < p_d) | ~any_fb)
+        s_tok = jnp.where(s_acc, d, fallback)
+
+        # -- greedy path: emitted token is the argmax either way
+        g_acc = has_draft & (d == greedy)
+
+        tok = jnp.where(greedy_mode, greedy, s_tok)
+        acc = jnp.where(greedy_mode, g_acc, s_acc)
+        emit = alive & active
+        window, wlen, counts = window_push(
+            window, wlen, counts, tok, emit, params.repeat_last_n, vocab
+        )
+        emitted = emitted + emit.astype(jnp.int32)
+        alive = alive & acc
+        return (counts, window, wlen, emitted, alive), jnp.where(emit, tok, 0)
+
+    init = (counts, window, wlen, jnp.zeros((s,), jnp.int32),
+            jnp.ones((s,), bool))
+    (counts, window, wlen, n_emit, _), out = jax.lax.scan(
+        body, init, jnp.arange(k1, dtype=jnp.int32)
+    )
+    # last emitted token per slot = the next block's input token
+    last = jnp.take_along_axis(
+        out.T, jnp.maximum(n_emit - 1, 0)[:, None], axis=1
+    )[:, 0]
+    params = dataclasses.replace(params, step=params.step + n_emit)
+    return out, n_emit, last, counts, window, wlen, params
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,95 @@
+"""Speculative-decoding drafters (ISSUE 5).
+
+A drafter proposes up to K candidate continuation tokens for one slot from
+host-visible state (the slot's full token history, prompt + generated).
+The engine verifies all K in ONE batched model forward (llama.verify_step)
+and keeps the longest accepted prefix plus one corrected token — so a
+drafter never affects *what* is generated, only how many model forwards it
+takes (greedy streams are byte-identical spec-on vs spec-off; sampled
+streams keep the rejection-sampled target distribution, ops/sampling.py
+spec_accept).
+
+Phase 1 is model-free **prompt-lookup / n-gram drafting** (arXiv:2304.04487
+-class): match the last n tokens of the slot's history against the earlier
+history (prompt included) and propose the continuation that followed the
+most recent occurrence. It costs no extra checkpoint, runs on CPU tier-1,
+and wins exactly where decode is most wasteful — repetitive/templated
+output (code edits, extraction, "repeat the policy clause" workloads),
+where acceptance routinely exceeds 50%. On novel text it degrades to
+proposing nothing, which the engine handles as a plain decode step.
+
+The interface is deliberately tiny so a small draft *model* can land later
+as another Drafter implementation without touching the engine: the engine
+only ever calls `draft(ids, k)` per slot between verify steps.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Protocol, Sequence
+
+
+class Drafter(Protocol):
+    """One method: propose up to k likely next tokens for a slot."""
+
+    def draft(self, ids: Sequence[int], k: int) -> list[int]:
+        """ids: the slot's full context so far (prompt + generated, oldest
+        first; the LAST element is the most recent emitted token). Returns
+        0..k proposed continuation tokens — an empty list means "no
+        proposal", which the engine runs as a normal decode step."""
+        ...
+
+
+class NgramDrafter:
+    """Prompt-lookup drafting: longest-suffix n-gram match over the slot's
+    own history.
+
+    For n from `max_n` down to `min_n`, find the most recent earlier
+    occurrence of the history's last-n tokens and propose the tokens that
+    followed it. Longest match first — a longer matched context is a
+    stronger predictor, and the first hit wins (most recent occurrence, the
+    llama.cpp/vLLM prompt-lookup convention).
+
+    `lookback` bounds how far back the scan walks (0 = the whole history);
+    worst case is O(max_n × min(len, lookback)) per call, a few µs at chat
+    context lengths — noise next to a model forward.
+    """
+
+    def __init__(self, max_n: int = 4, min_n: int = 1, lookback: int = 0):
+        if min_n < 1 or max_n < min_n:
+            raise ValueError(f"bad n-gram range [{min_n}, {max_n}]")
+        self.max_n = max_n
+        self.min_n = min_n
+        self.lookback = max(lookback, 0)
+
+    def draft(self, ids: Sequence[int], k: int) -> list[int]:
+        ids = list(ids)
+        n_ids = len(ids)
+        if k <= 0 or n_ids < self.min_n + 1:
+            return []
+        lo = 0 if not self.lookback else max(n_ids - self.lookback, 0)
+        for n in range(min(self.max_n, n_ids - 1), self.min_n - 1, -1):
+            suffix = ids[n_ids - n:]
+            # most recent occurrence strictly before the suffix itself
+            for i in range(n_ids - n - 1, lo - 1, -1):
+                if ids[i : i + n] == suffix:
+                    cont = ids[i + n : i + n + k]
+                    if cont:
+                        return cont
+                    break  # suffix only recurs at the very end — shorter n
+        return []
+
+
+def make_drafter(kind: str | None = None) -> Drafter:
+    """Drafter factory (env-pluggable): GRIDLLM_SPEC_DRAFTER selects the
+    implementation ("ngram" is the only phase-1 option; a draft-model
+    drafter slots in here later), GRIDLLM_SPEC_NGRAM_MAX / _MIN /
+    GRIDLLM_SPEC_LOOKBACK tune the n-gram matcher."""
+    kind = kind or os.environ.get("GRIDLLM_SPEC_DRAFTER", "ngram")
+    if kind == "ngram":
+        return NgramDrafter(
+            max_n=int(os.environ.get("GRIDLLM_SPEC_NGRAM_MAX", "4")),
+            min_n=int(os.environ.get("GRIDLLM_SPEC_NGRAM_MIN", "1")),
+            lookback=int(os.environ.get("GRIDLLM_SPEC_LOOKBACK", "0")),
+        )
+    raise ValueError(f"unknown drafter: {kind!r}")

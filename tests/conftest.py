@@ -25,6 +25,25 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# Persistent XLA compilation cache: the suite compiles the SAME tiny-model
+# programs (prefill buckets, decode block, verify block, embed) in nearly
+# every test process; on the CPU-share-constrained CI/verify box those
+# repeat compiles are a large slice of the tier-1 wall clock. The cache is
+# keyed by HLO hash (donation/aliasing included), so behavior is
+# unchanged — and the jit TRIPWIRE (obs/perf.py) counts python-side
+# signatures, not XLA compiles, so its tests are unaffected. Guarded:
+# older jaxlibs without CPU cache support just skip it.
+try:
+    import tempfile as _tempfile
+
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        os.path.join(_tempfile.gettempdir(), "gridllm-test-xla-cache"),
+    )
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+except Exception:  # noqa: BLE001 — cache is an optimization only
+    pass
+
 import asyncio  # noqa: E402
 
 import pytest  # noqa: E402

@@ -99,9 +99,16 @@ def test_unseen_shape_bucket_counts_exactly_one(engine):
 def test_static_arg_change_classified_new_static(engine):
     """decode_block's fused step count k is a static jit arg — a never-
     seen k recompiles with reason new_static, not new_shape."""
+    # baseline signature first: a spec-on engine (ISSUE 5 default) serves
+    # via the verify program and never compiles decode_block during
+    # warmup, and a probe's very FIRST signature always classifies as
+    # warmup — so establish k=1 (a no-op when spec is off: the runner
+    # already compiled it) before probing the static change
+    engine._dispatch_block(1)
+    engine._inflight.clear()   # no slots are active; tokens are junk
     before = RECOMPILES_TOTAL.value(fn="decode_block", reason="new_static")
     engine._dispatch_block(3)  # k=3 never dispatched by these tests
-    engine._inflight.clear()   # no slots are active; tokens are junk
+    engine._inflight.clear()
     assert RECOMPILES_TOTAL.value(
         fn="decode_block", reason="new_static") == before + 1
 
