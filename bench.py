@@ -599,6 +599,142 @@ async def run_spec_bench(model: str, n_requests: int, n_tokens: int,
     }
 
 
+async def run_mixed_bench(model: str, n_requests: int, n_tokens: int,
+                          max_slots: int, long_prompt_len: int) -> dict:
+    """Mixed-workload scenario (ISSUE 6): decode-heavy streams running
+    CONCURRENTLY with long chunked prefills — the traffic shape the
+    unified ragged paged-attention kernel exists for. Half the load is
+    short-prompt/long-decode streams (ITL is their number), half is
+    long-prompt/short-decode requests arriving while the others are
+    mid-generation (TTFT is theirs). Under the ragged engine each prefill
+    chunk and the running decodes share one launch, so the decode arm's
+    ITL should NOT degrade while prefills churn; `--compare` gates both
+    p50 ITL and p50 TTFT (plus tok/s) against a previous record."""
+    import os
+
+    import aiohttp
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from gridllm_tpu.engine import EngineConfig, InferenceEngine
+    from gridllm_tpu.worker.main import resolve_checkpoint
+
+    ckpt, tok = resolve_checkpoint(
+        os.environ.get("GRIDLLM_CHECKPOINT_DIR"), model
+    )
+    tiny = model.startswith("tiny")
+    engine = InferenceEngine(EngineConfig(
+        model=model,
+        checkpoint_path=ckpt,
+        tokenizer=tok,
+        max_slots=max_slots,
+        page_size=64,
+        num_pages=max(384, max_slots * 64),
+        max_pages_per_slot=8 if tiny else 48,
+        prefill_buckets=(64, 256, 1024),
+        # long prompts MUST take the chunked path — that is the mixed
+        # step under test (tiny CPU models cap context at 512 tokens)
+        prefill_chunk=64 if tiny else 512,
+    ))
+    bus, registry, scheduler, app, worker = await _build_stack(
+        engine, model, trace_capacity=n_requests * 4 + 16)
+    client = None
+    try:
+        await worker.start()
+        await asyncio.sleep(0.1)
+        client = TestClient(TestServer(app))
+        await client.start_server()
+
+        filler = "the quick brown fox jumps over the lazy dog; "
+        long_prompt = (filler * 200)[:long_prompt_len]
+        short_prompt = "summarize: " + filler
+
+        # warmup compiles every program both arms need: a long (chunked)
+        # prefill AND a short (bucketed) one, plus decode. The warmup
+        # prompts use the SAME "[X0] " tag shape as the measured ones so
+        # they land in the same prefill buckets — a one-character length
+        # difference can cross a bucket edge and put a first-compile
+        # inside the measured window
+        for p in (f"[W0] {long_prompt}", f"[W0] {short_prompt}"):
+            warm = await client.post("/ollama/api/generate", json={
+                "model": model, "prompt": p, "stream": False,
+                "options": {"temperature": 0, "num_predict": 4},
+            }, timeout=aiohttp.ClientTimeout(total=240))
+            assert warm.status == 200, await warm.text()
+
+        decode_ttfts: list[float] = []
+        decode_itls: list[float] = []
+        prefill_ttfts: list[float] = []
+        tokens_out = [0]
+
+        async def one(prompt: str, n_predict: int, ttfts: list,
+                      itls: list | None, tag: str, i: int) -> None:
+            t0 = time.perf_counter()
+            t_first = t_last = None
+            async with client.post("/ollama/api/generate", json={
+                "model": model, "prompt": f"[{tag}{i}] {prompt}",
+                "options": {"temperature": 0, "seed": i,
+                            "num_predict": n_predict},
+            }) as resp:
+                assert resp.status == 200, await resp.text()
+                async for line in resp.content:
+                    if not line.strip():
+                        continue
+                    now = time.perf_counter()
+                    if t_first is None:
+                        t_first = now
+                        ttfts.append(now - t0)
+                    t_last = now
+                    frame = json.loads(line)
+                    if frame.get("done"):
+                        n = frame.get("eval_count") or 0
+                        tokens_out[0] += n
+                        if itls is not None and n > 1 and t_first is not None:
+                            itls.append((t_last - t_first) / (n - 1) * 1000)
+
+        async def long_arm(i: int) -> None:
+            # arrive mid-decode: the prefill chunks must share steps with
+            # running streams, not an idle engine
+            await asyncio.sleep(0.2 * (i + 1))
+            await one(long_prompt, 4, prefill_ttfts, None, "L", i)
+
+        # main() clamps --mixed to >= 2 requests, so both arms get >= 1
+        # stream and the total matches the record's request count
+        n_decode = max(n_requests // 2, 1)
+        n_long = max(n_requests - n_decode, 1)
+        t0 = time.perf_counter()
+        await asyncio.gather(
+            *(one(short_prompt, n_tokens, decode_ttfts, decode_itls,
+                  "D", i) for i in range(n_decode)),
+            *(long_arm(i) for i in range(n_long)),
+        )
+        wall = time.perf_counter() - t0
+        return {
+            "tok_s": tokens_out[0] / wall,
+            "p50_ttft_ms": (statistics.median(prefill_ttfts) * 1000
+                            if prefill_ttfts else None),
+            "p50_itl_ms": (statistics.median(decode_itls)
+                           if decode_itls else None),
+            "p95_ttft_ms": (None if _p95(prefill_ttfts) is None
+                            else _p95(prefill_ttfts) * 1000),
+            "tokens": tokens_out[0],
+            "wall_s": wall,
+            "mixed": {
+                "decode_streams": n_decode,
+                "long_prefills": n_long,
+                "long_prompt_chars": len(long_prompt),
+                "p50_decode_ttft_ms": (
+                    statistics.median(decode_ttfts) * 1000
+                    if decode_ttfts else None),
+            },
+            "perf": _perf_sidecar(),
+            "weights": "real-checkpoint" if ckpt
+            else "random-weights synthetic",
+        }
+    finally:
+        await _teardown_stack(bus, registry, scheduler, worker,
+                              client=client)
+
+
 async def run_embed_bench(model: str, n_requests: int,
                           batch: int = 64, rounds: int = 8) -> dict:
     """Embeddings QPS through the full stack (BASELINE config #5):
@@ -799,6 +935,15 @@ def main() -> int:
                          "(ISSUE 5)")
     ap.add_argument("--spec-k", type=int, default=4,
                     help="speculation depth K for the --spec scenario")
+    ap.add_argument("--mixed", action="store_true",
+                    help="mixed-workload scenario: decode-heavy streams "
+                         "concurrent with long chunked prefills; reports "
+                         "the decode arm's p50 ITL and the prefill arm's "
+                         "p50 TTFT — the ragged paged-attention gate "
+                         "(ISSUE 6)")
+    ap.add_argument("--long-prompt-len", type=int, default=2400,
+                    help="long-prefill prompt length in characters "
+                         "(--mixed only)")
     ap.add_argument("--tiny", action="store_true",
                     help="tiny-llama CPU smoke test")
     ap.add_argument("--profile", metavar="DIR", default=None,
@@ -825,6 +970,13 @@ def main() -> int:
     if args.spec and (args.embed or args.shared_prefix):
         ap.error("--spec is its own generate scenario; drop "
                  "--embed/--shared-prefix")
+    if args.mixed and (args.embed or args.shared_prefix or args.spec):
+        ap.error("--mixed is its own generate scenario; drop "
+                 "--embed/--shared-prefix/--spec")
+    if args.mixed:
+        # the scenario needs at least one stream per arm — clamp HERE so
+        # the emitted record's request count matches the load actually run
+        args.requests = max(args.requests, 2)
 
     # structured run health (ISSUE 2 satellite — replaces the ||-joined
     # error string): `attempts` logs every stage that failed along the way,
@@ -857,11 +1009,15 @@ def main() -> int:
         args.model = "tiny-bert" if args.embed else "tiny-llama"
         # the spec scenario needs enough decode steps for the output to
         # enter its repetitive regime before acceptance can show
-        args.tokens = min(args.tokens, 48 if args.spec else 16)
+        args.tokens = min(args.tokens, 48 if (args.spec or args.mixed)
+                          else 16)
         args.prompt_len = 20
         # the shared prefix must still span several KV pages (64-token
         # pages, byte tokenizer) or there is nothing to cache
         args.prefix_len = min(args.prefix_len, 800)
+        # tiny models cap context at 512 tokens (byte tokenizer): the
+        # long arm must still span several 64-token chunks
+        args.long_prompt_len = min(args.long_prompt_len, 320)
         args.requests = min(args.requests, 4)
         if not args.tiny:
             # flag the substitution even when the CPU probe itself was
@@ -908,6 +1064,19 @@ def main() -> int:
                 f"({args.model}, speculative-decoding A/B, n-gram "
                 f"K={args.spec_k}, {args.requests} streams, repetitive "
                 f"workload, {r['weights']})"
+            )
+        elif args.mixed:
+            r = asyncio.run(run_mixed_bench(
+                args.model, args.requests, args.tokens, args.slots,
+                args.long_prompt_len,
+            ))
+            baseline = A100_OLLAMA_TOK_S.get(args.model, 0.0)
+            value, unit = r["tok_s"], "tok/s"
+            metric_name = (
+                f"mixed-workload output tokens/sec via /ollama/api/"
+                f"generate ({args.model}, decode streams concurrent with "
+                f"long chunked prefills, {args.requests} streams, "
+                f"{r['weights']})"
             )
         else:
             import os as _os
@@ -1031,6 +1200,16 @@ def main() -> int:
         payload["prefix_cache_hit_rate_cold"] = r["prefix_cache_hit_rate_cold"]
         payload["prefix_cache"] = r["prefix_cache"]
         payload["tokens"] = r["tokens"]
+    elif args.mixed:
+        # the mixed-workload headline: the decode arm's ITL must survive
+        # concurrent long prefills (single-launch mixed steps), and the
+        # prefill arm's TTFT shows the chunked path's pace under load
+        if r.get("p50_ttft_ms") is not None:
+            payload["p50_ttft_ms"] = round(r["p50_ttft_ms"], 1)
+        if r.get("p50_itl_ms") is not None:
+            payload["p50_itl_ms"] = round(r["p50_itl_ms"], 2)
+        payload["mixed"] = r["mixed"]
+        payload["tokens"] = r["tokens"]
     elif not args.embed:
         payload["p50_ttft_ms"] = round(r["p50_ttft_ms"], 1)
         if r.get("p50_itl_ms") is not None:
@@ -1059,7 +1238,8 @@ def main() -> int:
             payload["peak_hbm_bytes"] = perf_side["peak_hbm_bytes"]
     scenario = ("embed" if args.embed
                 else "shared-prefix" if args.shared_prefix
-                else "spec" if args.spec else "generate")
+                else "spec" if args.spec
+                else "mixed" if args.mixed else "generate")
     record = build_record(scenario, args, payload, r)
     regressions: list = []
     if args.compare:

@@ -73,6 +73,7 @@ from gridllm_tpu.obs.perf import (
     HOST_SCHED_SECONDS,
     RecompileTripwire,
 )
+from gridllm_tpu.ops.attention import ragged_attention_enabled
 from gridllm_tpu.ops.kvcache import (
     PagedKVCache,
     PageAllocator,
@@ -368,6 +369,10 @@ class InferenceEngine:
             # single-device engines keep their kernels.
             self.cfg = dataclasses.replace(self.cfg, use_pallas=False)
         self._rng = random.Random(config.seed)
+        # ragged paged attention (ISSUE 6), resolved ONCE at startup: the
+        # pool layout (_pool_head_dim) and the admission path both depend
+        # on it, and flipping mid-serving would mix incompatible layouts
+        self._ragged = ragged_attention_enabled()
         # prefix-cache capacity, resolved ONCE (env reads at startup, not
         # per admission): 0 = off, < 0 = unbounded reuse LRU, > 0 = cap.
         # sp > 1 prefills whole prompts via ring attention — there is no
@@ -533,13 +538,26 @@ class InferenceEngine:
         keeps the model's dim (tests stay fast) unless GRIDLLM_POOL_PAD=1
         forces the padded layout for coverage. The ops dispatchers
         pad/slice at the boundary."""
-        from gridllm_tpu.ops.kvcache import _pallas_mode, lane_pad_dim
+        from gridllm_tpu.ops.kvcache import (
+            _pallas_mode,
+            flat_lanes_ok,
+            lane_pad_dim,
+            local_kv_heads,
+        )
 
         d = self.cfg.head_dim_
         use, interpret = _pallas_mode(self.cfg.use_pallas)
         if not use:
             return d
         if interpret and os.environ.get("GRIDLLM_POOL_PAD") != "1":
+            return d
+        kvh = local_kv_heads(self.cfg.num_kv_heads, self.mesh)
+        if self._ragged and flat_lanes_ok(kvh, d):
+            # ragged layout (ISSUE 6): page rows are lane-aligned viewed
+            # flat ([ps, KVH*D] — PER tp SHARD, where kv heads split), so
+            # the ragged kernel and the DMA write kernels run on the
+            # UNPADDED pool — the lane-pad KV-byte overhead /admin/memory
+            # itemized drops to zero
             return d
         return lane_pad_dim(d)
 
@@ -700,6 +718,63 @@ class InferenceEngine:
             )
             return cache, counts, window, wlen, tokens, active, sp
 
+        # Ragged mixed step (ISSUE 6): ONE forward serving the admitting
+        # slot's prefill chunk AND a decode token for every active slot —
+        # a mixed prefill+decode step is a single attention launch per
+        # layer, so long chunked prefills no longer stall running streams
+        # between decode blocks. Bookkeeping is the union of
+        # prefill_chunk_fn's (chunk slot rows) and decode_block_fn's
+        # (active slot rows) — per-slot state rows are disjoint, so each
+        # region's updates are bit-identical to the legacy programs'.
+        # Returns a [2, S] block (row 0 = input tokens, row 1 = this
+        # step's decode samples) that rides the normal ingest protocol.
+        @partial(jax.jit, donate_argnums=(2, 3, 4, 5, 6, 7, 8))
+        def mixed_chunk_fn(params, chunk, cache, counts, window, wlen,
+                           tokens, active, sp, start, length, slot,
+                           table_row, is_final, embeds=None):
+            tokens_in = tokens
+            active_in = active
+            chunk_logits, dec_logits, cache = mod.mixed_step(
+                params, mc, chunk, start, length, slot, table_row, tokens,
+                cache, active, mesh=self.mesh, embeds=embeds,
+            )
+            # chunk-slot bookkeeping (exactly prefill_chunk_fn's)
+            rl = sp.repeat_last_n[slot]
+            window, wlen, counts = window_set_slot(
+                window, wlen, counts, slot, chunk, start, length,
+                rl, mc.vocab_size,
+            )
+            tok = sample_tokens(
+                chunk_logits[None], _gather_sp(sp, slot), counts[slot][None]
+            )[0]
+            tokens = tokens.at[slot].set(
+                jnp.where(is_final, tok, tokens[slot])
+            )
+            one = jnp.zeros_like(active).at[slot].set(is_final)
+            window, wlen, counts = window_push(
+                window, wlen, counts, tokens, one, sp.repeat_last_n,
+                mc.vocab_size,
+            )
+            active = active.at[slot].set(is_final | active[slot])
+            sp = dataclasses.replace(
+                sp, step=sp.step.at[slot].set(
+                    jnp.where(is_final, 1, sp.step[slot])
+                )
+            )
+            # decode bookkeeping for the slots that were active at entry
+            # (exactly decode_block_fn's body, k = 1)
+            sampled = sample_tokens(dec_logits, sp, counts)
+            tokens = jnp.where(active_in, sampled, tokens)
+            window, wlen, counts = window_push(
+                window, wlen, counts, tokens, active_in, sp.repeat_last_n,
+                mc.vocab_size,
+            )
+            sp = dataclasses.replace(
+                sp, step=sp.step + active_in.astype(jnp.int32)
+            )
+            out = jnp.stack([tokens_in, tokens])  # [2, S]
+            return out, cache, counts, window, wlen, tokens, active, sp
+
         # One decode block: k fused (model step + sample + bookkeeping)
         # iterations under lax.scan. Returns [k+1, S] tokens — row 0 is the
         # block's INPUT tokens (a newly admitted slot's prefill sample),
@@ -775,6 +850,16 @@ class InferenceEngine:
         # ring attention (sp) runs whole-prompt prefill; the chunked path
         # reads the paged prefix instead and has no sp variant yet
         self._use_chunked = attn is None
+        # ragged mixed steps need the chunked path AND a family mixed_step
+        # (parallel/pipeline.py has no mixed schedule — pp engines keep
+        # the legacy per-chunk dispatch even with ragged attention on)
+        self._use_mixed = (
+            self._ragged and self._use_chunked and hasattr(mod, "mixed_step")
+        )
+        if self._use_mixed:
+            self._mixed_chunk_fn = self.perf.wrap(
+                "mixed_chunk", mixed_chunk_fn, armable=text_only
+            )
         ps = self.config.page_size
         # page-aligned chunking: the in-place page-write kernel requires
         # chunk starts at page boundaries
@@ -1120,6 +1205,17 @@ class InferenceEngine:
                     embeds = self._splice_fn(
                         self.params, padded, img_flat, jnp.int32(off)
                     )
+                if self._use_mixed:
+                    # ragged mixed step (ISSUE 6): this chunk AND one
+                    # decode token for every active slot share a single
+                    # launch — running streams keep generating while the
+                    # prompt prefills; the decode rows ride _inflight and
+                    # are ingested like any other block
+                    self._dispatch_mixed_chunk(
+                        padded, s0, len(part), slot, row,
+                        s0 + c >= len(ids), embeds,
+                    )
+                    continue
                 (self.cache, self.counts, self.window, self.wlen,
                  self.tokens, self.active, self.sampling) = (
                     self._prefill_chunk_fn(
@@ -1161,6 +1257,7 @@ class InferenceEngine:
                 images=list(rec.get("images") or []) or None,
                 cached=int(rec.get("cached", 0)),
             )
+            self._inflight.clear()  # ragged mixed blocks: replay never fetches
         elif op == "block":
             self._dispatch_block(int(rec["k"]))
             self._inflight.clear()  # replay never fetches
@@ -1302,6 +1399,40 @@ class InferenceEngine:
             if self.plan_sink is not None:  # after-success; see _try_admit
                 self.plan_sink({"op": "block", "k": k})
 
+    def _dispatch_mixed_chunk(self, padded, start: int, length: int,
+                              slot: int, row, is_final: bool,
+                              embeds) -> None:
+        """Dispatch one ragged mixed step (chunk + decode, ISSUE 6). Runs
+        under dispatch_lock (called from _dispatch_prefill). The [2, S]
+        decode-token block joins _inflight with its own generation —
+        fetched later by the normal block drains, no host sync here."""
+        self._gen += 1
+        t0 = time.perf_counter()
+        (out, self.cache, self.counts, self.window, self.wlen, self.tokens,
+         self.active, self.sampling) = self._mixed_chunk_fn(
+            self.params, padded, self.cache, self.counts, self.window,
+            self.wlen, self.tokens, self.active, self.sampling,
+            jnp.int32(start), jnp.int32(length), jnp.int32(slot), row,
+            jnp.bool_(is_final), embeds=embeds,
+        )
+        now = time.perf_counter()
+        DISPATCH_SECONDS.observe(now - t0, model=self.cfg.name)
+        self._inflight.append((self._gen, out, 1, now))
+
+    def _fetch_oldest(self) -> None:
+        """Fetch + ingest the oldest in-flight decode/mixed block — the
+        ONE copy of the block fetch protocol: step()'s sync path,
+        _pump_once's pipelined pop, and the admission-block drains all go
+        through here. Observes device pace and per-fused-step duration
+        (fetch+ingest wall over the block's step count)."""
+        gen, out, blk, t_disp = self._inflight.popleft()
+        t0 = time.perf_counter()
+        raw = np.asarray(jax.device_get(out))
+        self._observe_device_step(t_disp, blk)
+        self._ingest_block(gen, raw)
+        _STEP_DURATION.observe(
+            (time.perf_counter() - t0) / max(blk, 1), model=self.cfg.name)
+
     def _dispatch_verify(self, drafts: np.ndarray, dlen: np.ndarray) -> None:
         """Dispatch one speculative verify block: [S, K] host drafts (+
         per-slot valid count) against the device's committed last tokens.
@@ -1337,6 +1468,11 @@ class InferenceEngine:
         depend on this step's emitted tokens, so there is no block
         pipeline to hide the fetch behind; speculation pays that back by
         emitting up to K+1 tokens per fetch."""
+        while self._inflight:
+            # drain mixed admission blocks first: their decode tokens must
+            # be host-visible before drafting (and the verify fetch below
+            # assumes the queue head is its own dispatch)
+            self._fetch_oldest()
         k = self._spec_k
         drafts = np.zeros((self.config.max_slots, k), np.int32)
         dlen = np.zeros((self.config.max_slots,), np.int32)
@@ -1459,6 +1595,11 @@ class InferenceEngine:
         self._drain_ctl()
         while self._try_admit():
             pass
+        while self._inflight:
+            # ragged mixed admission steps enqueue [2, S] blocks; sync
+            # semantics = nothing left in flight before this step's own
+            # dispatch
+            self._fetch_oldest()
         if not self._slots:
             self._t_prev_fetch = None
             return bool(self._pending)
@@ -1466,12 +1607,7 @@ class InferenceEngine:
             self._step_spec()
             return True
         self._dispatch_block(1)
-        gen, out, blk, t_disp = self._inflight.popleft()
-        t0 = time.perf_counter()
-        raw = np.asarray(jax.device_get(out))
-        self._observe_device_step(t_disp, blk)
-        self._ingest_block(gen, raw)
-        _STEP_DURATION.observe(time.perf_counter() - t0, model=self.cfg.name)
+        self._fetch_oldest()
         return True
 
     def _observe_device_step(self, t_disp: float, k: int) -> None:
@@ -1607,16 +1743,11 @@ class InferenceEngine:
                 model=self.cfg.name)
         while len(self._inflight) < max(1, self.config.pipeline_depth):
             self._dispatch_block(k)
-        gen, out, blk, t_disp = self._inflight.popleft()
-        t0 = time.perf_counter()
-        raw = np.asarray(jax.device_get(out))
-        self._observe_device_step(t_disp, blk)
-        self._ingest_block(gen, raw)
-        # fetch+ingest wall time per fused step; in steady state the fetch
-        # of block N overlaps block N+1's compute, so this is the honest
-        # per-step pace the pipeline sustains
-        _STEP_DURATION.observe(
-            (time.perf_counter() - t0) / max(blk, 1), model=self.cfg.name)
+        # fetch+ingest wall time per fused step (observed inside
+        # _fetch_oldest); in steady state the fetch of block N overlaps
+        # block N+1's compute, so this is the honest per-step pace the
+        # pipeline sustains
+        self._fetch_oldest()
         self._t_ingest_done = time.perf_counter()
 
     # ---------------------------------------------------------- public API
@@ -1847,9 +1978,19 @@ class InferenceEngine:
             "cachedBytes": int(self.alloc.cached_pages * bpp),
             "freeBytes": int(self.alloc.free_pages * bpp),
             # lane padding multiplies KV bytes for d<128 models under the
-            # kernel path (_pool_head_dim) — this is that overhead's share
+            # kernel path (_pool_head_dim) — this is that overhead's share.
+            # Under the ragged flat-lane layout (kvLayout "ragged") the
+            # pool stays UNPADDED, so this reads 0 — the KV-bytes win of
+            # ISSUE 6, visible directly here
             "lanePadOverheadBytes": int(
                 kv_bytes * (1 - mc.head_dim_ / dpool)) if dpool else 0,
+            # "ragged" = unified attention on an unpadded pool (the zero-
+            # overhead case the README documents); "ragged-padded" =
+            # ragged attention but the shape can't go flat-lane (e.g.
+            # KVH=1, d=64), so the pool still pays the pad
+            "kvLayout": (
+                ("ragged" if dpool == mc.head_dim_ else "ragged-padded")
+                if self._ragged else "legacy"),
             "liveTokens": live_tokens,
             # internal fragmentation of the live allocation: capacity
             # reserved at admission (num_predict headroom + tail pages)

@@ -46,6 +46,8 @@ from gridllm_tpu.ops.attention import (
     attention_prefix_chunk,
     paged_attention_decode,
     paged_attention_verify,
+    ragged_attention_enabled,
+    ragged_paged_attention,
 )
 from gridllm_tpu.ops.kvcache import (
     PagedKVCache,
@@ -302,6 +304,15 @@ def prefill_chunk(
     total = start + length
 
     def attn_fn(q, k, v, win, li):
+        if ragged_attention_enabled():
+            att, _ = ragged_paged_attention(
+                cache.k, cache.v, cache.page_size,
+                q_chunk=q, chunk_row=table_row, chunk_start=start,
+                chunk_total=total, k_chunk=k[0], v_chunk=v[0], layer=li,
+                use_pallas=cfg.use_pallas,
+                logit_softcap=cfg.attn_logit_softcap, window=win, mesh=mesh,
+            )
+            return att.reshape(1, t, -1)
         return attention_prefix_chunk(
             q, cache.k, cache.v, table_row, start, total, cache.page_size,
             k_cur=k[0], v_cur=v[0], layer=li, use_pallas=cfg.use_pallas,
@@ -346,6 +357,15 @@ def decode_step(
     )
 
     def attn_fn(q, k, v, win, li):
+        if ragged_attention_enabled():
+            _, att = ragged_paged_attention(
+                cache.k, cache.v, cache.page_size,
+                q_group=q, page_table=cache.page_table,
+                group_lengths=positions, k_group=k, v_group=v, layer=li,
+                use_pallas=cfg.use_pallas,
+                logit_softcap=cfg.attn_logit_softcap, window=win, mesh=mesh,
+            )
+            return att.reshape(s, 1, -1)
         return paged_attention_decode(
             q[:, 0], cache.k, cache.v, cache.page_table, positions,
             cache.page_size, k_cur=k[:, 0], v_cur=v[:, 0], layer=li,
@@ -391,6 +411,14 @@ def verify_step(
     pos = base[:, None] + jnp.arange(t, dtype=jnp.int32)[None]
 
     def attn_fn(q, k, v, win, li):
+        if ragged_attention_enabled():
+            _, att = ragged_paged_attention(
+                cache.k, cache.v, cache.page_size,
+                q_group=q, page_table=cache.page_table, group_lengths=base,
+                k_group=k, v_group=v, layer=li, use_pallas=cfg.use_pallas,
+                logit_softcap=cfg.attn_logit_softcap, window=win, mesh=mesh,
+            )
+            return att.reshape(s, t, -1)
         return paged_attention_verify(
             q, cache.k, cache.v, cache.page_table, base, cache.page_size,
             k_cur=k, v_cur=v, layer=li, use_pallas=cfg.use_pallas,
@@ -408,6 +436,76 @@ def verify_step(
     return logits, PagedKVCache(
         k=k_pool, v=v_pool, page_table=cache.page_table,
         lengths=base, page_size=cache.page_size,
+    )
+
+
+def mixed_step(
+    params: Params,
+    cfg: ModelConfig,
+    chunk_tokens: jnp.ndarray,
+    chunk_start: jnp.ndarray,
+    chunk_len: jnp.ndarray,
+    slot: jnp.ndarray,
+    table_row: jnp.ndarray,
+    tokens: jnp.ndarray,
+    cache: PagedKVCache,
+    active: jnp.ndarray,
+    mlp=None,
+    mesh=None,
+    embeds: jnp.ndarray | None = None,
+) -> tuple[jnp.ndarray, jnp.ndarray, PagedKVCache]:
+    """Fused chunked-prefill + decode step (llama.mixed_step contract):
+    one ragged attention launch per layer serves the admitting slot's
+    chunk AND a decode token for every active slot. Softcap and the
+    per-layer sliding windows thread through exactly as in decode/verify.
+    Only called with ragged attention enabled (engine gates on it)."""
+    del mlp
+    c = chunk_tokens.shape[0]
+    s = tokens.shape[0]
+    xc = _embed_in(params, cfg, chunk_tokens, embeds)   # [C, E]
+    xg = _embed_in(params, cfg, tokens)                 # [S, E]
+    x = jnp.concatenate([xc, xg])[None]                 # [1, C+S, E]
+    positions = cache.lengths
+    total = chunk_start + chunk_len
+    pos = jnp.concatenate([
+        chunk_start + jnp.arange(c, dtype=jnp.int32), positions
+    ])[None]
+
+    def attn_fn(q, k, v, win, li):
+        oc, og = ragged_paged_attention(
+            cache.k, cache.v, cache.page_size,
+            q_chunk=q[:, :c], chunk_row=table_row, chunk_start=chunk_start,
+            chunk_total=total, k_chunk=k[0, :c], v_chunk=v[0, :c],
+            q_group=q[0, c:][:, None], page_table=cache.page_table,
+            group_lengths=positions, k_group=k[0, c:][:, None],
+            v_group=v[0, c:][:, None], layer=li, use_pallas=cfg.use_pallas,
+            logit_softcap=cfg.attn_logit_softcap, window=win, mesh=mesh,
+        )
+        return jnp.concatenate([oc[0], og[:, 0]]).reshape(1, c + s, -1)
+
+    x, k_ys, v_ys = _scan_layers(params, cfg, x, pos, attn_fn)
+    k_new, v_new = k_ys[:, 0], v_ys[:, 0]               # [L, C+S, KVH, D]
+    x = _gnorm(x, params["final_norm"], cfg.rms_eps)
+    chunk_logits = _unembed(cfg, params, x[0, jnp.maximum(chunk_len - 1, 0)])
+    dec_logits = _unembed(cfg, params, x[0, c:])
+
+    k_pool, v_pool = write_prefill_all(
+        cache.k, cache.v, k_new[:, :c], v_new[:, :c], table_row,
+        chunk_start, chunk_len, cache.page_size, use_pallas=cfg.use_pallas,
+        mesh=mesh,
+    )
+    k_pool, v_pool = write_decode_all(
+        k_pool, v_pool, k_new[:, c:], v_new[:, c:], cache.page_table,
+        positions, active, cache.page_size, use_pallas=cfg.use_pallas,
+        mesh=mesh,
+    )
+    new_lengths = jnp.minimum(
+        cache.lengths + active.astype(jnp.int32), cache.max_context
+    ).at[slot].set(total)
+    return chunk_logits, dec_logits, PagedKVCache(
+        k=k_pool, v=v_pool,
+        page_table=cache.page_table.at[slot].set(table_row),
+        lengths=new_lengths, page_size=cache.page_size,
     )
 
 

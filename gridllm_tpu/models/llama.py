@@ -29,6 +29,8 @@ from gridllm_tpu.ops.attention import (
     attention_prefix_chunk,
     paged_attention_decode,
     paged_attention_verify,
+    ragged_attention_enabled,
+    ragged_paged_attention,
 )
 from gridllm_tpu.ops.kvcache import (
     PagedKVCache,
@@ -411,12 +413,23 @@ def prefill_chunk_layers(
         k = apply_rope(k, pos, inv_freq)
         # pool holds the PREFIX only (writes deferred past the scan); the
         # fresh chunk's K/V are overlaid inside the attention. Full pool as
-        # closure + layer index — see decode_layers.
-        att = attention_prefix_chunk(
-            q, k_pool, v_pool, table_row, start, total, page_size,
-            k_cur=k[0], v_cur=v[0], layer=li, use_pallas=cfg.use_pallas,
-            window=cfg.sliding_window, mesh=mesh,
-        ).reshape(1, t, -1)
+        # closure + layer index — see decode_layers. Ragged mode routes
+        # through the unified kernel's chunk region (ISSUE 6).
+        if ragged_attention_enabled():
+            att, _ = ragged_paged_attention(
+                k_pool, v_pool, page_size,
+                q_chunk=q, chunk_row=table_row, chunk_start=start,
+                chunk_total=total, k_chunk=k[0], v_chunk=v[0], layer=li,
+                use_pallas=cfg.use_pallas, window=cfg.sliding_window,
+                mesh=mesh,
+            )
+            att = att.reshape(1, t, -1)
+        else:
+            att = attention_prefix_chunk(
+                q, k_pool, v_pool, table_row, start, total, page_size,
+                k_cur=k[0], v_cur=v[0], layer=li, use_pallas=cfg.use_pallas,
+                window=cfg.sliding_window, mesh=mesh,
+            ).reshape(1, t, -1)
         x = x + qdot(att, lp["wo"], precision=_precision(x))
         hx = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
         return x + mlp(lp, hx), (k[0], v[0])
@@ -463,12 +476,24 @@ def decode_layers(
         # to the pool ONCE after the scan (in-place DMA kernel). The FULL
         # pool rides in as a scan closure with `li` selecting the layer —
         # per-layer xs slices would materialize 2×pool-slice copies/iter.
-        attn = paged_attention_decode(
-            q, k_pool, v_pool, page_table, positions,
-            page_size, k_cur=k, v_cur=v, layer=li,
-            use_pallas=cfg.use_pallas, window=cfg.sliding_window,
-            mesh=mesh,
-        ).reshape(s, -1)
+        # Ragged mode: a decode step is the unified kernel's group region
+        # with query_len = 1 per slot (ISSUE 6).
+        if ragged_attention_enabled():
+            _, attn = ragged_paged_attention(
+                k_pool, v_pool, page_size,
+                q_group=q[:, None], page_table=page_table,
+                group_lengths=positions, k_group=k[:, None],
+                v_group=v[:, None], layer=li, use_pallas=cfg.use_pallas,
+                window=cfg.sliding_window, mesh=mesh,
+            )
+            attn = attn[:, 0].reshape(s, -1)
+        else:
+            attn = paged_attention_decode(
+                q, k_pool, v_pool, page_table, positions,
+                page_size, k_cur=k, v_cur=v, layer=li,
+                use_pallas=cfg.use_pallas, window=cfg.sliding_window,
+                mesh=mesh,
+            ).reshape(s, -1)
         x = x + qdot(attn, lp["wo"], precision=_precision(x))
         hx = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
         return x + mlp(lp, hx), (k, v)
@@ -552,12 +577,24 @@ def verify_layers(
         k = apply_rope(k, pos, inv_freq)
         # pool holds each slot's prefix only; the candidates' K/V are
         # overlaid in-register and written ONCE after the scan (full pool
-        # as closure + layer index — see decode_layers)
-        att = paged_attention_verify(
-            q, k_pool, v_pool, page_table, base_lengths, page_size,
-            k_cur=k, v_cur=v, layer=li, use_pallas=cfg.use_pallas,
-            window=cfg.sliding_window, mesh=mesh,
-        ).reshape(s, t, -1)
+        # as closure + layer index — see decode_layers). Ragged mode: ONE
+        # launch over all slots (group region, query_len = K+1) instead
+        # of paged_attention_verify's per-slot kernel loop (ISSUE 6).
+        if ragged_attention_enabled():
+            _, att = ragged_paged_attention(
+                k_pool, v_pool, page_size,
+                q_group=q, page_table=page_table,
+                group_lengths=base_lengths, k_group=k, v_group=v,
+                layer=li, use_pallas=cfg.use_pallas,
+                window=cfg.sliding_window, mesh=mesh,
+            )
+            att = att.reshape(s, t, -1)
+        else:
+            att = paged_attention_verify(
+                q, k_pool, v_pool, page_table, base_lengths, page_size,
+                k_cur=k, v_cur=v, layer=li, use_pallas=cfg.use_pallas,
+                window=cfg.sliding_window, mesh=mesh,
+            ).reshape(s, t, -1)
         x = x + qdot(att, lp["wo"], precision=_precision(x))
         hx = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
         return x + mlp(lp, hx), (k, v)
@@ -607,6 +644,139 @@ def verify_step(
         lengths=base, page_size=cache.page_size,
     )
     return logits, cache
+
+
+def mixed_layers(
+    layers: Params,
+    cfg: ModelConfig,
+    x: jnp.ndarray,
+    chunk_width: int,
+    k_pool: jnp.ndarray,
+    v_pool: jnp.ndarray,
+    page_table: jnp.ndarray,
+    chunk_row: jnp.ndarray,
+    chunk_start: jnp.ndarray,
+    chunk_total: jnp.ndarray,
+    group_lengths: jnp.ndarray,
+    page_size: int,
+    mlp: MlpFn = _mlp,
+    mesh=None,
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Mixed chunked-prefill + decode layer scan (ISSUE 6): the ragged
+    token batch [1, C+S, E] — rows [0, C) one admitting slot's prefill
+    chunk at absolute positions chunk_start + i, rows [C, C+S) one decode
+    token per slot at positions group_lengths[s] — runs the whole layer
+    stack with ONE ragged attention launch per layer. Pointwise sublayers
+    (norms, projections, MLP) are row-independent, so each region's rows
+    compute exactly what the separate legacy programs would. Returns
+    (x out, k_new [L, C+S, KVH, D], v_new) — pool writes are the
+    caller's, split per region."""
+    c = chunk_width
+    t = x.shape[1]
+    s = t - c
+    inv_freq = precompute_rope(cfg.head_dim_, cfg.rope_theta, cfg.rope_scaling)
+    pos = jnp.concatenate([
+        chunk_start + jnp.arange(c, dtype=jnp.int32), group_lengths
+    ])[None]
+    n = jax.tree.leaves(layers)[0].shape[0]
+
+    def layer(x, xs):
+        lp, li = xs
+        hx = rms_norm(x, lp["attn_norm"], cfg.rms_eps)
+        q, k, v = _qkv(cfg, lp, hx)
+        q = apply_rope(q, pos, inv_freq)
+        k = apply_rope(k, pos, inv_freq)
+        oc, og = ragged_paged_attention(
+            k_pool, v_pool, page_size,
+            q_chunk=q[:, :c], chunk_row=chunk_row, chunk_start=chunk_start,
+            chunk_total=chunk_total, k_chunk=k[0, :c], v_chunk=v[0, :c],
+            q_group=q[0, c:][:, None], page_table=page_table,
+            group_lengths=group_lengths, k_group=k[0, c:][:, None],
+            v_group=v[0, c:][:, None], layer=li, use_pallas=cfg.use_pallas,
+            window=cfg.sliding_window, mesh=mesh,
+        )
+        att = jnp.concatenate([oc[0], og[:, 0]]).reshape(1, t, -1)
+        x = x + qdot(att, lp["wo"], precision=_precision(x))
+        hx = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
+        return x + mlp(lp, hx), (k[0], v[0])
+
+    x, (k_new, v_new) = jax.lax.scan(
+        layer, x, (layers, jnp.arange(n, dtype=jnp.int32))
+    )
+    return x, k_new, v_new
+
+
+def mixed_step(
+    params: Params,
+    cfg: ModelConfig,
+    chunk_tokens: jnp.ndarray,
+    chunk_start: jnp.ndarray,
+    chunk_len: jnp.ndarray,
+    slot: jnp.ndarray,
+    table_row: jnp.ndarray,
+    tokens: jnp.ndarray,
+    cache: PagedKVCache,
+    active: jnp.ndarray,
+    mlp: MlpFn = _mlp,
+    mesh=None,
+    embeds: jnp.ndarray | None = None,
+) -> tuple[jnp.ndarray, jnp.ndarray, PagedKVCache]:
+    """One fused chunked-prefill + decode step (ISSUE 6): the prefill
+    chunk for ONE admitting slot PLUS one decode token for every active
+    slot, batched into one ragged descriptor — a single attention launch
+    per layer instead of the legacy per-phase (and per-slot) dispatches.
+    Long prefills stop stalling running streams: the batch keeps decoding
+    while the chunk prefills alongside it (the DeepServe mixed-step
+    shape).
+
+    chunk_tokens: [C] (padded chunk), chunk_start/chunk_len: scalars,
+    table_row: [max_pages] the admitting slot's pages, tokens: [S] each
+    slot's last token, active: [S]. Returns (chunk last-valid-token
+    logits [V], decode logits [S, V], updated cache with the chunk
+    written at [chunk_start, chunk_start+chunk_len) and active slots
+    advanced by one)."""
+    _check_supported(cfg)
+    c = chunk_tokens.shape[0]
+    xc = params["embed"][chunk_tokens] if embeds is None else embeds
+    xg = params["embed"][tokens]
+    x = jnp.concatenate([
+        xc.astype(params["embed"].dtype), xg.astype(params["embed"].dtype)
+    ])[None]                                        # [1, C+S, E]
+    positions = cache.lengths
+    total = chunk_start + chunk_len
+
+    x, k_new, v_new = mixed_layers(
+        params["layers"], cfg, x, c, cache.k, cache.v, cache.page_table,
+        table_row, chunk_start, total, positions, cache.page_size, mlp,
+        mesh=mesh,
+    )
+    x = rms_norm(x, params["final_norm"], cfg.rms_eps)
+    chunk_logits = _unembed(
+        cfg, params, x[0, jnp.maximum(chunk_len - 1, 0)]
+    )
+    dec_logits = _unembed(cfg, params, x[0, c:])
+
+    # region writes target disjoint pages (the admitting slot is not yet
+    # active), so the order is immaterial
+    k_pool, v_pool = write_prefill_all(
+        cache.k, cache.v, k_new[:, :c], v_new[:, :c], table_row,
+        chunk_start, chunk_len, cache.page_size, use_pallas=cfg.use_pallas,
+        mesh=mesh,
+    )
+    k_pool, v_pool = write_decode_all(
+        k_pool, v_pool, k_new[:, c:], v_new[:, c:], cache.page_table,
+        positions, active, cache.page_size, use_pallas=cfg.use_pallas,
+        mesh=mesh,
+    )
+    new_lengths = jnp.minimum(
+        cache.lengths + active.astype(jnp.int32), cache.max_context
+    ).at[slot].set(total)
+    cache = PagedKVCache(
+        k=k_pool, v=v_pool,
+        page_table=cache.page_table.at[slot].set(table_row),
+        lengths=new_lengths, page_size=cache.page_size,
+    )
+    return chunk_logits, dec_logits, cache
 
 
 # ---------------------------------------------------------------------------

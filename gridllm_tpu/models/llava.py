@@ -46,6 +46,7 @@ prefill = llama.prefill
 prefill_chunk = llama.prefill_chunk
 decode_step = llama.decode_step
 verify_step = llama.verify_step
+mixed_step = llama.mixed_step
 forward = llama.forward
 hidden_states = llama.hidden_states
 hf_map = llama.hf_map

@@ -327,6 +327,30 @@ def verify_step(
     )
 
 
+def mixed_step(
+    params: Params,
+    cfg: ModelConfig,
+    chunk_tokens: jnp.ndarray,
+    chunk_start: jnp.ndarray,
+    chunk_len: jnp.ndarray,
+    slot: jnp.ndarray,
+    table_row: jnp.ndarray,
+    tokens: jnp.ndarray,
+    cache: PagedKVCache,
+    active: jnp.ndarray,
+    mesh=None,
+    embeds: jnp.ndarray | None = None,
+) -> tuple[jnp.ndarray, jnp.ndarray, PagedKVCache]:
+    """Fused chunked-prefill + decode step (llama.mixed_step contract);
+    the flat [C+S, E] ragged token batch routes through the MoE exactly
+    like any other leading-dim layout."""
+    return llama.mixed_step(
+        params, cfg, chunk_tokens, chunk_start, chunk_len, slot, table_row,
+        tokens, cache, active, mlp=_mlp_for(cfg, mesh), mesh=mesh,
+        embeds=embeds,
+    )
+
+
 # ---------------------------------------------------------------------------
 # HF weight conversion (layout contract with transformers MixtralForCausalLM)
 # ---------------------------------------------------------------------------

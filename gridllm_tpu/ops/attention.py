@@ -18,6 +18,7 @@ materialized repeat).
 
 from __future__ import annotations
 
+import os
 from functools import partial
 
 import jax
@@ -34,10 +35,24 @@ from gridllm_tpu.ops.kvcache import (
 
 __all__ = [
     "attention_prefill", "paged_attention_decode", "attention_prefix_chunk",
-    "paged_attention_verify",
+    "paged_attention_verify", "ragged_paged_attention",
+    "ragged_attention_enabled",
     "attention_prefill_ref", "paged_attention_decode_ref",
     "_env_mode", "_pallas_mode",  # re-export: policy lives in ops/kvcache.py
 ]
+
+
+def ragged_attention_enabled() -> bool:
+    """Ragged paged attention (ISSUE 6): one unified kernel/launch serving
+    chunked prefill, decode, and spec-verify over a ragged per-slot
+    descriptor layout, replacing the three per-phase dispatchers below.
+    Env `GRIDLLM_RAGGED_ATTN` = "1" (default: on) routes the model
+    decode/verify/chunk paths (and the engine's mixed admission steps)
+    through `ragged_paged_attention`; "0" is the escape hatch restoring
+    the legacy dispatchers exactly. Resolved at trace time — flip it
+    before building an engine, not mid-serving."""
+    return os.environ.get("GRIDLLM_RAGGED_ATTN", "1").lower() not in (
+        "0", "off", "false")
 
 _NEG_INF = -1e30
 
@@ -347,6 +362,33 @@ def attention_prefix_chunk(
                                out_specs=hs)
         return sm(*args)
     record_kernel_path("attention_prefix_chunk", False)
+    return _prefix_chunk_ref(
+        q, k_pages, v_pages, table_row, start, total_len, page_size,
+        k_cur=k_cur, v_cur=v_cur, layer=layer,
+        logit_softcap=logit_softcap, window=window,
+    )
+
+
+def _prefix_chunk_ref(
+    q: jnp.ndarray,
+    k_pages: jnp.ndarray,
+    v_pages: jnp.ndarray,
+    table_row: jnp.ndarray,
+    start: jnp.ndarray,
+    total_len: jnp.ndarray,
+    page_size: int,
+    k_cur: jnp.ndarray | None = None,
+    v_cur: jnp.ndarray | None = None,
+    layer: jnp.ndarray | None = None,
+    logit_softcap: float = 0.0,
+    window: jnp.ndarray | int = 0,
+) -> jnp.ndarray:
+    """jnp reference for chunked-prefill attention against a paged prefix
+    (the fallback leg of attention_prefix_chunk, factored out so
+    ragged_paged_attention's chunk region shares it VERBATIM — ragged-on
+    and ragged-off jnp paths must stay bit-identical)."""
+    _, t, h, d = q.shape
+    kvh = k_pages.shape[-2]
     g = h // kvh
     scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32))
 
@@ -527,6 +569,207 @@ def paged_attention_verify_ref(
 
     out = jax.vmap(one_slot)(q, page_table, lengths, k_cur, v_cur)
     return out.astype(q.dtype)
+
+
+def ragged_paged_attention(
+    k_pages: jnp.ndarray,
+    v_pages: jnp.ndarray,
+    page_size: int,
+    q_chunk: jnp.ndarray | None = None,
+    chunk_row: jnp.ndarray | None = None,
+    chunk_start: jnp.ndarray | None = None,
+    chunk_total: jnp.ndarray | None = None,
+    k_chunk: jnp.ndarray | None = None,
+    v_chunk: jnp.ndarray | None = None,
+    q_group: jnp.ndarray | None = None,
+    page_table: jnp.ndarray | None = None,
+    group_lengths: jnp.ndarray | None = None,
+    k_group: jnp.ndarray | None = None,
+    v_group: jnp.ndarray | None = None,
+    layer: jnp.ndarray | None = None,
+    use_pallas: bool | None = None,
+    logit_softcap: float = 0.0,
+    window: jnp.ndarray | int = 0,
+    mesh=None,
+) -> tuple[jnp.ndarray | None, jnp.ndarray | None]:
+    """Unified ragged paged attention (ISSUE 6, Ragged Paged Attention
+    design): causal paged attention for a ragged token batch — one prefill
+    CHUNK region plus S fixed-stride per-slot GROUPS — in a single kernel
+    launch, replacing the three per-phase dispatchers
+    (attention_prefix_chunk / paged_attention_decode /
+    paged_attention_verify) and the per-slot Python loop verify used.
+
+    Regions (either may be absent; descriptors are per-sequence
+    `(query_len, context_len, page_table_row)` in the RPA sense):
+
+    - chunk: q_chunk [1, C, H, D] — one slot's prefill chunk at absolute
+      positions chunk_start + i, prefix pages via chunk_row [max_pages],
+      fresh K/V k_chunk/v_chunk [C, KVH, D] overlaid causally;
+      chunk_total = chunk_start + valid rows (query_len = valid rows).
+    - group: q_group [S, Td, H, D] — Td query tokens per slot (Td = 1 for
+      decode, K+1 for spec-verify) at positions group_lengths[s] + i
+      against page_table[s]; fresh K/V k_group/v_group [S, Td, KVH, D]
+      merged in-register. Slots with length 0 (inactive) compute garbage
+      cheaply — callers mask on `active`, matching the legacy ops.
+
+    Pools may be one layer [P, ps, KVH, D] or the full stack with `layer`
+    selecting (pass from inside a layer scan). Returns (chunk_out,
+    group_out), each shaped like its q (None when the region is absent).
+
+    Kernel path: ONE pallas_call with a static grid over query-token tiles
+    (C/BQ chunk tiles + S group tiles, pallas_kernels.ragged_attention) —
+    a mixed prefill+decode+verify engine step is a single launch. d=64
+    models keep the kernel path WITHOUT the 2x lane-padded pool when the
+    per-shard (KVH*D) % 128 == 0: pages are stored unpadded (tile-aligned
+    flat rows for the DMA) and lane-padded in-register at load — the
+    KV-bytes win /admin/memory itemizes. jnp path: the per-region
+    legacy references, shared verbatim, so greedy streams are
+    bit-identical ragged-on vs ragged-off on the fallback path.
+    """
+    some_q = q_chunk if q_chunk is not None else q_group
+    d, dpool = some_q.shape[-1], k_pages.shape[-1]
+    if dpool != d:
+        # lane-padded pool (legacy layout or KVH*D not lane-aligned):
+        # pad q/fresh-K/V at the boundary and slice back, exactly as the
+        # legacy dispatchers do
+        if q_chunk is not None:
+            q_chunk, k_chunk, v_chunk = _lane_pad_qkv(
+                q_chunk, k_chunk, v_chunk, dpool)
+        if q_group is not None:
+            q_group, k_group, v_group = _lane_pad_qkv(
+                q_group, k_group, v_group, dpool)
+        oc, og = ragged_paged_attention(
+            k_pages, v_pages, page_size,
+            q_chunk=q_chunk, chunk_row=chunk_row, chunk_start=chunk_start,
+            chunk_total=chunk_total, k_chunk=k_chunk, v_chunk=v_chunk,
+            q_group=q_group, page_table=page_table,
+            group_lengths=group_lengths, k_group=k_group, v_group=v_group,
+            layer=layer, use_pallas=use_pallas, logit_softcap=logit_softcap,
+            window=window, mesh=mesh,
+        )
+        return (
+            oc[..., :d] if oc is not None else None,
+            og[..., :d] if og is not None else None,
+        )
+
+    h = some_q.shape[-2]
+    kvh = k_pages.shape[-2]
+    use, interpret = _pallas_mode(use_pallas)
+    mode, ax = kernel_mesh_axis(mesh, kvh, h)
+    # per-SHARD head count: under tp the kernel runs inside a shard_map
+    # with kv heads split, so both the lane and VMEM gates must look at
+    # what one shard actually sees
+    kvh_local = kvh // mesh.shape["tp"] if ax == "tp" else kvh
+    # Mosaic lane alignment: either classic 128-lane head dim, or the
+    # ragged flat-lane layout — page rows viewed as [ps, KVH*D], aligned
+    # whenever the SHARD's KVH*D divides the lane tile (d=64 models with
+    # enough kv heads per shard)
+    lanes_ok = interpret or d % 128 == 0 or (kvh_local * d) % 128 == 0
+    chunk_ok = True
+    if q_chunk is not None:
+        c = q_chunk.shape[1]
+        # the chunk's fresh K/V stay VMEM-resident — same budget gate as
+        # attention_prefix_chunk (per shard under tp)
+        chunk_ok = (
+            c % min(128, c) == 0
+            and 2 * c * kvh_local * d * q_chunk.dtype.itemsize
+            <= _FLASH_KV_VMEM_CAP
+        )
+    if use and mode != "ref" and lanes_ok and chunk_ok:
+        from gridllm_tpu.ops import pallas_kernels
+
+        record_kernel_path("attention_ragged", True)
+        kp = k_pages if k_pages.ndim == 5 else k_pages[None]
+        vp = v_pages if v_pages.ndim == 5 else v_pages[None]
+        kernel = partial(
+            pallas_kernels.ragged_attention, page_size=page_size,
+            interpret=interpret, softcap=float(logit_softcap),
+        )
+        if mode == "direct":
+            return kernel(
+                kp, vp,
+                q_chunk=q_chunk, chunk_row=chunk_row,
+                chunk_start=chunk_start, chunk_total=chunk_total,
+                k_chunk=k_chunk, v_chunk=v_chunk,
+                q_group=q_group, page_table=page_table,
+                group_lengths=group_lengths, k_group=k_group,
+                v_group=v_group, layer=layer, window=window,
+            )
+        from jax.sharding import PartitionSpec as P
+
+        pool = P(None, None, None, ax, None)
+        # dynamic operand assembly (shard_map bodies cannot close over
+        # tracers): name → (value, spec); sorted for a stable order
+        opt = {"window": (jnp.asarray(window, jnp.int32), P())}
+        if layer is not None:
+            opt["layer"] = (layer, P())
+        if q_chunk is not None:
+            opt["q_chunk"] = (q_chunk, P(None, None, ax, None))
+            opt["chunk_row"] = (chunk_row, P(None))
+            opt["chunk_start"] = (chunk_start, P())
+            opt["chunk_total"] = (chunk_total, P())
+            opt["k_chunk"] = (k_chunk, P(None, ax, None))
+            opt["v_chunk"] = (v_chunk, P(None, ax, None))
+        if q_group is not None:
+            opt["q_group"] = (q_group, P(None, None, ax, None))
+            opt["page_table"] = (page_table, P(None, None))
+            opt["group_lengths"] = (group_lengths, P(None))
+            opt["k_group"] = (k_group, P(None, None, ax, None))
+            opt["v_group"] = (v_group, P(None, None, ax, None))
+        names = sorted(opt)
+
+        out_specs = (
+            (P(None, None, ax, None),) if q_chunk is not None else ()
+        ) + (
+            (P(None, None, ax, None),) if q_group is not None else ()
+        )
+
+        def sm_tuple(kp, vp, *dyn):
+            oc, og = kernel(kp, vp, **dict(zip(names, dyn)))
+            return tuple(o for o in (oc, og) if o is not None)
+
+        sm = _shard_map_kernel(
+            mesh, sm_tuple,
+            in_specs=(pool, pool, *(opt[n][1] for n in names)),
+            out_specs=out_specs,
+        )
+        outs = sm(kp, vp, *(opt[n][0] for n in names))
+        it = iter(outs)
+        return (
+            next(it) if q_chunk is not None else None,
+            next(it) if q_group is not None else None,
+        )
+
+    record_kernel_path("attention_ragged", False)
+    out_chunk = out_group = None
+    if q_chunk is not None:
+        out_chunk = _prefix_chunk_ref(
+            q_chunk, k_pages, v_pages, chunk_row, chunk_start, chunk_total,
+            page_size, k_cur=k_chunk, v_cur=v_chunk, layer=layer,
+            logit_softcap=logit_softcap, window=window,
+        )
+    if q_group is not None:
+        td = q_group.shape[1]
+        if td == 1:
+            # Td == 1 IS legacy decode — delegate to its reference so the
+            # ragged-on jnp path stays bit-identical to ragged-off decode
+            kp, vp = k_pages, v_pages
+            if kp.ndim == 5:
+                li = jnp.int32(0) if layer is None else layer
+                kp = jax.lax.dynamic_index_in_dim(kp, li, keepdims=False)
+                vp = jax.lax.dynamic_index_in_dim(vp, li, keepdims=False)
+            out_group = paged_attention_decode_ref(
+                q_group[:, 0], kp, vp, page_table, group_lengths, page_size,
+                k_cur=k_group[:, 0], v_cur=v_group[:, 0],
+                logit_softcap=logit_softcap, window=window,
+            )[:, None]
+        else:
+            out_group = paged_attention_verify_ref(
+                q_group, k_pages, v_pages, page_table, group_lengths,
+                page_size, k_group, v_group, layer=layer,
+                logit_softcap=logit_softcap, window=window,
+            )
+    return out_chunk, out_group
 
 
 def _softcap(logits: jnp.ndarray, cap: float) -> jnp.ndarray:

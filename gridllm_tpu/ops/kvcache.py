@@ -157,6 +157,43 @@ def _shard_map_kernel(mesh, body, in_specs, out_specs):
     )
 
 
+def flat_lanes_ok(kvh: int, d: int) -> bool:
+    """True when a page's rows are lane-aligned VIEWED FLAT ([ps, KVH*D])
+    even though d alone is not — the ragged layout's trick (ISSUE 6):
+    pages are contiguous, so the page DMA moves tile-aligned flat rows
+    without padding D itself (the ragged kernel lane-pads the LOADED
+    values in-register before its dots). d=64 models with KVH >= 2 per
+    shard keep the kernel write/attention paths on an UNPADDED pool
+    (half the KV bytes of the lane-padded layout).
+
+    `kvh` must be the PER-SHARD head count: under tp the kernels run
+    inside a full-manual shard_map with kv heads split over "tp"
+    (kernel_mesh_axis), so each shard's page rows are (kvh/tp)*D lanes —
+    callers divide before asking (see local_kv_heads)."""
+    return (kvh * d) % 128 == 0
+
+
+def local_kv_heads(kvh: int, mesh) -> int:
+    """KV heads per kernel shard: kvh/tp when the tp axis will split the
+    head dim (the same divisibility rule kernel_mesh_axis applies),
+    otherwise the full count (no mesh, or indivisible heads replicate)."""
+    if mesh is None:
+        return kvh
+    tp = mesh.shape.get("tp", 1)
+    return kvh // tp if tp > 1 and kvh % tp == 0 else kvh
+
+
+def _write_lane_gate(k_pages, ax, mesh, interpret: bool) -> bool:
+    """Mosaic lane-alignment gate for the pool-write kernels: classic
+    128-lane head dim, or the ragged flat-lane row view — checked at the
+    PER-SHARD head count when `ax` says tp will split heads."""
+    d = k_pages.shape[-1]
+    kvh = k_pages.shape[-2]
+    if ax == "tp":
+        kvh //= mesh.shape["tp"]
+    return interpret or d % 128 == 0 or flat_lanes_ok(kvh, d)
+
+
 def lane_pad_dim(d: int) -> int:
     """Head dim rounded up to the 128-lane tile. The engine allocates the
     page pool at this width when kernels are on (d=64 models: qwen2.5
@@ -359,9 +396,11 @@ def write_decode_all(
     offset = positions % page_size
     use, interpret = _pallas_mode(use_pallas)
     # same Mosaic constraint as the attention kernels: page slices need a
-    # 128-lane-aligned minor dim on real TPU; d=64 models take the scatter
+    # 128-lane-aligned minor dim on real TPU — met either by a (padded)
+    # d % 128 pool or by the ragged layout's flat [ps, KVH*D] row view
     mode, ax = kernel_mesh_axis(mesh, k_new.shape[2])
-    if use and mode != "ref" and (interpret or k_pages.shape[-1] % 128 == 0):
+    if use and mode != "ref" and _write_lane_gate(k_pages, ax, mesh,
+                                                  interpret):
         from gridllm_tpu.ops.pallas_kernels import paged_write_decode
 
         record_kernel_path("write_decode", True)
@@ -424,7 +463,8 @@ def write_multi_all(
     v_flat = v_new.reshape(n_layers, s * t, *v_new.shape[3:])
     use, interpret = _pallas_mode(use_pallas)
     mode, ax = kernel_mesh_axis(mesh, k_new.shape[3])
-    if use and mode != "ref" and (interpret or k_pages.shape[-1] % 128 == 0):
+    if use and mode != "ref" and _write_lane_gate(k_pages, ax, mesh,
+                                                  interpret):
         from gridllm_tpu.ops.pallas_kernels import paged_write_decode
 
         record_kernel_path("write_multi", True)
@@ -494,7 +534,7 @@ def write_prefill_all(
     use, interpret = _pallas_mode(use_pallas)
     mode, ax = kernel_mesh_axis(mesh, k_new.shape[2])
     if use and mode != "ref" and k_new.shape[1] % page_size == 0 and (
-        interpret or k_pages.shape[-1] % 128 == 0
+        _write_lane_gate(k_pages, ax, mesh, interpret)
     ):
         from gridllm_tpu.ops.pallas_kernels import paged_write_chunk
 

@@ -801,6 +801,440 @@ def prefix_chunk(
 
 
 # ---------------------------------------------------------------------------
+# unified ragged paged attention (ISSUE 6)
+# ---------------------------------------------------------------------------
+
+
+def _ragged_attn_kernel(
+    *refs,
+    ps: int, bq: int, bk: int, c: int, kvh: int, g: int, d: int,
+    td: int, nct: int, softcap: float, has_chunk: bool, has_group: bool,
+):
+    """One grid over query-token tiles serving all phases at once
+    (the Ragged Paged Attention shape): tiles [0, nct) are the prefill
+    chunk's BQ-row blocks (prefix pages streamed HBM→VMEM double-buffered
+    + the chunk's own resident K/V, causally masked — the
+    _prefix_chunk_kernel math); tiles [nct, nct+S) are one slot each —
+    Td query rows (1 = decode, K+1 = spec-verify) against the slot's
+    paged context with the Td fresh K/V columns merged in-register (the
+    _paged_decode_kernel math generalized from 1 to Td tokens). The DMA
+    discipline is shared: every conditional start is guarded by the same
+    bound as its wait (scratch + semaphores persist across grid steps)."""
+    it = iter(refs)
+    scal_ref = next(it)      # SMEM [4]: layer, window, chunk_start, total
+    if has_group:
+        lens_ref = next(it)      # SMEM [S] per-slot context lengths
+        gtable_ref = next(it)    # SMEM [S, maxp]
+    if has_chunk:
+        crow_ref = next(it)      # SMEM [maxp] chunk slot's page row
+        qc_ref = next(it)        # VMEM (BQ, KVH, G, D)
+        kc_ref = next(it)        # VMEM (C, KVH, D) — resident chunk K
+        vc_ref = next(it)
+    if has_group:
+        qg_ref = next(it)        # VMEM (1, Td, KVH, G, D)
+        kg_ref = next(it)        # VMEM (1, Td, KVH, D)
+        vg_ref = next(it)
+    k_hbm = next(it)             # ANY [L, P, ps, KVH, D]
+    v_hbm = next(it)
+    oc_ref = next(it) if has_chunk else None
+    og_ref = next(it) if has_group else None
+    k_scr = next(it)             # VMEM (2, ps, KVH, D) double buffer
+    v_scr = next(it)
+    sems = next(it)              # DMA sems (2, 2)
+
+    i = pl.program_id(0)
+    layer = scal_ref[0]
+    window = scal_ref[1]
+    scale = jax.lax.rsqrt(jnp.float32(d))
+    # flat-lane pools (d % 128 != 0, ISSUE 6): pages are STORED unpadded
+    # (the KV-bytes win) and lane-padded here, in-register after the
+    # load, so every dot still runs on 128-lane minors — numerically
+    # exact (zero lanes meet zero q lanes), same compute as the legacy
+    # lane-padded-pool kernels, half the HBM bytes/bandwidth
+    dp = -(-d // 128) * 128
+
+    def _lp(x):
+        """Zero-pad a loaded value's last dim from d to the lane tile."""
+        if dp == d:
+            return x
+        return jnp.pad(x, [(0, 0)] * (x.ndim - 1) + [(0, dp - d)])
+
+    def attend_pages(page_of, ctx_limit, n_table, q_f32, q_abs, q_lo,
+                     r, carry):
+        """Stream the pages holding keys [0, ctx_limit) (double-buffered)
+        into the online-softmax carry. q_f32: [R, KVH, G, D]-ish accessed
+        per head as [R, D]; q_abs: [R] absolute query positions (q_lo =
+        q_abs minimum, for the window's first-page skip)."""
+
+        def k_dma(slot, page_no):
+            page = jnp.maximum(page_of(page_no), 0)
+            return pltpu.make_async_copy(
+                k_hbm.at[layer, page], k_scr.at[slot], sems.at[slot, 0]
+            )
+
+        def v_dma(slot, page_no):
+            page = jnp.maximum(page_of(page_no), 0)
+            return pltpu.make_async_copy(
+                v_hbm.at[layer, page], v_scr.at[slot], sems.at[slot, 1]
+            )
+
+        n_pages = jnp.minimum(
+            pl.cdiv(jnp.maximum(ctx_limit, 0), ps), n_table
+        )
+        p0 = jnp.where(window > 0, jnp.maximum(q_lo - window + 1, 0) // ps,
+                       0)
+        p0 = jnp.minimum(p0, n_pages)
+
+        @pl.when(n_pages > p0)
+        def _():
+            k_dma(0, p0).start()
+            v_dma(0, p0).start()
+
+        def body(p, carry):
+            m, l, acc = carry
+            slot = jax.lax.rem(p - p0, 2)
+
+            @pl.when(p + 1 < n_pages)
+            def _():
+                nxt = jax.lax.rem(p + 1 - p0, 2)
+                k_dma(nxt, p + 1).start()
+                v_dma(nxt, p + 1).start()
+
+            k_dma(slot, p).wait()
+            v_dma(slot, p).wait()
+            k_page = k_scr[slot]                    # [ps, KVH, D]
+            v_page = v_scr[slot]
+            logits = jnp.stack([
+                jax.lax.dot_general(
+                    q_f32[h], _lp(k_page[:, h, :].astype(jnp.float32)),
+                    (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+                for h in range(kvh)
+            ])                                      # [KVH, R, ps]
+            if softcap:
+                logits = softcap * jnp.tanh(logits / softcap)
+            pos = p * ps + jax.lax.broadcasted_iota(
+                jnp.int32, (kvh, r, ps), 2
+            )
+            valid = (pos < ctx_limit) & (
+                (window <= 0) | (q_abs[None, :, None] - pos < window)
+            )
+            logits = jnp.where(valid, logits, -1e30)
+
+            m_new = jnp.maximum(m, logits.max(axis=2, keepdims=True))
+            alpha = jnp.exp(m - m_new)
+            prob = jnp.exp(logits - m_new)
+            l_new = l * alpha + prob.sum(axis=2, keepdims=True)
+            acc_new = acc * alpha + jnp.stack([
+                jax.lax.dot_general(
+                    prob[h], _lp(v_page[:, h, :].astype(jnp.float32)),
+                    (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+                for h in range(kvh)
+            ])
+            return m_new, l_new, acc_new
+
+        return jax.lax.fori_loop(p0, n_pages, body, carry)
+
+    def chunk_tile():
+        start = scal_ref[2]
+        total = scal_ref[3]
+        r = bq * g
+        q = qc_ref[...].astype(jnp.float32) * scale  # [BQ, KVH, G, D]
+        q_heads = [_lp(q[:, h].reshape(r, d)) for h in range(kvh)]
+        # row → chunk-relative token index (rows are token-major: g rows
+        # per token)
+        q_rel = i * bq + jax.lax.broadcasted_iota(jnp.int32, (r,), 0) // g
+        q_abs = start + q_rel
+
+        m0 = jnp.full((kvh, r, 1), -1e30, jnp.float32)
+        l0 = jnp.zeros((kvh, r, 1), jnp.float32)
+        acc0 = jnp.zeros((kvh, r, dp), jnp.float32)
+        m, l, acc = attend_pages(
+            lambda p: crow_ref[p], start, crow_ref.shape[0], q_heads,
+            q_abs, start + i * bq, r, (m0, l0, acc0),
+        )
+
+        # phase 2: the chunk's own K/V blocks, causal within the chunk
+        nkb = pl.cdiv((i + 1) * bq, bk)
+        kb0 = jnp.where(
+            window > 0, jnp.maximum(i * bq - window + 1, 0) // bk, 0
+        )
+        kb0 = jnp.minimum(kb0, nkb)
+
+        def chunk_body(kb, carry):
+            m, l, acc = carry
+            k_blk = kc_ref[pl.ds(kb * bk, bk)]      # [BK, KVH, D]
+            v_blk = vc_ref[pl.ds(kb * bk, bk)]
+            logits = jnp.stack([
+                jax.lax.dot_general(
+                    q_heads[h], _lp(k_blk[:, h, :].astype(jnp.float32)),
+                    (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+                for h in range(kvh)
+            ])                                      # [KVH, R, BK]
+            if softcap:
+                logits = softcap * jnp.tanh(logits / softcap)
+            krel = kb * bk + jax.lax.broadcasted_iota(
+                jnp.int32, (kvh, r, bk), 2
+            )
+            dist = q_rel[None, :, None] - krel
+            valid = (dist >= 0) & (start + krel < total) & (
+                (window <= 0) | (dist < window)
+            )
+            logits = jnp.where(valid, logits, -1e30)
+
+            m_new = jnp.maximum(m, logits.max(axis=2, keepdims=True))
+            alpha = jnp.exp(m - m_new)
+            prob = jnp.exp(logits - m_new)
+            l_new = l * alpha + prob.sum(axis=2, keepdims=True)
+            acc_new = acc * alpha + jnp.stack([
+                jax.lax.dot_general(
+                    prob[h], _lp(v_blk[:, h, :].astype(jnp.float32)),
+                    (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+                for h in range(kvh)
+            ])
+            return m_new, l_new, acc_new
+
+        _, l, acc = jax.lax.fori_loop(kb0, nkb, chunk_body, (m, l, acc))
+        out = (acc / jnp.maximum(l, 1e-30))[..., :d]  # [KVH, R, D]
+        oc_ref[...] = (
+            out.reshape(kvh, bq, g, d).transpose(1, 0, 2, 3)
+            .astype(oc_ref.dtype)
+        )
+
+    def group_tile():
+        s = i - nct if has_chunk else i
+        length = lens_ref[s]
+        r = td * g
+        q = qg_ref[0].astype(jnp.float32) * scale   # [Td, KVH, G, D]
+        q_heads = [_lp(q[:, h].reshape(r, d)) for h in range(kvh)]
+        tok = jax.lax.broadcasted_iota(jnp.int32, (r,), 0) // g
+        q_abs = length + tok
+
+        m0 = jnp.full((kvh, r, 1), -1e30, jnp.float32)
+        l0 = jnp.zeros((kvh, r, 1), jnp.float32)
+        acc0 = jnp.zeros((kvh, r, dp), jnp.float32)
+        m, l, acc = attend_pages(
+            lambda p: gtable_ref[s, p], length, gtable_ref.shape[1],
+            q_heads, q_abs, length, r, (m0, l0, acc0),
+        )
+
+        # merge the Td fresh columns (candidates not yet in the pool):
+        # column j is the slot's token at absolute position length + j;
+        # row token i attends columns j <= i (verify causality; Td = 1
+        # degenerates to the decode kernel's single current-token merge)
+        kg = kg_ref[0].astype(jnp.float32)          # [Td, KVH, D]
+        vg = vg_ref[0].astype(jnp.float32)
+        logits = jnp.stack([
+            jax.lax.dot_general(
+                q_heads[h], _lp(kg[:, h, :]),
+                (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            for h in range(kvh)
+        ])                                          # [KVH, R, Td]
+        if softcap:
+            logits = softcap * jnp.tanh(logits / softcap)
+        col = jax.lax.broadcasted_iota(jnp.int32, (kvh, r, td), 2)
+        dist = tok[None, :, None] - col
+        valid = (dist >= 0) & ((window <= 0) | (dist < window))
+        logits = jnp.where(valid, logits, -1e30)
+        m_new = jnp.maximum(m, logits.max(axis=2, keepdims=True))
+        alpha = jnp.exp(m - m_new)
+        prob = jnp.exp(logits - m_new)
+        l = l * alpha + prob.sum(axis=2, keepdims=True)
+        acc = acc * alpha + jnp.stack([
+            jax.lax.dot_general(
+                prob[h], _lp(vg[:, h, :]),
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            for h in range(kvh)
+        ])
+        out = (acc / jnp.maximum(l, 1e-30))[..., :d]  # [KVH, R, D]
+        og_ref[0] = (
+            out.reshape(kvh, td, g, d).transpose(1, 0, 2, 3)
+            .astype(og_ref.dtype)
+        )
+
+    if has_chunk and has_group:
+        @pl.when(i < nct)
+        def _():
+            chunk_tile()
+
+        @pl.when(i >= nct)
+        def _():
+            group_tile()
+    elif has_chunk:
+        chunk_tile()
+    else:
+        group_tile()
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("page_size", "interpret", "softcap"))
+def ragged_attention(
+    k_pages: jnp.ndarray,
+    v_pages: jnp.ndarray,
+    page_size: int,
+    q_chunk: jnp.ndarray | None = None,
+    chunk_row: jnp.ndarray | None = None,
+    chunk_start: jnp.ndarray | None = None,
+    chunk_total: jnp.ndarray | None = None,
+    k_chunk: jnp.ndarray | None = None,
+    v_chunk: jnp.ndarray | None = None,
+    q_group: jnp.ndarray | None = None,
+    page_table: jnp.ndarray | None = None,
+    group_lengths: jnp.ndarray | None = None,
+    k_group: jnp.ndarray | None = None,
+    v_group: jnp.ndarray | None = None,
+    layer: jnp.ndarray | None = None,
+    interpret: bool = False,
+    softcap: float = 0.0,
+    window: jnp.ndarray | int = 0,
+) -> tuple[jnp.ndarray | None, jnp.ndarray | None]:
+    """Kernel form of ops.attention.ragged_paged_attention: ONE launch,
+    static grid (C/BQ chunk tiles + S group tiles) serving chunked
+    prefill, decode (Td=1), and spec-verify (Td=K+1) at once. See the
+    dispatcher's docstring for the region contracts. Unlike the legacy
+    kernels this one accepts d < 128 pools when the PER-SHARD KVH*D is
+    lane-aligned: pages are STORED unpadded (contiguous [ps, KVH*D]-byte
+    rows, so the page DMA stays tile-aligned) and the loaded values are
+    zero-padded to 128 lanes in-register before every dot — same compute
+    as the lane-padded-pool kernels, half the HBM bytes/bandwidth."""
+    has_chunk = q_chunk is not None
+    has_group = q_group is not None
+    assert has_chunk or has_group
+    if k_pages.ndim == 4:
+        k_pages = k_pages[None]
+        v_pages = v_pages[None]
+    if layer is None:
+        layer = jnp.int32(0)
+    kvh, d = k_pages.shape[-2], k_pages.shape[-1]
+    h = (q_chunk if has_chunk else q_group).shape[-2]
+    g = h // kvh
+    dtype = (q_chunk if has_chunk else q_group).dtype
+
+    nct = 0
+    c = bq = bk = 0
+    if has_chunk:
+        c = q_chunk.shape[1]
+        bq = min(128, c)
+        bk = min(128, c)
+        assert c % bq == 0 and c % bk == 0, (c, bq, bk)
+        nct = c // bq
+    s = td = 0
+    if has_group:
+        s, td = q_group.shape[:2]
+
+    kernel = functools.partial(
+        _ragged_attn_kernel, ps=page_size, bq=bq, bk=bk, c=c, kvh=kvh,
+        g=g, d=d, td=td, nct=nct, softcap=softcap,
+        has_chunk=has_chunk, has_group=has_group,
+    )
+
+    scal = jnp.stack([
+        jnp.asarray(layer, jnp.int32).reshape(()),
+        jnp.asarray(window, jnp.int32).reshape(()),
+        (jnp.asarray(chunk_start, jnp.int32).reshape(())
+         if has_chunk else jnp.int32(0)),
+        (jnp.asarray(chunk_total, jnp.int32).reshape(())
+         if has_chunk else jnp.int32(0)),
+    ])
+
+    prefetch: list = [scal]
+    if has_group:
+        prefetch += [group_lengths.astype(jnp.int32),
+                     page_table.astype(jnp.int32)]
+    if has_chunk:
+        prefetch += [chunk_row.astype(jnp.int32)]
+
+    # block index clamps: chunk operands pin to their last tile during
+    # group steps (and vice versa at index 0) — those blocks are simply
+    # not re-fetched/written outside their region
+    last_ct = max(nct - 1, 0)
+
+    in_specs = []
+    args = []
+    if has_chunk:
+        in_specs += [
+            pl.BlockSpec((bq, kvh, g, d),
+                         lambda i, *_: (jnp.minimum(i, last_ct), 0, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((c, kvh, d), lambda i, *_: (0, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((c, kvh, d), lambda i, *_: (0, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ]
+        args += [q_chunk[0].reshape(c, kvh, g, d), k_chunk, v_chunk]
+    if has_group:
+        def _gidx(i, *_):
+            return (jnp.maximum(i - nct, 0), 0, 0, 0, 0)
+
+        def _gidx4(i, *_):
+            return (jnp.maximum(i - nct, 0), 0, 0, 0)
+
+        in_specs += [
+            pl.BlockSpec((1, td, kvh, g, d), _gidx,
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, td, kvh, d), _gidx4,
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, td, kvh, d), _gidx4,
+                         memory_space=pltpu.VMEM),
+        ]
+        args += [q_group.reshape(s, td, kvh, g, d), k_group, v_group]
+    in_specs += [pl.BlockSpec(memory_space=pl.ANY),
+                 pl.BlockSpec(memory_space=pl.ANY)]
+    args += [k_pages, v_pages]
+
+    out_specs = []
+    out_shape = []
+    if has_chunk:
+        out_specs.append(
+            pl.BlockSpec((bq, kvh, g, d),
+                         lambda i, *_: (jnp.minimum(i, last_ct), 0, 0, 0),
+                         memory_space=pltpu.VMEM))
+        out_shape.append(jax.ShapeDtypeStruct((c, kvh, g, d), dtype))
+    if has_group:
+        out_specs.append(
+            pl.BlockSpec((1, td, kvh, g, d),
+                         lambda i, *_: (jnp.maximum(i - nct, 0), 0, 0, 0, 0),
+                         memory_space=pltpu.VMEM))
+        out_shape.append(jax.ShapeDtypeStruct((s, td, kvh, g, d), dtype))
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=len(prefetch),
+        grid=(nct + s,),
+        in_specs=in_specs,
+        out_specs=out_specs,
+        scratch_shapes=[
+            pltpu.VMEM((2, page_size, kvh, d), k_pages.dtype),
+            pltpu.VMEM((2, page_size, kvh, d), v_pages.dtype),
+            pltpu.SemaphoreType.DMA((2, 2)),
+        ],
+    )
+    outs = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=out_shape,
+        interpret=interpret,
+    )(*prefetch, *args)
+    it = iter(outs)
+    out_chunk = out_group = None
+    if has_chunk:
+        out_chunk = next(it).reshape(1, c, h, d)
+    if has_group:
+        out_group = next(it).reshape(s, td, h, d)
+    return out_chunk, out_group
+
+
+# ---------------------------------------------------------------------------
 # paged KV writes (in-place DMA; replaces XLA scatter on the hot path)
 # ---------------------------------------------------------------------------
 #

@@ -159,18 +159,23 @@ def test_chunked_prefill_matches_single_shot():
     single = InferenceEngine(EngineConfig(**TINY, prefill_chunk=64))
     opts = {"temperature": 0.0, "num_predict": 6}
 
+    # the chunk program: the ragged mixed step (ISSUE 6) when ragged
+    # attention is on, the legacy per-chunk prefill otherwise
+    chunk_fn = (chunked._mixed_chunk_fn if chunked._use_mixed
+                else chunked._prefill_chunk_fn)
+
     prompt = "abcdefgh" * 4  # 33 ids with BOS > chunk 16 → 3 chunks
     r_c = chunked.generate(GenerationRequest(id="c", prompt=prompt, options=opts))
     r_s = single.generate(GenerationRequest(id="s", prompt=prompt, options=opts))
     assert r_c.token_ids == r_s.token_ids
-    assert chunked._prefill_chunk_fn._cache_size() == 1
+    assert chunk_fn._cache_size() == 1
 
     # different long length → same compiled program, no new trace
     prompt2 = "zyxwvuts" * 5  # 41 ids
     r2_c = chunked.generate(GenerationRequest(id="c2", prompt=prompt2, options=opts))
     r2_s = single.generate(GenerationRequest(id="s2", prompt=prompt2, options=opts))
     assert r2_c.token_ids == r2_s.token_ids
-    assert chunked._prefill_chunk_fn._cache_size() == 1
+    assert chunk_fn._cache_size() == 1
 
 
 def test_embed_batched_matches_single():

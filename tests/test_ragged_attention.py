@@ -1,0 +1,387 @@
+"""Ragged paged attention (ISSUE 6): the unified kernel/dispatcher that
+serves chunked prefill, decode, and spec-verify in one launch.
+
+Four layers of pinning:
+
+- differential: the ragged jnp reference is BIT-identical to the legacy
+  per-phase references (it delegates to them region-by-region), and the
+  interpret-mode kernel matches the reference across mixed batches,
+  page-boundary straddles, empty slots, windows, and softcap;
+- stream parity: greedy engine token streams are identical ragged-on vs
+  ragged-off — concurrent mixed batches, warm prefix-cache replays, and
+  the speculative path included; GRIDLLM_RAGGED_ATTN=0 restores the
+  legacy dispatchers exactly;
+- single launch: the kernel-dispatch counters prove a ragged engine
+  compiles ONLY `attention_ragged` programs — no per-phase
+  decode/chunk/verify dispatches, no per-slot loop;
+- recompile hygiene: varying batch mixes (admissions mid-decode, spec
+  verify, warm cache) trigger zero steady-state recompiles.
+"""
+
+import os
+from contextlib import contextmanager
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gridllm_tpu.engine import EngineConfig, GenerationRequest, InferenceEngine
+from gridllm_tpu.obs import default_registry
+from gridllm_tpu.obs.perf import recompile_totals
+from gridllm_tpu.ops import attention as A
+from gridllm_tpu.ops import pallas_kernels as PK
+
+TINY = dict(
+    model="tiny-llama",
+    max_slots=4,
+    page_size=8,
+    num_pages=64,
+    max_pages_per_slot=8,
+    prefill_buckets=(16, 32),
+    prefill_chunk=16,
+)
+# long enough to take the chunked (= ragged mixed-step) admission path
+LONG_PROMPT = "ab ab ab ab ab ab ab ab ab ab"
+GREEDY = {"temperature": 0.0, "repeat_penalty": 1.0, "num_predict": 24}
+
+
+@contextmanager
+def ragged(flag: bool):
+    old = os.environ.get("GRIDLLM_RAGGED_ATTN")
+    os.environ["GRIDLLM_RAGGED_ATTN"] = "1" if flag else "0"
+    try:
+        yield
+    finally:
+        if old is None:
+            os.environ.pop("GRIDLLM_RAGGED_ATTN", None)
+        else:
+            os.environ["GRIDLLM_RAGGED_ATTN"] = old
+
+
+def _gen_batch(engine, prompts, opts=GREEDY):
+    """Submit all prompts, drive step() until done, return token streams
+    in submission order (concurrent batch → mixed steps exercise)."""
+    res = {}
+
+    def cb(i):
+        def f(_delta, done, r):
+            if done:
+                res[i] = r
+
+        return f
+
+    for i, p in enumerate(prompts):
+        req = GenerationRequest(id=f"r{i}", prompt=p, options=dict(opts))
+        req.on_chunk = cb(i)
+        engine.submit(req)
+    while len(res) < len(prompts):
+        engine.step()
+    return [res[i] for i in range(len(prompts))]
+
+
+# ---------------------------------------------------------------------------
+# differential: ragged op vs the legacy references / interpret kernel
+# ---------------------------------------------------------------------------
+
+
+def _pools(rng, L=2, P=32, ps=8, kvh=2, d=16):
+    kp = jnp.asarray(rng.normal(size=(L, P, ps, kvh, d)), jnp.float32)
+    vp = jnp.asarray(rng.normal(size=(L, P, ps, kvh, d)), jnp.float32)
+    return kp, vp
+
+
+def test_ragged_ref_bitwise_equals_legacy_refs():
+    """The fallback path delegates region-by-region to the legacy
+    references — ragged-on and ragged-off jnp paths are the same bits."""
+    rng = np.random.default_rng(0)
+    kp, vp = _pools(rng)
+    ps, kvh, d, h = 8, 2, 16, 4
+    S, maxp, T = 3, 6, 4
+    table = jnp.asarray(
+        rng.choice(32, size=S * maxp, replace=False).reshape(S, maxp),
+        jnp.int32)
+    # lengths straddle page boundaries; slot 1 empty (fresh admission)
+    lengths = jnp.asarray([13, 0, 37], jnp.int32)
+    li = jnp.int32(1)
+
+    q = jnp.asarray(rng.normal(size=(S, h, d)), jnp.float32)
+    kc = jnp.asarray(rng.normal(size=(S, kvh, d)), jnp.float32)
+    vc = jnp.asarray(rng.normal(size=(S, kvh, d)), jnp.float32)
+    want = A.paged_attention_decode_ref(
+        q, kp[1], vp[1], table, lengths, ps, k_cur=kc, v_cur=vc)
+    _, got = A.ragged_paged_attention(
+        kp, vp, ps, q_group=q[:, None], page_table=table,
+        group_lengths=lengths, k_group=kc[:, None], v_group=vc[:, None],
+        layer=li, use_pallas=False)
+    np.testing.assert_array_equal(np.asarray(want), np.asarray(got[:, 0]))
+
+    qv = jnp.asarray(rng.normal(size=(S, T, h, d)), jnp.float32)
+    kcv = jnp.asarray(rng.normal(size=(S, T, kvh, d)), jnp.float32)
+    vcv = jnp.asarray(rng.normal(size=(S, T, kvh, d)), jnp.float32)
+    wantv = A.paged_attention_verify_ref(
+        qv, kp, vp, table, lengths, ps, kcv, vcv, layer=li)
+    _, gotv = A.ragged_paged_attention(
+        kp, vp, ps, q_group=qv, page_table=table, group_lengths=lengths,
+        k_group=kcv, v_group=vcv, layer=li, use_pallas=False)
+    np.testing.assert_array_equal(np.asarray(wantv), np.asarray(gotv))
+
+    C = 16
+    row, start = table[2], jnp.int32(16)
+    qc = jnp.asarray(rng.normal(size=(1, C, h, d)), jnp.float32)
+    kcc = jnp.asarray(rng.normal(size=(C, kvh, d)), jnp.float32)
+    vcc = jnp.asarray(rng.normal(size=(C, kvh, d)), jnp.float32)
+    wantc = A.attention_prefix_chunk(
+        qc, kp, vp, row, start, start + C, ps, k_cur=kcc, v_cur=vcc,
+        layer=li, use_pallas=False)
+    gotc, _ = A.ragged_paged_attention(
+        kp, vp, ps, q_chunk=qc, chunk_row=row, chunk_start=start,
+        chunk_total=start + C, k_chunk=kcc, v_chunk=vcc, layer=li,
+        use_pallas=False)
+    np.testing.assert_array_equal(np.asarray(wantc), np.asarray(gotc))
+
+
+@pytest.mark.parametrize("softcap,window", [(0.0, 0), (30.0, 0), (0.0, 9)])
+def test_ragged_kernel_mixed_batch_matches_ref(softcap, window):
+    """ONE interpret-mode launch over chunk + decode + verify regions
+    matches the per-region references — incl. page straddles, an empty
+    slot, a partially filled last page, softcap, and sliding window."""
+    rng = np.random.default_rng(1)
+    kp, vp = _pools(rng)
+    ps, kvh, d, h = 8, 2, 16, 4
+    S, maxp, T, C = 3, 6, 4, 16
+    table = jnp.asarray(
+        rng.choice(26, size=S * maxp, replace=False).reshape(S, maxp),
+        jnp.int32)
+    lengths = jnp.asarray([13, 0, 37], jnp.int32)
+    li = jnp.int32(0)
+    row = jnp.asarray([26, 27, 28, 29, 30, 31], jnp.int32)
+    start = jnp.int32(16)   # page-aligned, mid-prompt chunk
+    total = start + jnp.int32(11)  # ragged chunk: only 11 of 16 rows valid
+
+    qv = jnp.asarray(rng.normal(size=(S, T, h, d)), jnp.float32)
+    kcv = jnp.asarray(rng.normal(size=(S, T, kvh, d)), jnp.float32)
+    vcv = jnp.asarray(rng.normal(size=(S, T, kvh, d)), jnp.float32)
+    qc = jnp.asarray(rng.normal(size=(1, C, h, d)), jnp.float32)
+    kcc = jnp.asarray(rng.normal(size=(C, kvh, d)), jnp.float32)
+    vcc = jnp.asarray(rng.normal(size=(C, kvh, d)), jnp.float32)
+
+    wantv = A.paged_attention_verify_ref(
+        qv, kp, vp, table, lengths, ps, kcv, vcv, layer=li,
+        logit_softcap=softcap, window=window)
+    wantc = A._prefix_chunk_ref(
+        qc, kp, vp, row, start, total, ps, k_cur=kcc, v_cur=vcc, layer=li,
+        logit_softcap=softcap, window=window)
+
+    gc, gg = PK.ragged_attention(
+        kp, vp, ps, q_chunk=qc, chunk_row=row, chunk_start=start,
+        chunk_total=total, k_chunk=kcc, v_chunk=vcc,
+        q_group=qv, page_table=table, group_lengths=lengths,
+        k_group=kcv, v_group=vcv, layer=li, interpret=True,
+        softcap=softcap, window=window)
+    np.testing.assert_allclose(
+        np.asarray(gc), np.asarray(wantc), rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(
+        np.asarray(gg), np.asarray(wantv), rtol=2e-5, atol=2e-5)
+
+
+def test_ragged_kernel_group_only_and_chunk_only():
+    """Region-absent variants (pure decode step / pure chunk) run the
+    same kernel with the other region compiled out."""
+    rng = np.random.default_rng(2)
+    kp, vp = _pools(rng)
+    ps, kvh, d, h = 8, 2, 16, 4
+    S, maxp = 3, 6
+    table = jnp.asarray(
+        rng.choice(32, size=S * maxp, replace=False).reshape(S, maxp),
+        jnp.int32)
+    lengths = jnp.asarray([7, 25, 1], jnp.int32)
+    li = jnp.int32(1)
+
+    q = jnp.asarray(rng.normal(size=(S, 1, h, d)), jnp.float32)
+    kc = jnp.asarray(rng.normal(size=(S, 1, kvh, d)), jnp.float32)
+    vc = jnp.asarray(rng.normal(size=(S, 1, kvh, d)), jnp.float32)
+    want = A.paged_attention_decode_ref(
+        q[:, 0], kp[1], vp[1], table, lengths, ps,
+        k_cur=kc[:, 0], v_cur=vc[:, 0])
+    _, got = PK.ragged_attention(
+        kp, vp, ps, q_group=q, page_table=table, group_lengths=lengths,
+        k_group=kc, v_group=vc, layer=li, interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(got[:, 0]), np.asarray(want), rtol=2e-5, atol=2e-5)
+
+    C = 16
+    qc = jnp.asarray(rng.normal(size=(1, C, h, d)), jnp.float32)
+    kcc = jnp.asarray(rng.normal(size=(C, kvh, d)), jnp.float32)
+    vcc = jnp.asarray(rng.normal(size=(C, kvh, d)), jnp.float32)
+    row = table[1]
+    start = jnp.int32(8)
+    wantc = A._prefix_chunk_ref(
+        qc, kp, vp, row, start, start + C, ps, k_cur=kcc, v_cur=vcc,
+        layer=li)
+    gotc, _ = PK.ragged_attention(
+        kp, vp, ps, q_chunk=qc, chunk_row=row, chunk_start=start,
+        chunk_total=start + C, k_chunk=kcc, v_chunk=vcc, layer=li,
+        interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(gotc), np.asarray(wantc), rtol=2e-5, atol=2e-5)
+
+
+def test_ragged_kernel_first_chunk_empty_prefix():
+    """start == 0 (a fresh prompt's first chunk): no prefix pages are
+    streamed, causal attention over the chunk alone."""
+    rng = np.random.default_rng(3)
+    kp, vp = _pools(rng)
+    ps, kvh, d, h = 8, 2, 16, 4
+    C = 16
+    row = jnp.asarray([0, 1, 2, 3, 4, 5], jnp.int32)
+    qc = jnp.asarray(rng.normal(size=(1, C, h, d)), jnp.float32)
+    kcc = jnp.asarray(rng.normal(size=(C, kvh, d)), jnp.float32)
+    vcc = jnp.asarray(rng.normal(size=(C, kvh, d)), jnp.float32)
+    want = A._prefix_chunk_ref(
+        qc, kp, vp, row, jnp.int32(0), jnp.int32(C), ps,
+        k_cur=kcc, v_cur=vcc)
+    got, _ = PK.ragged_attention(
+        kp, vp, ps, q_chunk=qc, chunk_row=row, chunk_start=jnp.int32(0),
+        chunk_total=jnp.int32(C), k_chunk=kcc, v_chunk=vcc, interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5)
+
+
+# ---------------------------------------------------------------------------
+# greedy stream parity: ragged-on vs ragged-off engines
+# ---------------------------------------------------------------------------
+
+
+def _engine(ragged_on: bool, **kw):
+    with ragged(ragged_on):
+        return InferenceEngine(EngineConfig(**TINY, **kw))
+
+
+def test_greedy_parity_concurrent_mixed_batch():
+    """Long (chunked → ragged mixed-step) and short (bucketed) prompts in
+    one concurrent batch: identical greedy streams ragged-on vs off."""
+    prompts = [LONG_PROMPT, "hello", LONG_PROMPT + " xyz", "q"]
+    off = _engine(False, spec_decode=False, prefix_cache=False)
+    with ragged(False):
+        want = [r.token_ids for r in _gen_batch(off, prompts)]
+    on = _engine(True, spec_decode=False, prefix_cache=False)
+    with ragged(True):
+        got = [r.token_ids for r in _gen_batch(on, prompts)]
+    assert got == want
+    assert all(len(t) == GREEDY["num_predict"] for t in got)
+
+
+def test_greedy_parity_warm_prefix_cache():
+    """Warm (cache-hit) admissions replay through the ragged mixed path
+    bit-identically: cold == warm == legacy."""
+    off = _engine(False, spec_decode=False)
+    with ragged(False):
+        want = [_gen_batch(off, [LONG_PROMPT])[0].token_ids
+                for _ in range(2)]
+    on = _engine(True, spec_decode=False)
+    with ragged(True):
+        got = [_gen_batch(on, [LONG_PROMPT])[0].token_ids
+               for _ in range(2)]
+    assert got == want
+    assert got[0] == got[1]            # cold == warm
+    assert on.alloc.hits > 0           # the warm round really hit
+
+
+def test_greedy_parity_speculative():
+    """Spec-on engines: the ragged verify path (one launch, no per-slot
+    loop) keeps greedy streams identical, with real acceptance."""
+    prompts = [LONG_PROMPT, "hello"]
+    off = _engine(False, spec_decode=True, spec_k=4, prefix_cache=False)
+    with ragged(False):
+        want = _gen_batch(off, prompts)
+    on = _engine(True, spec_decode=True, spec_k=4, prefix_cache=False)
+    with ragged(True):
+        got = _gen_batch(on, prompts)
+    assert [r.token_ids for r in got] == [r.token_ids for r in want]
+    assert sum(r.spec_accepted for r in got) > 0
+
+
+def test_escape_hatch_restores_legacy_dispatchers():
+    """GRIDLLM_RAGGED_ATTN=0 engines never trace the ragged op."""
+    c = default_registry().get("gridllm_kernel_dispatch_total")
+
+    def count(op):
+        return sum(v for labels, v in c.items() if labels["op"] == op)
+
+    before = count("attention_ragged")
+    legacy_before = count("attention_decode")
+    off = _engine(False, spec_decode=False, prefix_cache=False)
+    with ragged(False):
+        _gen_batch(off, [LONG_PROMPT])
+    assert count("attention_ragged") == before
+    assert count("attention_decode") > legacy_before
+
+
+# ---------------------------------------------------------------------------
+# single-launch proof: dispatch counters
+# ---------------------------------------------------------------------------
+
+
+def test_single_attention_dispatch_per_step():
+    """A ragged engine serving a mixed workload (chunked admission +
+    decode + spec verify + warm cache) compiles ONLY attention_ragged
+    programs — the legacy per-phase ops (and verify's per-slot chunk
+    loop) are never dispatched. Counters count per compiled program, so
+    zero deltas prove the phases share the unified entry point."""
+    c = default_registry().get("gridllm_kernel_dispatch_total")
+
+    def snap():
+        return {op: sum(v for labels, v in c.items()
+                        if labels["op"] == op)
+                for op in ("attention_ragged", "attention_decode",
+                           "attention_prefix_chunk", "attention_verify")}
+
+    before = snap()
+    eng = _engine(True, spec_decode=True, spec_k=4)
+    with ragged(True):
+        _gen_batch(eng, [LONG_PROMPT, "hello"])
+        _gen_batch(eng, [LONG_PROMPT])  # warm-cache replay
+    after = snap()
+    assert after["attention_ragged"] > before["attention_ragged"]
+    for op in ("attention_decode", "attention_prefix_chunk",
+               "attention_verify"):
+        assert after[op] == before[op], op
+
+
+# ---------------------------------------------------------------------------
+# recompile hygiene: varying batch mixes, zero steady-state recompiles
+# ---------------------------------------------------------------------------
+
+
+def test_zero_steady_recompiles_over_varying_mixes():
+    """After the first completed request arms the tripwire, admissions
+    mid-decode (mixed steps), different batch fills, spec verify, and
+    warm-cache replays must all reuse compiled programs."""
+    eng = _engine(True, spec_decode=True, spec_k=4)
+    with ragged(True):
+        # warm every program this test's mixes need: chunked + bucketed
+        # admission, decode, verify, warm-cache window seeding
+        _gen_batch(eng, [LONG_PROMPT, "hello"])
+        _gen_batch(eng, [LONG_PROMPT])
+        assert eng.perf.armed
+        steady0 = recompile_totals()["steady"]
+        _gen_batch(eng, [LONG_PROMPT, "hi", LONG_PROMPT + " xyz"])
+        _gen_batch(eng, ["hello", LONG_PROMPT])
+        steady = recompile_totals()["steady"]
+    assert steady == steady0, recompile_totals()["byFn"]
+
+
+def test_ragged_pool_unpadded_and_memory_fields():
+    """_pool_head_dim under ragged: the pool stays at the model's head
+    dim when KVH*D is flat-lane aligned (no 2x lane-pad bytes), and
+    /admin/memory's allocator math reports zero lane-pad overhead with
+    kvLayout "ragged". (Interpret/CPU engines keep the unpadded pool
+    either way; the layout assertion is on the accounting fields.)"""
+    eng = _engine(True, spec_decode=False)
+    alloc = eng.memory_arrays()["alloc"]
+    assert alloc["kvLayout"] == "ragged"
+    assert alloc["lanePadOverheadBytes"] == 0
+    assert eng.cache.k.shape[-1] == eng.cfg.head_dim_
+
+    off = _engine(False, spec_decode=False)
+    assert off.memory_arrays()["alloc"]["kvLayout"] == "legacy"
