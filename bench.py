@@ -31,6 +31,7 @@ import json
 import statistics
 import sys
 import time
+import uuid
 
 # Approximate public Ollama single-stream numbers on A100 (the BASELINE.json
 # comparison anchor; nothing is published by the reference itself).
@@ -735,6 +736,214 @@ async def run_mixed_bench(model: str, n_requests: int, n_tokens: int,
                               client=client)
 
 
+async def run_disagg_bench(model: str, n_requests: int, n_tokens: int,
+                           max_slots: int, long_prompt_len: int) -> dict:
+    """Disaggregated-serving A/B (ISSUE 7): the same mixed workload
+    (decode-heavy streams + long prefills arriving mid-generation) served
+    by (a) ONE unified worker and (b) a prefill worker + a decode worker
+    with KV-page migration between them. The headline: the split arm's
+    decode-pool ITL under mixed load — long prefills run on the prefill
+    worker, so they stop inflating the decode pool's inter-token latency
+    — plus migration volume/latency from the transfer layer's metrics.
+    Measured at the scheduler boundary (submit_streaming_job) so both
+    arms pay identical harness overhead."""
+    import os
+
+    from gridllm_tpu.bus.memory import InMemoryBus
+    from gridllm_tpu.engine import EngineConfig, InferenceEngine
+    from gridllm_tpu.scheduler import JobScheduler, WorkerRegistry
+    from gridllm_tpu.transfer.migrate import (
+        _MIG_BYTES,
+        _MIG_SECONDS,
+        _MIGRATIONS,
+    )
+    from gridllm_tpu.utils.config import SchedulerConfig, WorkerConfig
+    from gridllm_tpu.utils.types import InferenceRequest
+    from gridllm_tpu.worker.main import resolve_checkpoint
+    from gridllm_tpu.worker.service import WorkerService
+
+    ckpt, tok = resolve_checkpoint(
+        os.environ.get("GRIDLLM_CHECKPOINT_DIR"), model
+    )
+    tiny = model.startswith("tiny")
+
+    def make_engine() -> InferenceEngine:
+        return InferenceEngine(EngineConfig(
+            model=model,
+            checkpoint_path=ckpt,
+            tokenizer=tok,
+            max_slots=max_slots,
+            page_size=64,
+            num_pages=max(384, max_slots * 64),
+            max_pages_per_slot=8 if tiny else 48,
+            prefill_buckets=(64, 256, 1024),
+            prefill_chunk=64 if tiny else 512,
+        ))
+
+    filler = "the quick brown fox jumps over the lazy dog; "
+    long_prompt = (filler * 200)[:long_prompt_len]
+    # the short prompt must span >1 KV page (64 TOKENS) or there is no
+    # full-page prefix to migrate and every decode stream falls back —
+    # sized against the engines' ACTUAL tokenizer (byte-level for tiny
+    # models, HF for real checkpoints), not in characters
+    from gridllm_tpu.engine.tokenizer import get_tokenizer
+    from gridllm_tpu.models.configs import get_config
+
+    try:
+        vocab = get_config(model).vocab_size
+    except KeyError:
+        vocab = 32000
+    probe_tok = get_tokenizer(tok, vocab)
+    short_prompt = "summarize: " + filler
+    while len(probe_tok.encode(short_prompt, add_bos=True)) < 80:
+        short_prompt += filler
+
+    async def run_arm(roles: list[str]) -> dict:
+        bus = InMemoryBus()
+        await bus.connect()
+        cfg = SchedulerConfig()
+        registry = WorkerRegistry(bus, cfg)
+        scheduler = JobScheduler(bus, registry, cfg)
+        await registry.initialize()
+        await scheduler.initialize()
+        workers: list[WorkerService] = []
+        for i, role in enumerate(roles):
+            svc = WorkerService(
+                bus, {model: make_engine()},
+                WorkerConfig(worker_id=f"bench-{role}-{i}", role=role,
+                             heartbeat_interval_ms=250),
+                stream_flush_ms=5)
+            await svc.start()
+            workers.append(svc)
+        await asyncio.sleep(0.4)  # first heartbeats (roles/headroom) land
+        try:
+            tokens_out = [0]
+
+            async def one(prompt: str, n_predict: int, ttfts: list,
+                          itls: list | None, tag: str, i: int) -> None:
+                t0 = time.perf_counter()
+                marks: list[float] = []
+
+                async def on_chunk(_c) -> None:
+                    marks.append(time.perf_counter())
+
+                req = InferenceRequest(
+                    id=f"bench-{tag}{i}-{uuid.uuid4().hex[:6]}",
+                    model=model, prompt=f"[{tag}{i}] {prompt}", stream=True,
+                    options={"temperature": 0, "seed": i,
+                             "num_predict": n_predict},
+                    metadata={"requestType": "inference"})
+                res = await scheduler.submit_streaming_job(
+                    req, on_chunk, timeout_ms=240_000)
+                assert res.success, res.error
+                n = int(res.response.eval_count or 0)
+                tokens_out[0] += n
+                if marks:
+                    ttfts.append(marks[0] - t0)
+                    if itls is not None and n > 1:
+                        itls.append((marks[-1] - marks[0]) / (n - 1) * 1000)
+
+            # warmup compiles every program both arms need — long
+            # (chunked) and short (bucketed) prefills, decode, and on the
+            # split arm the whole export→wire→import→warm-resume chain —
+            # run TWICE so warm-path programs exist before measurement
+            for w in range(2):
+                await one(long_prompt, 4, [], None, "W", w)
+                await one(short_prompt, 4, [], None, "W", w + 10)
+            tokens_out[0] = 0  # warmup tokens must not inflate tok/s
+
+            mig0 = _MIGRATIONS.value(side="send", outcome="ok")
+            bytes0, secs0 = _MIG_BYTES.sum(), _MIG_SECONDS.sum()
+            count0 = _MIG_BYTES.count()
+            handoff0 = scheduler._disagg_total.value(event="handoff")
+            fallback0 = scheduler._disagg_total.value(event="fallback")
+
+            decode_ttfts: list[float] = []
+            decode_itls: list[float] = []
+            prefill_ttfts: list[float] = []
+            n_decode = max(n_requests // 2, 1)
+            n_long = max(n_requests - n_decode, 1)
+
+            async def long_arm(i: int) -> None:
+                # arrive mid-decode: prefill load lands while the decode
+                # streams are generating — the interference under test
+                await asyncio.sleep(0.2 * (i + 1))
+                await one(long_prompt, 4, prefill_ttfts, None, "L", i)
+
+            t0 = time.perf_counter()
+            await asyncio.gather(
+                *(one(short_prompt, n_tokens, decode_ttfts, decode_itls,
+                      "D", i) for i in range(n_decode)),
+                *(long_arm(i) for i in range(n_long)),
+            )
+            wall = time.perf_counter() - t0
+            n_mig = int(_MIG_BYTES.count() - count0)
+            steady = sum(
+                p["steadyRecompiles"]
+                for svc in workers
+                for p in svc.engines[model].perf.state().values())
+            return {
+                "roles": roles,
+                "tok_s": tokens_out[0] / wall,
+                "tokens": tokens_out[0],
+                "wall_s": wall,
+                "p50_itl_ms": (statistics.median(decode_itls)
+                               if decode_itls else None),
+                "p95_itl_ms": _p95(decode_itls),
+                "p50_ttft_ms": (statistics.median(prefill_ttfts) * 1000
+                                if prefill_ttfts else None),
+                "p95_ttft_ms": (None if _p95(prefill_ttfts) is None
+                                else _p95(prefill_ttfts) * 1000),
+                "p50_decode_ttft_ms": (
+                    statistics.median(decode_ttfts) * 1000
+                    if decode_ttfts else None),
+                "recompiles_steady": steady,
+                "migrations": {
+                    "count": n_mig,
+                    "ok": int(_MIGRATIONS.value(side="send", outcome="ok")
+                              - mig0),
+                    "bytes": int(_MIG_BYTES.sum() - bytes0),
+                    "avg_ms": (round((_MIG_SECONDS.sum() - secs0)
+                                     / n_mig * 1000, 2) if n_mig else None),
+                    # deltas over the measured window, like count/bytes
+                    # (warmups migrate too and must not skew the record)
+                    "handoffs": int(scheduler._disagg_total.value(
+                        event="handoff") - handoff0),
+                    "fallbacks": int(scheduler._disagg_total.value(
+                        event="fallback") - fallback0),
+                },
+            }
+        finally:
+            for svc in workers:
+                try:
+                    await svc.stop(announce=False)
+                except Exception:  # noqa: BLE001
+                    pass
+            try:
+                await scheduler.shutdown()
+                await registry.shutdown()
+                await bus.disconnect()
+            except Exception:  # noqa: BLE001
+                pass
+
+    unified = await run_arm(["unified"])
+    split = await run_arm(["prefill", "decode"])
+    return {
+        # headline = the split arm (what --compare gates release over
+        # release); the unified arm rides in the payload for the A/B read
+        "tok_s": split["tok_s"],
+        "tokens": split["tokens"],
+        "wall_s": unified["wall_s"] + split["wall_s"],
+        "p50_itl_ms": split["p50_itl_ms"],
+        "p50_ttft_ms": split["p50_ttft_ms"],
+        "p95_ttft_ms": split["p95_ttft_ms"],
+        "disagg": {"unified": unified, "split": split},
+        "perf": _perf_sidecar(),
+        "weights": "real-checkpoint" if ckpt
+        else "random-weights synthetic",
+    }
+
+
 async def run_embed_bench(model: str, n_requests: int,
                           batch: int = 64, rounds: int = 8) -> dict:
     """Embeddings QPS through the full stack (BASELINE config #5):
@@ -943,7 +1152,13 @@ def main() -> int:
                          "(ISSUE 6)")
     ap.add_argument("--long-prompt-len", type=int, default=2400,
                     help="long-prefill prompt length in characters "
-                         "(--mixed only)")
+                         "(--mixed/--disagg only)")
+    ap.add_argument("--disagg", action="store_true",
+                    help="disaggregated-serving A/B: the mixed workload "
+                         "served by one unified worker vs a prefill+decode "
+                         "split fleet with KV-page migration; reports both "
+                         "arms' decode ITL and prefill TTFT plus migration "
+                         "bytes/latency (ISSUE 7)")
     ap.add_argument("--tiny", action="store_true",
                     help="tiny-llama CPU smoke test")
     ap.add_argument("--profile", metavar="DIR", default=None,
@@ -973,6 +1188,13 @@ def main() -> int:
     if args.mixed and (args.embed or args.shared_prefix or args.spec):
         ap.error("--mixed is its own generate scenario; drop "
                  "--embed/--shared-prefix/--spec")
+    if args.disagg and (args.embed or args.shared_prefix or args.spec
+                        or args.mixed):
+        ap.error("--disagg is its own generate scenario; drop "
+                 "--embed/--shared-prefix/--spec/--mixed")
+    if args.disagg:
+        # at least one stream per class, same clamp rationale as --mixed
+        args.requests = max(args.requests, 2)
     if args.mixed:
         # the scenario needs at least one stream per arm — clamp HERE so
         # the emitted record's request count matches the load actually run
@@ -1009,7 +1231,8 @@ def main() -> int:
         args.model = "tiny-bert" if args.embed else "tiny-llama"
         # the spec scenario needs enough decode steps for the output to
         # enter its repetitive regime before acceptance can show
-        args.tokens = min(args.tokens, 48 if (args.spec or args.mixed)
+        args.tokens = min(args.tokens,
+                          48 if (args.spec or args.mixed or args.disagg)
                           else 16)
         args.prompt_len = 20
         # the shared prefix must still span several KV pages (64-token
@@ -1064,6 +1287,19 @@ def main() -> int:
                 f"({args.model}, speculative-decoding A/B, n-gram "
                 f"K={args.spec_k}, {args.requests} streams, repetitive "
                 f"workload, {r['weights']})"
+            )
+        elif args.disagg:
+            r = asyncio.run(run_disagg_bench(
+                args.model, args.requests, args.tokens, args.slots,
+                args.long_prompt_len,
+            ))
+            baseline = A100_OLLAMA_TOK_S.get(args.model, 0.0)
+            value, unit = r["tok_s"], "tok/s"
+            metric_name = (
+                f"split-fleet output tokens/sec via scheduler submit "
+                f"({args.model}, disaggregated prefill/decode A/B with "
+                f"KV-page migration, {args.requests} streams, "
+                f"{r['weights']})"
             )
         elif args.mixed:
             r = asyncio.run(run_mixed_bench(
@@ -1200,6 +1436,17 @@ def main() -> int:
         payload["prefix_cache_hit_rate_cold"] = r["prefix_cache_hit_rate_cold"]
         payload["prefix_cache"] = r["prefix_cache"]
         payload["tokens"] = r["tokens"]
+    elif args.disagg:
+        # the disaggregation headline: the split arm's decode-pool ITL
+        # under mixed load (long prefills no longer inflate it) against
+        # the unified arm's, plus migration volume/latency — both arms
+        # ride the record so --compare gates the split numbers
+        if r.get("p50_itl_ms") is not None:
+            payload["p50_itl_ms"] = round(r["p50_itl_ms"], 2)
+        if r.get("p50_ttft_ms") is not None:
+            payload["p50_ttft_ms"] = round(r["p50_ttft_ms"], 1)
+        payload["disagg"] = r["disagg"]
+        payload["tokens"] = r["tokens"]
     elif args.mixed:
         # the mixed-workload headline: the decode arm's ITL must survive
         # concurrent long prefills (single-launch mixed steps), and the
@@ -1239,7 +1486,8 @@ def main() -> int:
     scenario = ("embed" if args.embed
                 else "shared-prefix" if args.shared_prefix
                 else "spec" if args.spec
-                else "mixed" if args.mixed else "generate")
+                else "mixed" if args.mixed
+                else "disagg" if args.disagg else "generate")
     record = build_record(scenario, args, payload, r)
     regressions: list = []
     if args.compare:

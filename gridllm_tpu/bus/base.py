@@ -55,6 +55,8 @@ _CHANNEL_CLASS_PREFIXES = (
     # multi-host SPMD plan replay: slice:{worker_id}:plan and
     # slice:{worker_id}:ready:{pid} — collapse both under one class
     ("slice:", "slice"),
+    # KV-page migration chunk streams (ISSUE 7): kvx:{request_id}
+    ("kvx:", "kvx"),
 )
 
 

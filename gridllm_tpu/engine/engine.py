@@ -257,6 +257,11 @@ class GenerationRequest:
     options: dict[str, Any] = dataclasses.field(default_factory=dict)
     raw: bool = False                    # skip BOS when prompt_ids is None
     images: list[str] | None = None      # base64 images (vision models only)
+    # disaggregated prefill (ISSUE 7): finish at the FIRST host-visible
+    # token with done_reason "export" — the prompt's KV pages land in the
+    # prefix cache (free+register, exactly the normal finish path) ready
+    # for export_prefix_pages; no text is detokenized or streamed
+    export_only: bool = False
     # called from the engine loop: (text_delta, done, result|None)
     on_chunk: Callable[[str, bool, "GenerationResult | None"], None] | None = None
 
@@ -293,7 +298,7 @@ class _Slot:
     __slots__ = (
         "req", "ids", "prompt_len", "generated", "detok", "text", "emitted_len",
         "num_predict", "stop_seqs", "eos_ids", "capacity", "joined_gen",
-        "cached_tokens", "spec_proposed", "spec_accepted",
+        "cached_tokens", "spec_proposed", "spec_accepted", "export_only",
         "t_start", "t_prefill_ns", "t_first_decode", "t_last_ingest",
     )
 
@@ -313,6 +318,7 @@ class _Slot:
         self.cached_tokens = 0           # prompt tokens reused from the prefix cache
         self.spec_proposed = 0           # drafts sent to verify steps
         self.spec_accepted = 0           # drafts the model accepted
+        self.export_only = req.export_only  # disagg prefill: stop at token 1
         # dispatch generation of the FIRST decode block that will see this
         # slot: its row 0 (block-input tokens) carries the prefill-sampled
         # token; blocks with a lower generation predate the slot (or belong
@@ -382,6 +388,13 @@ class InferenceEngine:
             0 if sp_prefill else self._resolve_prefix_cache_cap()
         )
         self._lock = threading.Lock()
+        # allocator guard (ISSUE 7): page allocation/free runs on the
+        # driving thread (admission/finish), while KV export/import runs
+        # on the worker's executor threads — both mutate PageAllocator
+        # state, so every allocator mutation sits under this lock. Lock
+        # order where both are held: _alloc_lock BEFORE dispatch_lock.
+        self._alloc_lock = threading.RLock()
+        self._kv_install_fn: Callable | None = None  # lazy (ISSUE 7 import)
         self._pending: deque[GenerationRequest] = deque()
         self._slots: dict[int, _Slot] = {}
         self._free_slots = list(range(config.max_slots - 1, -1, -1))
@@ -608,7 +621,7 @@ class InferenceEngine:
         abort_all() first — slot state is discarded here."""
         if self.embedding_only:
             return
-        with self.dispatch_lock:
+        with self._alloc_lock, self.dispatch_lock:
             self._slots.clear()
             self._inflight.clear()
             self._t_prev_fetch = None  # recovery wall must not read as
@@ -1025,17 +1038,18 @@ class InferenceEngine:
         # then allocate the remainder. Images are excluded — token ids
         # alone don't address spliced pixel embeddings — and sp meshes
         # have no chunked path to resume from (cap forced to 0 there).
-        cached = 0
-        if self._prefix_cache_cap != 0 and not images:
-            cached = self.alloc.match_prefix(slot, ids)
-        pages = self.alloc.alloc(slot, want)
-        if pages is None:
-            # pool exhausted: unpin any matched prefix, requeue at front,
-            # wait for a slot to free pages
-            self.alloc.free(slot)
-            with self._lock:
-                self._pending.appendleft(req)
-            return False
+        with self._alloc_lock:
+            cached = 0
+            if self._prefix_cache_cap != 0 and not images:
+                cached = self.alloc.match_prefix(slot, ids)
+            pages = self.alloc.alloc(slot, want)
+            if pages is None:
+                # pool exhausted: unpin any matched prefix, requeue at
+                # front, wait for a slot to free pages
+                self.alloc.free(slot)
+                with self._lock:
+                    self._pending.appendleft(req)
+                return False
         self._free_slots.pop()
 
         stop = opts.get("stop") or []
@@ -1284,6 +1298,18 @@ class InferenceEngine:
 
     def _ingest(self, slot: int, st: _Slot, tok: int) -> None:
         """Record one sampled token; emit text; finish the slot if done."""
+        if st.export_only:
+            # disaggregated prefill (ISSUE 7): the first host-visible token
+            # proves the whole prompt's KV is written — finish NOW with
+            # reason "export" so _finish registers the prompt's full pages
+            # in the prefix cache (the export source). The sampled token is
+            # deliberately discarded (not detokenized, not streamed): the
+            # decode worker re-prefills the prompt tail and samples it
+            # itself, which is what keeps the streams bit-identical.
+            st.generated.append(tok)
+            st.ids.append(tok)
+            self._finish(slot, st, "export")
+            return
         st.generated.append(tok)
         st.ids.append(tok)
         done_reason = None
@@ -1356,7 +1382,8 @@ class InferenceEngine:
         # pixel embeddings that identical token ids (image-token runs) do
         # not capture, so a token-chain key would collide across images.
         register = reason != "error" and not st.req.images
-        self.alloc.free(slot, st.ids[:-1] if register else None)
+        with self._alloc_lock:
+            self.alloc.free(slot, st.ids[:-1] if register else None)
         self._update_kv_gauges()
         del self._slots[slot]
         self._free_slots.append(slot)
@@ -1892,6 +1919,176 @@ class InferenceEngine:
                         self._work.notify_all()
                 return True
         return False
+
+    # ------------------------------------------- KV-page migration (ISSUE 7)
+
+    @property
+    def free_slot_count(self) -> int:
+        """Open batch slots — the decode-headroom figure heartbeats carry
+        for the scheduler's decode-pool placement."""
+        return 0 if self.embedding_only else len(self._free_slots)
+
+    def kv_transfer_supported(self) -> bool:
+        """Export/import needs the content-addressed prefix cache (the
+        transfer unit IS cached pages) and a process-local, unsharded
+        pool: a mesh shards the pool across devices and a multi-host
+        plan replay would desync on any out-of-plan pool mutation."""
+        return (not self.embedding_only
+                and self._prefix_cache_cap != 0
+                and self.mesh is None
+                and self.plan_sink is None)
+
+    def export_prefix_pages(self, token_ids: list[int]) -> dict[str, Any] | None:
+        """Gather the longest cached full-page prefix of `token_ids` as
+        host arrays for the migration wire (transfer/wire.py). Returns
+        {tokens, k, v, model, kvLayout, quant} with k/v
+        [L, n, ps, KVH, D] sliced to the UNPADDED model head dim, or
+        None when nothing is cached / transfer is unsupported here.
+
+        The pages are refcount-pinned for the duration of the device
+        gather so a concurrent admission can neither evict nor overwrite
+        them; the pin is dropped before returning."""
+        if not self.kv_transfer_supported():
+            return None
+        with self._alloc_lock:
+            pages, tokens = self.alloc.pin_prefix(token_ids)
+        if not pages:
+            return None
+        try:
+            with self.dispatch_lock:
+                # dispatch the gather only — it materializes its own
+                # device buffers, so the (slow, size-proportional)
+                # device→host copy below runs WITHOUT the lock and
+                # concurrent decode dispatch never stalls on an export
+                idx = jnp.asarray(pages, jnp.int32)
+                d = self.cfg.head_dim_
+                k_dev = self.cache.k[:, idx][..., :d]
+                v_dev = self.cache.v[:, idx][..., :d]
+            k = np.asarray(k_dev)
+            v = np.asarray(v_dev)
+        finally:
+            with self._alloc_lock:
+                self.alloc.unpin_pages(pages)
+        dpool = self.cache.k.shape[-1]
+        layout = (("ragged" if dpool == d else "ragged-padded")
+                  if self._ragged else "legacy")
+        return {
+            "tokens": [int(t) for t in token_ids[:tokens]],
+            "k": k, "v": v,
+            "model": self.cfg.name,
+            "kvLayout": layout,
+            "quant": self.config.quantize,
+        }
+
+    def import_prefix_pages(self, token_ids: list[int], k: np.ndarray,
+                            v: np.ndarray, meta: dict[str, Any]) -> int:
+        """Install migrated KV pages into this engine's pool and register
+        them in the content-addressed prefix cache (refcount allocator),
+        so the request's decode-side admission shares them via the normal
+        match_prefix warm path. Returns the number of tokens installed
+        (contiguous from position 0; may be shorter than offered under
+        pool pressure — a shorter prefix is still valid). Raises on any
+        geometry/dtype mismatch; the sender treats that as a NACK and
+        falls back to serving the request locally."""
+        if not self.kv_transfer_supported():
+            raise ValueError(
+                f"{self.cfg.name}: KV import unsupported here (prefix "
+                "cache off, sharded pool, or multi-host plan replay)")
+        mc, c = self.cfg, self.config
+        ps = c.page_size
+        kvh, dpool = self.cache.k.shape[3], self.cache.k.shape[4]
+        if int(meta["pageSize"]) != ps:
+            raise ValueError(
+                f"page-size mismatch: wire {meta['pageSize']} vs pool {ps}")
+        if (int(meta["numLayers"]) != mc.num_layers
+                or int(meta["kvHeads"]) != kvh
+                or int(meta["headDim"]) != mc.head_dim_):
+            raise ValueError(
+                f"pool geometry mismatch: wire L{meta['numLayers']}/"
+                f"H{meta['kvHeads']}/D{meta['headDim']} vs "
+                f"L{mc.num_layers}/H{kvh}/D{mc.head_dim_}")
+        if jnp.dtype(str(meta["dtype"])) != self.cache.k.dtype:
+            raise ValueError(
+                f"dtype mismatch: wire {meta['dtype']} vs pool "
+                f"{self.cache.k.dtype}")
+        n = min(int(k.shape[1]), len(token_ids) // ps)
+        keys = self.alloc.chain_keys(token_ids, n_pages=n)
+        # claim pool pages under the allocator lock; claimed pages come
+        # back PINNED and UNREGISTERED — the chain key only becomes
+        # matchable AFTER the device write lands, so a concurrent
+        # admission can never match (and decode over) an unwritten page
+        writes: list[tuple[int, int, bytes]] = []  # (page, wire idx, key)
+        installed = 0
+        with self._alloc_lock:
+            for i, key in enumerate(keys):
+                if self.alloc.peek_key(key) is not None:
+                    # identical content already cached here (possibly
+                    # pinned by a live request) — skip the write, keep it
+                    installed = i + 1
+                    continue
+                page = self.alloc.claim_page()
+                if page is None:
+                    break  # pool exhausted: keep the shorter prefix
+                writes.append((page, i, key))
+                installed = i + 1
+        if writes:
+            try:
+                self._write_imported_pages(
+                    [(p, i) for p, i, _ in writes], k, v, dpool)
+                with self._alloc_lock:
+                    for page, _i, key in writes:
+                        self.alloc.register_claimed(page, key)
+            finally:
+                with self._alloc_lock:
+                    self.alloc.unpin_pages([p for p, _, _ in writes])
+        self._update_kv_gauges()
+        _FLIGHTREC.record("engine", "kv_import", model=self.cfg.name,
+                          pagesInstalled=len(writes),
+                          pagesShared=installed - len(writes),
+                          tokens=installed * ps)
+        return installed * ps
+
+    _IMPORT_PAGE_BLOCK = 8  # pages per jitted install (fixed shape)
+
+    def _write_imported_pages(self, writes: list[tuple[int, int]],
+                              k: np.ndarray, v: np.ndarray,
+                              dpool: int) -> None:
+        """Scatter imported page data into the pool in fixed-size blocks
+        (sentinel-padded so ONE compiled program serves any count), with
+        buffer donation so the pool is updated in place."""
+        if dpool != k.shape[-1]:  # lane-padded pool: zero-pad the lanes
+            pad = [(0, 0)] * (k.ndim - 1) + [(0, dpool - k.shape[-1])]
+            k, v = np.pad(k, pad), np.pad(v, pad)
+        if self._kv_install_fn is None:
+            @partial(jax.jit, donate_argnums=(0, 1))
+            def install_fn(k_pages, v_pages, idx, k_new, v_new):
+                return (k_pages.at[:, idx].set(k_new, mode="drop"),
+                        v_pages.at[:, idx].set(v_new, mode="drop"))
+
+            # armable=False: imports legitimately first compile long after
+            # the engine arms (the first migration can land any time)
+            self._kv_install_fn = self.perf.wrap("kv_install", install_fn,
+                                                 armable=False)
+        block = self._IMPORT_PAGE_BLOCK
+        sentinel = self.config.num_pages  # out of bounds → mode="drop"
+        dt = self.cache.k.dtype
+        for s0 in range(0, len(writes), block):
+            grp = writes[s0:s0 + block]
+            idx = np.full((block,), sentinel, np.int32)
+            kb = np.zeros((k.shape[0], block) + k.shape[2:], dtype=k.dtype)
+            vb = np.zeros_like(kb)
+            for j, (page, src) in enumerate(grp):
+                idx[j] = page
+                kb[:, j] = k[:, src]
+                vb[:, j] = v[:, src]
+            with self.dispatch_lock:
+                new_k, new_v = self._kv_install_fn(
+                    self.cache.k, self.cache.v, jnp.asarray(idx),
+                    jnp.asarray(kb, dt), jnp.asarray(vb, dt))
+                self.cache = PagedKVCache(
+                    k=new_k, v=new_v, page_table=self.cache.page_table,
+                    lengths=self.cache.lengths,
+                    page_size=self.cache.page_size)
 
     @property
     def active_requests(self) -> int:

@@ -71,6 +71,8 @@ def build_routes(bus: MessageBus, registry: WorkerRegistry,
             detail.append({
                 "workerId": w.workerId,
                 "status": w.status,
+                "role": w.role,
+                "decodeSlotsFree": w.decodeSlotsFree,
                 "currentJobs": w.currentJobs,
                 "totalJobsProcessed": w.totalJobsProcessed,
                 "lastHeartbeat": w.lastHeartbeat,
@@ -81,7 +83,9 @@ def build_routes(bus: MessageBus, registry: WorkerRegistry,
                 "topology": (w.capabilities.topology.model_dump()
                              if w.capabilities.topology else None),
             })
-        return web.json_response({"workers": detail, "counts": registry.get_worker_count()})
+        return web.json_response({"workers": detail,
+                                  "counts": registry.get_worker_count(),
+                                  "roles": registry.role_counts()})
 
     async def jobs(request: web.Request) -> web.Response:
         return web.json_response({

@@ -790,22 +790,108 @@ class PageAllocator:
                     self._page_by_key[key] = page
                     self._key_of[page] = key
         for page in owned:
-            refs = self._refs.get(page, 1) - 1
-            if refs > 0:
-                self._refs[page] = refs
-                continue
-            self._refs.pop(page, None)
-            if page in self._key_of:
-                self._lru[page] = None  # most-recently released
-                cap = self.cache_pages
-                while cap > 0 and len(self._lru) > cap:
-                    old, _ = self._lru.popitem(last=False)
-                    self._drop_key(old)
-                    self.evictions += 1
-                    _PREFIX_EVICTIONS.inc(model=self.model)
-                    self._free.append(old)
-            else:
-                self._free.append(page)
+            self._release_page(page)
+
+    def _release_page(self, page: int) -> None:
+        """Drop one reference to `page`; at zero, park a registered page
+        in the reuse LRU (bounded by cache_pages) or return an
+        unregistered one to the free list. Shared by free() and
+        unpin_pages() so both sides of an export/import pin obey the
+        same refcount/LRU rules."""
+        refs = self._refs.get(page, 1) - 1
+        if refs > 0:
+            self._refs[page] = refs
+            return
+        self._refs.pop(page, None)
+        if page in self._key_of:
+            self._lru[page] = None  # most-recently released
+            cap = self.cache_pages
+            while cap > 0 and len(self._lru) > cap:
+                old, _ = self._lru.popitem(last=False)
+                self._drop_key(old)
+                self.evictions += 1
+                _PREFIX_EVICTIONS.inc(model=self.model)
+                self._free.append(old)
+        else:
+            self._free.append(page)
+
+    # -- KV-page migration (ISSUE 7) ----------------------------------------
+    #
+    # The transfer subsystem moves the cached full-page prefix of a prompt
+    # between workers. On the export side pin_prefix/unpin_pages bracket
+    # the device gather (a refcount pin keeps the pages from being evicted
+    # or handed to a fresh allocation mid-copy); on the import side
+    # install_page registers externally produced pages under their chain
+    # keys so the very next admission's match_prefix can share them.
+
+    def chain_keys(self, token_ids: list[int],
+                   n_pages: int | None = None) -> list[bytes]:
+        """Chain keys for the first `n_pages` FULL pages of token_ids.
+        Default cap is one page below len (the last token is always
+        recomputed — the same cap match_prefix applies, so export and a
+        later match agree on coverage); the import side passes the exact
+        page count its wire payload covers."""
+        ps = self.page_size
+        cap = (len(token_ids) - 1) // ps if n_pages is None else n_pages
+        cap = min(cap, len(token_ids) // ps)
+        keys: list[bytes] = []
+        key = b""
+        for i in range(cap):
+            key = _page_chain_key(key, token_ids[i * ps:(i + 1) * ps])
+            keys.append(key)
+        return keys
+
+    def pin_prefix(self, token_ids: list[int]) -> tuple[list[int], int]:
+        """Bump refcounts on the cached pages covering token_ids' longest
+        full-page prefix (no slot involved). Returns (pages, tokens
+        covered); release with unpin_pages. Pinned pages leave the
+        eviction LRU, so a concurrent admission cannot reclaim them."""
+        pages: list[int] = []
+        if self.cache_pages == 0:
+            return pages, 0
+        for key in self.chain_keys(token_ids):
+            page = self._page_by_key.get(key)
+            if page is None:
+                break
+            self._lru.pop(page, None)
+            self._refs[page] = self._refs.get(page, 0) + 1
+            pages.append(page)
+        return pages, len(pages) * self.page_size
+
+    def unpin_pages(self, pages: list[int]) -> None:
+        for page in pages:
+            self._release_page(page)
+
+    def peek_key(self, key: bytes) -> int | None:
+        """The page cached under `key`, if any (no state change)."""
+        return self._page_by_key.get(key)
+
+    def claim_page(self) -> int | None:
+        """Take a pool page for externally imported content, PINNED at
+        refcount 1 and deliberately UNREGISTERED: the chain key must not
+        become matchable until the page's KV data has actually landed on
+        the device (a concurrent admission matching an unwritten page
+        would silently decode over garbage). Callers write the data,
+        then register_claimed() + unpin_pages(). Returns None when the
+        pool has nothing reclaimable."""
+        if self.cache_pages == 0:
+            return None
+        page = self._take_page()
+        if page is None:
+            return None
+        self._refs[page] = 1
+        return page
+
+    def register_claimed(self, page: int, key: bytes) -> None:
+        """Publish a claimed page under its chain key AFTER its data was
+        written. If a concurrent import registered the same content
+        first, the first registration wins and this page stays
+        unregistered (it returns to the free list on unpin — exactly the
+        duplicate rule free() applies)."""
+        if key in self._page_by_key or page in self._key_of:
+            return
+        self._page_by_key[key] = page
+        self._key_of[page] = key
 
     def table_row(self, slot: int) -> list[int]:
         owned = self._owned.get(slot, [])

@@ -52,6 +52,7 @@ class WorkerRegistry(EventEmitter):
         self._running = False
         self.metrics: MetricsRegistry | None = None
         self._workers_gauge: Gauge | None = None
+        self._live_gauge: Gauge | None = None
         self._removed_total: Counter | None = None
 
     def attach_metrics(self, metrics: MetricsRegistry) -> None:
@@ -61,6 +62,12 @@ class WorkerRegistry(EventEmitter):
         self.metrics = metrics
         self._workers_gauge = metrics.gauge(
             "gridllm_workers", "Registered workers, by status.", ("status",))
+        self._live_gauge = metrics.gauge(
+            "gridllm_workers_live",
+            "Live (online or busy) workers, by fleet role "
+            "(unified/prefill/decode) — the disaggregated-serving pool "
+            "sizes (ISSUE 7).",
+            ("role",))
         self._removed_total = metrics.counter(
             "gridllm_workers_removed_total",
             "Workers removed from the registry, by reason "
@@ -76,6 +83,9 @@ class WorkerRegistry(EventEmitter):
             if status == "total":  # derivable; exporting it double-counts
                 continue           # every worker under sum(gridllm_workers)
             self._workers_gauge.set(n, status=status)
+        if self._live_gauge is not None:
+            for role, n in self.role_counts().items():
+                self._live_gauge.set(n, role=role)
 
     # -- lifecycle ----------------------------------------------------------
     async def initialize(self) -> None:
@@ -182,6 +192,20 @@ class WorkerRegistry(EventEmitter):
         if isinstance(prefixes, list):
             # keys arrive oldest→newest; keep the newest when truncating
             info.cachedPrefixes = [str(k) for k in prefixes[-64:]]
+        # Disaggregated serving (ISSUE 7): role, decode-slot headroom,
+        # and the worker-to-worker transfer address ride every heartbeat
+        # so the scheduler's pool split and the KV sender's HTTP fallback
+        # both work from live data
+        role = data.get("role")
+        if role in ("unified", "prefill", "decode"):
+            info.role = role
+        if "decodeSlotsFree" in data:
+            try:
+                info.decodeSlotsFree = max(int(data["decodeSlotsFree"]), 0)
+            except (TypeError, ValueError):
+                pass
+        if data.get("httpAddr"):
+            info.httpAddr = str(data["httpAddr"])
         # Persist so a restarted server doesn't see a stale lastHeartbeat and
         # evict live workers (reference hsets every beat too).
         await self.bus.hset(WORKERS_KEY, worker_id, info.model_dump_json())
@@ -339,6 +363,15 @@ class WorkerRegistry(EventEmitter):
             entry["gridllm_metadata"] = {"num_workers_with_model": n}
             out.append(entry)
         return out
+
+    def role_counts(self) -> dict[str, int]:
+        """Live (online/busy) workers per fleet role (ISSUE 7) — the one
+        source for both the gridllm_workers_live gauge and the
+        /health/workers roles block."""
+        live = {"unified": 0, "prefill": 0, "decode": 0}
+        for w in self.get_online_workers():
+            live[w.role] = live.get(w.role, 0) + 1
+        return live
 
     def get_worker_count(self) -> dict[str, int]:
         all_w = list(self.workers.values())

@@ -149,6 +149,18 @@ class JobScheduler(EventEmitter):
         self.metrics.add_collector("scheduler", self._collect_gauges)
         registry.attach_metrics(self.metrics)
         self._queue_spans: dict[str, Any] = {}  # jobId → open queue span
+        # Disaggregated serving (ISSUE 7): jobs placed with a planned
+        # prefill→decode handoff, jobId → {"from", "to", "at"}. Entries
+        # clear on handoff/fallback/terminal events; a job orphaned while
+        # still here died MID-MIGRATION and takes the migration_lost path
+        # (KV release on both workers + front requeue).
+        self._migrations: dict[str, dict[str, Any]] = {}
+        self._disagg_total = self.metrics.counter(
+            "gridllm_disagg_jobs_total",
+            "Disaggregated-placement lifecycle events (planned/handoff/"
+            "fallback/migration_lost/handoff_worker_lost/cross_role).",
+            ("event",),
+        )
         # interpretation layer (ISSUE 2): SLO judgments on the same
         # registry, the hang watchdog sweeping this scheduler's state
         # (started in initialize), and the process flight recorder
@@ -167,6 +179,7 @@ class JobScheduler(EventEmitter):
             ("job:completed", self._on_job_completed),
             ("job:failed", self._on_job_failed),
             ("job:timeout", self._on_job_timeout_report),
+            ("job:handoff", self._on_handoff),
         ]:
             self._subs.append(await self.bus.subscribe(channel, handler))
         # worker-side span timelines arrive on trace:{request_id}; merging
@@ -424,6 +437,7 @@ class JobScheduler(EventEmitter):
         JobScheduler.ts:874-908). The cancelled-set guards the race where a
         dispatch pass already snapshotted the queued job."""
         self._cancelled[job_id] = time.time()
+        self._migrations.pop(job_id, None)
 
         def account() -> None:
             # a cancel with reason="timeout" is the waiter-side timeout
@@ -539,7 +553,7 @@ class JobScheduler(EventEmitter):
                     await self.bus.hdel(JOB_QUEUE_KEY, qj.request.id)
                     self._end_queue_span(qj.request.id, cancelled=True)
                     continue
-                worker = self._select_worker(qj.request)
+                worker, disagg = self._plan_placement(qj.request)
                 if worker is None:
                     owners = self.registry.get_workers_with_model(qj.request.model)
                     if not owners:
@@ -551,16 +565,61 @@ class JobScheduler(EventEmitter):
                             log.warning("no worker serves model; job held",
                                         job_id=qj.request.id, model=qj.request.model)
                     continue
-                if await self._assign_job(qj, worker):
+                if await self._assign_job(qj, worker, disagg=disagg):
                     assigned_ids.add(qj.request.id)
             if assigned_ids:
                 # jobs added during assignment awaits stay for the next pass
                 self.job_queue = [qj for qj in self.job_queue
                                   if qj.request.id not in assigned_ids]
 
-    def _select_worker(self, request: InferenceRequest) -> WorkerInfo | None:
+    def _plan_placement(
+        self, request: InferenceRequest
+    ) -> tuple[WorkerInfo | None, dict[str, Any] | None]:
+        """(worker, disagg-plan) for one queued job (ISSUE 7).
+
+        Two-phase placement: when the fleet has BOTH a prefill pool and a
+        decode pool for the model and the job is a plain generation, the
+        job goes to a prefill worker with a pre-planned decode target
+        stamped in the plan — the prefill worker migrates the finished KV
+        pages there and the scheduler hands the assignment off on
+        ``job:handoff``. Anything else (embeddings, image requests,
+        homogeneous fleets, disagg disabled) takes whole-request
+        placement; a requeued copy of a decode-phase job replans from
+        scratch (its imported pages may be anywhere by now)."""
+        md = request.metadata or {}
+        md.pop("disagg", None)       # requeue hygiene: stale plans never
+        md.pop("disaggPhase", None)  # survive a fresh placement pass
+        # same image collection the worker's collect_images() applies:
+        # top-level (generate path) AND per-message (chat path) — a
+        # vision request can never migrate, so it must not be planned
+        has_images = bool(request.images) or any(
+            m.get("images") for m in request.messages or [])
+        generation = (request.request_type in ("inference", "chat", "generate")
+                      and not has_images)
+        if self.config.disagg_enabled and generation:
+            pre = self._select_worker(request, role="prefill")
+            dec = self._select_worker(request, role="decode")
+            if pre is not None and dec is not None:
+                return pre, {
+                    "decodeWorkerId": dec.workerId,
+                    "decodeAddr": dec.httpAddr or "",
+                }
+        return self._select_worker(request), None
+
+    def _select_worker(self, request: InferenceRequest,
+                       role: str | None = None) -> WorkerInfo | None:
         """Topology-aware selection (reference baseline: least-loaded then
         tier, JobScheduler.ts:317-360; TPU extension per SURVEY.md §2.6).
+
+        Role strictness (ISSUE 7): candidates are filtered to the asked
+        pool BEFORE scoring — cross-role placement is refused, never
+        silently scored. ``role=None`` (whole-request placement) serves
+        from the unified pool; when no unified worker exists the prefill
+        pool substitutes (a prefill worker can always finish a request
+        locally — that is the disagg fallback contract), and a
+        decode-only fleet substitutes last, both counted under
+        ``gridllm_disagg_jobs_total{event="cross_role"}`` so a
+        misconfigured fleet is visible rather than wedged.
 
         Order of discrimination:
         1. context fit — a worker whose layout for this model cannot hold
@@ -578,6 +637,20 @@ class JobScheduler(EventEmitter):
         4. performance tier.
         """
         candidates = self.registry.get_available_workers_by_model(request.model)
+        if role in ("prefill", "decode"):
+            candidates = [w for w in candidates if w.role == role]
+        else:
+            by_role: dict[str, list[WorkerInfo]] = {}
+            for w in candidates:
+                by_role.setdefault(w.role, []).append(w)
+            if by_role.get("unified"):
+                candidates = by_role["unified"]
+            elif by_role.get("prefill") or by_role.get("decode"):
+                candidates = (by_role.get("prefill")
+                              or by_role.get("decode") or [])
+                self._disagg_total.inc(event="cross_role")
+            else:
+                candidates = []
         if not candidates:
             return None
         opts = request.options or {}
@@ -589,7 +662,7 @@ class JobScheduler(EventEmitter):
         prefix_key = (request.metadata or {}).get("prefixKey")
         affinity_w = self.config.prefix_affinity_weight
 
-        def score(w: WorkerInfo) -> tuple[int, float, int, int]:
+        def score(w: WorkerInfo) -> tuple[int, float, int, int, int]:
             caps = w.capabilities
             layout = next(
                 (l for l in caps.shardLayouts if l.name == request.model), None
@@ -599,16 +672,22 @@ class JobScheduler(EventEmitter):
             load = w.currentJobs / max(caps.maxConcurrentTasks, 1)
             if prefix_key and affinity_w and prefix_key in w.cachedPrefixes:
                 load -= affinity_w
+            # decode-pool placement prefers the worker with the most open
+            # batch slots (heartbeat-advertised headroom, ISSUE 7) — the
+            # prefill pool orders purely by queue depth via `load`
+            headroom = w.decodeSlotsFree if role == "decode" else 0
             return (
                 0 if ctx_ok else 1,
                 load,
+                -headroom,
                 -slots,
                 _TIER_RANK.get(caps.performanceTier, 1),
             )
 
         return min(candidates, key=score)
 
-    async def _assign_job(self, qj: _QueuedJob, worker: WorkerInfo) -> bool:
+    async def _assign_job(self, qj: _QueuedJob, worker: WorkerInfo,
+                          disagg: dict[str, Any] | None = None) -> bool:
         """reference: JobScheduler.ts:362-432."""
         # staleness re-check right before assignment (:368-386)
         fresh = self.registry.get_worker(worker.workerId)
@@ -619,6 +698,18 @@ class JobScheduler(EventEmitter):
             return False
 
         request = qj.request
+        if disagg is not None:
+            # two-phase placement (ISSUE 7): the prefill worker reads the
+            # decode target from metadata; the migration record makes the
+            # orphan path release KV state on BOTH workers if the job dies
+            # before the handoff resolves
+            request.metadata["disagg"] = dict(disagg)
+            self._migrations[request.id] = {
+                "from": worker.workerId,
+                "to": disagg["decodeWorkerId"],
+                "at": time.time(),
+            }
+            self._disagg_total.inc(event="planned")
         timeout_ms = request.timeout or self.config.job_timeout_ms
         assignment = JobAssignment(
             jobId=request.id, workerId=worker.workerId,
@@ -682,6 +773,7 @@ class JobScheduler(EventEmitter):
                     "scheduler", "duplicate_completion",
                     job=result.jobId, worker=result.workerId, tokens=wasted)
             return
+        self._migrations.pop(result.jobId, None)
         await self._clear_active(result.jobId, free_worker=True)
         self._jobs_total.inc(event="completed")
         log.job("job completed", result.jobId, worker_id=result.workerId,
@@ -700,6 +792,7 @@ class JobScheduler(EventEmitter):
         assignment = self.active_jobs.get(result.jobId)
         if assignment is None:
             return
+        self._migrations.pop(result.jobId, None)
         await self._clear_active(result.jobId, free_worker=True)
         request = assignment.request
         if result.nack:
@@ -774,6 +867,7 @@ class JobScheduler(EventEmitter):
         assignment = self.active_jobs.pop(job_id, None)
         if assignment is None:
             return  # already completed/cancelled — benign
+        self._migrations.pop(job_id, None)
         self._jobs_total.inc(event="timeout")
         self.flightrec.record("scheduler", "timeout", job=job_id,
                               worker=assignment.workerId)
@@ -795,6 +889,112 @@ class JobScheduler(EventEmitter):
         await self.bus.publish(f"job:result:{job_id}", result.model_dump_json())
         self.emit("job_timeout", result)
         self.request_dispatch()
+
+    # -- disaggregated handoff (ISSUE 7) ------------------------------------
+    async def _on_handoff(self, _ch: str, raw: str) -> None:
+        """``job:handoff`` from a prefill worker after its KV migration
+        resolved. ok=True → move the live assignment to the planned
+        decode worker and dispatch the decode phase (the request now
+        carries ``disaggPhase=decode``; the decode engine admits warm
+        from the imported pages). ok=False → the prefill worker is
+        already serving the request locally (graceful degradation) and
+        this message only accounts the fallback."""
+        try:
+            data = json.loads(raw)
+            job_id = data["jobId"]
+        except Exception:
+            return
+        from_worker = str(data.get("fromWorker") or "")
+        mig = self._migrations.get(job_id)
+        if mig is not None and mig.get("from") != from_worker:
+            # stale handoff from a PREVIOUS placement (the job was
+            # orphaned and replanned meanwhile): the live migration
+            # record belongs to the new placement and must survive
+            return
+        ok = bool(data.get("ok"))
+        if not ok:
+            self._migrations.pop(job_id, None)
+            self._disagg_total.inc(event="fallback")
+            self.flightrec.record(
+                "scheduler", "disagg_fallback", job=job_id,
+                worker=from_worker,
+                reason=str(data.get("reason") or "")[:120])
+            self.tracer.event(job_id, "scheduler.disagg_fallback",
+                              reason=str(data.get("reason") or ""))
+            # the decode worker prepared a receiver that will never see
+            # (the rest of) the stream — release its assembly state so a
+            # failed transfer cannot leak buffers there
+            to_worker = str(data.get("toWorker")
+                            or (mig or {}).get("to") or "")
+            if to_worker:
+                try:
+                    await self.bus.publish(
+                        f"worker:{to_worker}:job",
+                        json.dumps({"type": "kv_release", "jobId": job_id}))
+                except Exception as e:  # noqa: BLE001 — best-effort
+                    log.warning("kv_release publish failed", job_id=job_id,
+                                worker=to_worker, error=str(e))
+            return
+        assignment = self.active_jobs.get(job_id)
+        if assignment is None or assignment.workerId != from_worker:
+            return  # resolved/cancelled meanwhile — stale handoff
+        self._migrations.pop(job_id, None)
+        to_worker = str(data.get("toWorker")
+                        or (mig or {}).get("to") or "")
+        self._disagg_total.inc(event="handoff")
+        self.tracer.event(
+            job_id, "scheduler.handoff",
+            fromWorker=assignment.workerId, toWorker=to_worker,
+            migratedTokens=int(data.get("tokens") or 0),
+            bytes=int(data.get("bytes") or 0),
+            transferMs=round(float(data.get("seconds") or 0) * 1000, 2),
+            path=str(data.get("path") or ""))
+        # release the prefill half: worker freed, timeout disarmed; the
+        # decode assignment below re-arms with the job's full budget
+        await self._clear_active(job_id, free_worker=True,
+                                 assignment=assignment)
+        if job_id in self._cancelled:
+            # cancelled during the await above: cancel_job found the job
+            # in no collection (we had just popped it) and accounted the
+            # cancellation — re-adding would resurrect a dead job onto
+            # the decode pool with nobody listening
+            return
+        target = self.registry.get_worker(to_worker)
+        if target is None or target.status not in ("online", "busy"):
+            # decode worker vanished after acking the import: its copy of
+            # the pages died with it — requeue through the migration_lost
+            # path (the prefill worker still holds a cached copy, so a
+            # re-placement there is warm)
+            self._disagg_total.inc(event="handoff_worker_lost")
+            await self._orphan_job(assignment, reason="migration_lost")
+            self.request_dispatch()
+            return
+        request = assignment.request
+        request.metadata["disaggPhase"] = "decode"
+        request.metadata["kvxTokens"] = int(data.get("tokens") or 0)
+        handoff = JobAssignment(
+            jobId=job_id, workerId=to_worker, request=request,
+            timeout=assignment.timeout,
+        )
+        self.active_jobs[job_id] = handoff
+        await self.bus.hset(ACTIVE_JOBS_KEY, job_id,
+                            handoff.model_dump_json())
+        await self.registry.mark_worker_busy(to_worker)
+        await self.bus.publish(
+            f"worker:{to_worker}:job",
+            json.dumps({"type": "job_assignment",
+                        "job": handoff.model_dump(mode="json")}),
+        )
+        self._arm_timeout(handoff, remaining_ms=handoff.timeout)
+        self._assignments.inc(worker=to_worker)
+        self.flightrec.record("scheduler", "handoff", job=job_id,
+                              fromWorker=data.get("fromWorker"),
+                              toWorker=to_worker,
+                              tokens=int(data.get("tokens") or 0))
+        log.job("job handed off to decode worker", job_id,
+                from_worker=str(data.get("fromWorker")),
+                worker_id=to_worker)
+        self.emit("job_assigned", handoff)
 
     async def _drop_resolved(self, job_id: str) -> bool:
         """Remove every pending copy of a job whose result has already been
@@ -827,8 +1027,31 @@ class JobScheduler(EventEmitter):
 
     async def _orphan_job(self, assignment: JobAssignment, reason: str) -> None:
         """Promote to high priority, requeue at the FRONT, record audit
-        metadata (reference: JobScheduler.ts:259-315)."""
+        metadata (reference: JobScheduler.ts:259-315).
+
+        Mid-migration deaths (ISSUE 7): a job still carrying a live
+        migration record died between its prefill placement and the
+        handoff. Both ends must drop their KV-transfer state — the
+        prefill worker's in-flight send, the decode worker's partially
+        assembled import — BEFORE the requeue, or a late chunk stream
+        could ghost into the retried job's transfer. The requeue reason
+        becomes ``migration_lost`` and the stale plan is stripped so the
+        fresh placement replans from live registry state."""
         job_id = assignment.jobId
+        mig = self._migrations.pop(job_id, None)
+        if mig is not None:
+            reason = "migration_lost"
+            self._disagg_total.inc(event="migration_lost")
+            self.flightrec.record("scheduler", "migration_lost", job=job_id,
+                                  fromWorker=mig["from"], toWorker=mig["to"])
+            for wid in {mig["from"], mig["to"]}:
+                try:
+                    await self.bus.publish(
+                        f"worker:{wid}:job",
+                        json.dumps({"type": "kv_release", "jobId": job_id}))
+                except Exception as e:  # noqa: BLE001 — best-effort release
+                    log.warning("kv_release publish failed", job_id=job_id,
+                                worker=wid, error=str(e))
         await self._clear_active(job_id, free_worker=False)
         # mark the loss on the trace BEFORE the requeue opens fresh spans:
         # the dead worker will never publish its half of the timeline, and
@@ -841,6 +1064,8 @@ class JobScheduler(EventEmitter):
         request = assignment.request
         request.priority = Priority.high
         md = request.metadata
+        md.pop("disagg", None)       # stale plan: the fresh dispatch pass
+        md.pop("disaggPhase", None)  # replans against live pools
         md["orphaned"] = True
         md["originalWorkerId"] = assignment.workerId
         md["orphanedAt"] = time.time()

@@ -1,0 +1,42 @@
+"""KV-page migration for disaggregated prefill/decode serving (ISSUE 7).
+
+``wire``: versioned, chunked, checksummed serialization of paged-KV
+state; ``migrate``: the sender/receiver protocol over the bus (with a
+direct worker-to-worker HTTP fallback for large transfers) plus the
+migration metrics. The engine-side export/import lives on
+``InferenceEngine`` (export_prefix_pages / import_prefix_pages); the
+control flow (two-phase placement, handoff, fallback) in
+scheduler/scheduler.py and worker/service.py.
+"""
+
+from gridllm_tpu.transfer.migrate import (
+    KVImportManager,
+    ack_key,
+    kvx_channel,
+    kvx_settings,
+    ready_key,
+    recv_key,
+    send_kv,
+)
+from gridllm_tpu.transfer.wire import (
+    WIRE_VERSION,
+    Assembler,
+    WireError,
+    build_header,
+    iter_chunks,
+)
+
+__all__ = [
+    "KVImportManager",
+    "Assembler",
+    "WireError",
+    "WIRE_VERSION",
+    "ack_key",
+    "build_header",
+    "iter_chunks",
+    "kvx_channel",
+    "kvx_settings",
+    "ready_key",
+    "recv_key",
+    "send_kv",
+]

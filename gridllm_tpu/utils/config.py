@@ -19,7 +19,7 @@ from __future__ import annotations
 
 import os
 import uuid
-from typing import Any
+from typing import Any, Literal
 
 from pydantic import BaseModel, Field, ValidationError
 
@@ -73,6 +73,13 @@ class SchedulerConfig(BaseModel):
     # outweighs load differences up to this fraction, so a hot worker
     # still sheds. 0 disables the term.
     prefix_affinity_weight: float = Field(0.25, ge=0)
+    # Disaggregated prefill/decode serving (ISSUE 7): when the fleet has
+    # BOTH a prefill pool and a decode pool for a model, generation jobs
+    # get two-phase placement (prefill worker + planned decode handoff
+    # with KV-page migration). Default on — with a homogeneous unified
+    # fleet there are no pools, so nothing changes. GRIDLLM_DISAGG=0
+    # forces whole-request placement even on a split fleet.
+    disagg_enabled: bool = True
 
 
 class GatewayConfig(BaseModel):
@@ -122,6 +129,16 @@ class WorkerConfig(BaseModel):
     max_reconnect_attempts: int = 10
     max_concurrent_tasks: int = 1      # superseded by engine.max_batch_slots when engine present
     performance_tier: str = "medium"
+    # Disaggregated serving (ISSUE 7): this worker's fleet role
+    # (GRIDLLM_WORKER_ROLE). "prefill" workers take phase-1 placements
+    # and export KV; "decode" workers admit from imported pages;
+    # "unified" (default) serves whole requests as before.
+    role: Literal["unified", "prefill", "decode"] = "unified"
+    # host:port other workers can reach this worker's health HTTP server
+    # at (GRIDLLM_WORKER_ADVERTISE_ADDR) — the direct worker-to-worker
+    # KV-transfer fallback path. "" → 127.0.0.1:{port} (single-host
+    # deployments and tests).
+    advertise_addr: str = ""
 
 
 class SLOClassConfig(BaseModel):
@@ -254,6 +271,7 @@ def load_config() -> Config:
                 sweep_interval_ms=_env("SCHEDULER_SWEEP_INTERVAL", 1_000),
                 prefix_affinity_weight=_env(
                     "GRIDLLM_PREFIX_AFFINITY_WEIGHT", 0.25),
+                disagg_enabled=_env("GRIDLLM_DISAGG", True),
             ),
             gateway=GatewayConfig(
                 host=_env("HOST", "0.0.0.0"),
@@ -271,6 +289,8 @@ def load_config() -> Config:
                 max_reconnect_attempts=_env("MAX_RECONNECT_ATTEMPTS", 10),
                 max_concurrent_tasks=_env("MAX_CONCURRENT_TASKS", 1),
                 performance_tier=_env("PERFORMANCE_TIER", "medium"),
+                role=_env("GRIDLLM_WORKER_ROLE", "unified"),
+                advertise_addr=_env("GRIDLLM_WORKER_ADVERTISE_ADDR", ""),
             ),
             engine=EngineConfig(
                 models=_env("GRIDLLM_MODELS", ""),

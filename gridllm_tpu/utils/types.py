@@ -131,6 +131,19 @@ class WorkerInfo(_Model):
     # heartbeats; the scheduler scores cached-prefix overlap against a
     # job's metadata.prefixKey (prefix-affinity routing).
     cachedPrefixes: list[str] = Field(default_factory=list)
+    # Disaggregated serving (ISSUE 7): the worker's advertised fleet role.
+    # "unified" serves whole requests (today's behavior); "prefill"
+    # workers take phase-1 placements and migrate finished KV pages out;
+    # "decode" workers take the handoff and run generation from imported
+    # pages. Placement is role-strict (scheduler._select_worker) — a
+    # homogeneous unified fleet behaves exactly as before.
+    role: Literal["unified", "prefill", "decode"] = "unified"
+    # decode-slot headroom (open engine batch slots) from the latest
+    # heartbeat — the decode-pool placement tiebreaker
+    decodeSlotsFree: int = 0
+    # host:port of the worker's health HTTP server, for the direct
+    # worker-to-worker KV transfer fallback (large payloads)
+    httpAddr: str = ""
 
     def model_names(self) -> list[str]:
         return [m.name for m in self.capabilities.availableModels]

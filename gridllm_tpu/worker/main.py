@@ -108,7 +108,10 @@ def build_engines(config: Config) -> dict[str, InferenceEngine]:
 def build_health_app(service: WorkerService) -> web.Application:
     """reference: client/src/routes/health.ts:8-59 + /worker/status
     (client/src/index.ts:75-82)."""
-    app = web.Application()
+    # client_max_size: the /kvx/ migration route receives whole KV
+    # payloads in one POST (aiohttp's 1 MB default would 413 any real
+    # transfer — that is exactly the path chosen for LARGE payloads)
+    app = web.Application(client_max_size=1024**3)
     started = iso_now()
 
     async def health(_):
@@ -185,12 +188,25 @@ def build_health_app(service: WorkerService) -> web.Application:
             handle_profile_request, request.query.get("seconds"))
         return web.json_response(payload, status=status)
 
+    async def kvx(request):
+        # direct worker-to-worker KV migration (ISSUE 7): the whole wire
+        # payload in one POST — the large-transfer fast path that skips
+        # the bus. The header arrived via the bus prepare message; an
+        # unknown request id means no prepare was seen and the sender
+        # falls back to bus chunks (or local serving).
+        rid = request.match_info["request_id"]
+        body = await request.read()
+        result = await service.kvx.feed_http(rid, body)
+        return web.json_response(result,
+                                 status=200 if result.get("ok") else 409)
+
     app.add_routes([
         web.get("/health", health), web.get("/health/live", live),
         web.get("/health/ready", ready), web.get("/health/system", system),
         web.get("/worker/status", status), web.get("/metrics", metrics),
         web.get("/admin/dump", dump), web.get("/admin/memory", memory),
         web.post("/admin/profile", profile),
+        web.post("/kvx/{request_id}", kvx),
     ])
     return app
 

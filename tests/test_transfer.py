@@ -1,0 +1,335 @@
+"""KV wire-format and engine export/import units (ISSUE 7 satellite).
+
+Round-trips across page-boundary straddles, unpadded vs lane-padded
+pools, int8-quant engines, and refcount safety when an imported prefix
+overlaps already-cached pages (no double-free, pinned pages stay
+pinned)."""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+import pytest
+
+from gridllm_tpu.engine import EngineConfig, InferenceEngine
+from gridllm_tpu.engine.engine import GenerationRequest
+from gridllm_tpu.transfer import (
+    Assembler,
+    WireError,
+    build_header,
+    iter_chunks,
+)
+
+PS = 8  # page size used throughout
+
+
+def make_engine(**kw) -> InferenceEngine:
+    cfg = dict(
+        model="tiny-llama", max_slots=2, page_size=PS, num_pages=64,
+        max_pages_per_slot=16, prefill_buckets=(16, 64, 128), seed=42,
+        prefill_chunk=16,
+    )
+    cfg.update(kw)
+    return InferenceEngine(EngineConfig(**cfg))
+
+
+def greedy(engine, rid, prompt, n=12, export_only=False, **opts):
+    return engine.generate(GenerationRequest(
+        id=rid, prompt=prompt,
+        options={"temperature": 0, "num_predict": n, **opts},
+        export_only=export_only,
+    ))
+
+
+def roundtrip(header, payload, chunked=True):
+    asm = Assembler(header)
+    if chunked:
+        for _seq, frame in iter_chunks(header, payload):
+            asm.feed(frame)
+    else:
+        asm.feed_raw(payload)
+    return asm.arrays()
+
+
+def migrate(src: InferenceEngine, dst: InferenceEngine, prompt: str,
+            chunked=True, chunk_bytes=512) -> int:
+    """Export prompt's cached prefix from src, wire round-trip, import
+    into dst. Returns imported token count."""
+    ids = src.tokenizer.encode(prompt, add_bos=True)
+    export = src.export_prefix_pages(ids)
+    assert export is not None
+    header, payload = build_header(
+        "t1", "tiny-llama", export["tokens"], export["k"], export["v"],
+        kv_layout=export["kvLayout"], quant=export["quant"],
+        chunk_bytes=chunk_bytes)
+    tokens, k, v = roundtrip(header, payload, chunked=chunked)
+    assert tokens == export["tokens"]
+    return dst.import_prefix_pages(tokens, k, v, header)
+
+
+# ---------------------------------------------------------------- wire units
+
+
+class TestWireFormat:
+    def _hp(self, n_pages=3, chunk_bytes=64):
+        rng = np.random.default_rng(0)
+        k = rng.standard_normal((2, n_pages, PS, 2, 16)).astype(np.float32)
+        v = rng.standard_normal((2, n_pages, PS, 2, 16)).astype(np.float32)
+        tokens = list(range(n_pages * PS))
+        header, payload = build_header("r1", "m", tokens, k, v,
+                                       chunk_bytes=chunk_bytes)
+        return header, payload, k, v
+
+    def test_roundtrip_chunked(self):
+        header, payload, k, v = self._hp()
+        tokens, k2, v2 = roundtrip(header, payload)
+        assert tokens == list(range(3 * PS))
+        np.testing.assert_array_equal(k, k2)
+        np.testing.assert_array_equal(v, v2)
+
+    def test_roundtrip_http_raw(self):
+        header, payload, k, v = self._hp()
+        _t, k2, v2 = roundtrip(header, payload, chunked=False)
+        np.testing.assert_array_equal(k, k2)
+        np.testing.assert_array_equal(v, v2)
+
+    def test_duplicate_and_out_of_order_chunks(self):
+        header, payload, k, _v = self._hp(chunk_bytes=100)
+        frames = [f for _s, f in iter_chunks(header, payload)]
+        asm = Assembler(header)
+        for f in reversed(frames):  # out of order
+            asm.feed(f)
+        for f in frames:            # duplicates ignored
+            asm.feed(f)
+        _t, k2, _v2 = asm.arrays()
+        np.testing.assert_array_equal(k, k2)
+
+    def test_crc_mismatch_raises(self):
+        header, payload, *_ = self._hp(chunk_bytes=100)
+        frames = [f for _s, f in iter_chunks(header, payload)]
+        rec = json.loads(frames[1])
+        rec["crc"] = (rec["crc"] + 1) & 0xFFFFFFFF
+        asm = Assembler(header)
+        with pytest.raises(WireError, match="crc"):
+            asm.feed(json.dumps(rec))
+
+    def test_digest_mismatch_raises(self):
+        header, payload, *_ = self._hp()
+        asm = Assembler(header)
+        asm.feed_raw(payload[:-4] + b"\x00\x00\x00\x00")
+        with pytest.raises(WireError):
+            asm.arrays()
+
+    def test_incomplete_raises(self):
+        header, payload, *_ = self._hp(chunk_bytes=100)
+        asm = Assembler(header)
+        asm.feed(next(iter_chunks(header, payload))[1])
+        assert not asm.complete
+        with pytest.raises(WireError, match="incomplete"):
+            asm.payload()
+
+    def test_contiguous_progress(self):
+        header, payload, *_ = self._hp(chunk_bytes=50)
+        frames = list(iter_chunks(header, payload))
+        asm = Assembler(header)
+        asm.feed(frames[2][1])
+        assert asm.contiguous == 0  # gap at 0
+        asm.feed(frames[0][1])
+        assert asm.contiguous == 1
+        asm.feed(frames[1][1])
+        assert asm.contiguous == 3
+
+    def test_bad_version_rejected(self):
+        header, _p, *_ = self._hp()
+        header["v"] = 99
+        with pytest.raises(WireError, match="version"):
+            Assembler(header)
+
+
+# ------------------------------------------------------ engine export/import
+
+
+@pytest.fixture(scope="module")
+def engines():
+    """One source + one destination engine shared by the round-trip
+    cases (module-scoped: tiny-model compiles dominate test wall time)."""
+    return make_engine(), make_engine()
+
+
+class TestEngineRoundTrip:
+    @pytest.mark.parametrize("extra", [0, 1, PS - 1, PS])
+    def test_page_boundary_straddles(self, engines, extra):
+        """Prompts landing exactly on / one past / one short of a page
+        boundary all export the full pages strictly below len-1 and
+        reproduce the unified greedy stream on the import side."""
+        src, dst = engines
+        base = "straddle test of the quick brown fox "
+        prompt = (base * 8)[: 5 * 7 + extra]  # vary length around pages
+        rid = f"pb-{extra}"
+        r_uni = greedy(make_engine(), rid + "-u", prompt)
+        r_exp = greedy(src, rid + "-e", prompt, export_only=True)
+        assert r_exp.done_reason == "export"
+        ids = r_exp.context[:-1]
+        export = src.export_prefix_pages(ids)
+        assert export is not None
+        # coverage = full pages strictly below the last prompt token
+        assert len(export["tokens"]) == ((len(ids) - 1) // PS) * PS
+        header, payload = build_header(
+            rid, "tiny-llama", export["tokens"], export["k"], export["v"])
+        tokens, k, v = roundtrip(header, payload)
+        n = dst.import_prefix_pages(tokens, k, v, header)
+        assert n == len(export["tokens"])
+        r_mig = greedy(dst, rid + "-d", prompt)
+        assert r_mig.token_ids == r_uni.token_ids
+        assert r_mig.cached_tokens == n
+
+    def test_lane_padded_pool_roundtrip(self, monkeypatch):
+        """A lane-padded destination pool (kernel-path d<128 models)
+        accepts the UNPADDED wire data — import re-pads the lanes; the
+        decode stream still matches an unpadded engine's."""
+        prompt = "lane padded pool migration check " * 3
+        r_uni = greedy(make_engine(), "lp-u", prompt)
+        src = make_engine()
+        greedy(src, "lp-e", prompt, export_only=True)
+        ids = src.tokenizer.encode(prompt, add_bos=True)
+        export = src.export_prefix_pages(ids)
+        d = export["k"].shape[-1]
+        monkeypatch.setattr(InferenceEngine, "_pool_head_dim",
+                            lambda self: 128)
+        dst = make_engine()
+        assert dst.cache.k.shape[-1] == 128 > d  # really padded
+        header, payload = build_header(
+            "lp", "tiny-llama", export["tokens"], export["k"], export["v"])
+        tokens, k, v = roundtrip(header, payload)
+        n = dst.import_prefix_pages(tokens, k, v, header)
+        assert n == len(tokens)
+        # padded lanes beyond d must be zero (the write kernels' contract)
+        import jax.numpy as jnp
+
+        pad_region = np.asarray(dst.cache.k[..., d:], dtype=jnp.float32)
+        assert float(np.abs(pad_region).max()) == 0.0
+        r_mig = greedy(dst, "lp-d", prompt)
+        assert r_mig.token_ids == r_uni.token_ids
+
+    def test_int8_quant_engine_roundtrip(self):
+        """Weight-only int8 engines migrate KV like any other — the pool
+        dtype is the engine dtype, quant rides the header as metadata."""
+        q = dict(quantize="int8")
+        r_uni = greedy(make_engine(**q), "q-u", "int8 quant migration " * 4)
+        src, dst = make_engine(**q), make_engine(**q)
+        prompt = "int8 quant migration " * 4
+        greedy(src, "q-e", prompt, export_only=True)
+        ids = src.tokenizer.encode(prompt, add_bos=True)
+        export = src.export_prefix_pages(ids)
+        assert export["quant"] == "int8"
+        n = migrate(src, dst, prompt)
+        assert n > 0
+        r_mig = greedy(dst, "q-d", prompt)
+        assert r_mig.token_ids == r_uni.token_ids
+
+    def test_dtype_mismatch_rejected(self, engines):
+        src, _dst = engines
+        prompt = "dtype mismatch check " * 4
+        greedy(src, "dm-e", prompt, export_only=True)
+        ids = src.tokenizer.encode(prompt, add_bos=True)
+        export = src.export_prefix_pages(ids)
+        header, payload = build_header(
+            "dm", "tiny-llama", export["tokens"],
+            export["k"].astype(np.float32), export["v"].astype(np.float32))
+        tokens, k, v = roundtrip(header, payload)
+        dst = make_engine()
+        with pytest.raises(ValueError, match="dtype"):
+            dst.import_prefix_pages(tokens, k, v, header)
+
+    def test_geometry_mismatch_rejected(self, engines):
+        src, _dst = engines
+        prompt = "geometry mismatch check " * 4
+        greedy(src, "gm-e", prompt, export_only=True)
+        ids = src.tokenizer.encode(prompt, add_bos=True)
+        export = src.export_prefix_pages(ids)
+        header, payload = build_header(
+            "gm", "tiny-llama", export["tokens"], export["k"], export["v"])
+        tokens, k, v = roundtrip(header, payload)
+        dst = make_engine(page_size=16, prefill_chunk=16)
+        with pytest.raises(ValueError, match="page-size"):
+            dst.import_prefix_pages(tokens, k, v, header)
+
+
+class TestRefcountSafety:
+    def test_overlap_import_no_double_free_pinned_stays_pinned(self):
+        """Importing a prefix that overlaps already-cached pages must not
+        install duplicates, must leave live pins untouched, and must keep
+        the allocator's page accounting exact (no page ever appears in
+        two ownership states — the no-double-free invariant)."""
+        prompt = "overlap import refcount safety check " * 3
+        src, dst = make_engine(), make_engine()
+        # dst already served (and cached) the same prompt
+        greedy(dst, "ov-warm", prompt)
+        alloc = dst.alloc
+        ids = dst.tokenizer.encode(prompt, add_bos=True)
+        pinned, _tok = alloc.pin_prefix(ids)
+        assert pinned, "prompt pages should be cached on dst"
+        refs_before = {p: alloc._refs.get(p) for p in pinned}
+        free_before = alloc.free_pages
+        cached_before = alloc.cached_pages
+
+        greedy(src, "ov-e", prompt, export_only=True)
+        n = migrate(src, dst, prompt)
+        assert n > 0
+        # every imported page overlapped the existing cache: nothing new
+        # was installed, nothing was freed twice
+        assert alloc.free_pages == free_before
+        assert alloc.cached_pages == cached_before
+        for p in pinned:  # live pins untouched by the overlap import
+            assert alloc._refs.get(p) == refs_before[p]
+        alloc.unpin_pages(pinned)
+        # full accounting: free + cached + live-referenced == num_pages
+        used = dst.config.num_pages - alloc.free_pages - alloc.cached_pages
+        assert used == 0
+        assert sorted(set(alloc._free)) == sorted(alloc._free), \
+            "duplicate page in the free list (double free)"
+
+    def test_partial_overlap_installs_only_missing_tail(self):
+        prompt = "partial overlap only missing tail pages install " * 2
+        src = make_engine()
+        greedy(src, "po-e", prompt, export_only=True)
+        ids = src.tokenizer.encode(prompt, add_bos=True)
+        export = src.export_prefix_pages(ids)
+        n_pages = len(export["tokens"]) // PS
+        assert n_pages >= 2
+        dst = make_engine()
+        header, payload = build_header(
+            "po", "tiny-llama", export["tokens"], export["k"], export["v"])
+        tokens, k, v = roundtrip(header, payload)
+        # first import only the first page's worth
+        h1 = dict(header)
+        h1["tokens"] = tokens[:PS]
+        h1["numPages"] = 1
+        n1 = dst.import_prefix_pages(tokens[:PS], k[:, :1], v[:, :1], h1)
+        assert n1 == PS
+        cached_1 = dst.alloc.cached_pages
+        # full import now only adds the missing tail pages
+        n2 = dst.import_prefix_pages(tokens, k, v, header)
+        assert n2 == len(tokens)
+        assert dst.alloc.cached_pages == cached_1 + (n_pages - 1)
+
+    def test_pool_exhaustion_keeps_shorter_prefix(self):
+        """A full pool truncates the install instead of failing — the
+        shorter contiguous prefix is still valid, and nothing leaks."""
+        prompt = "pool exhaustion truncates the imported prefix " * 2
+        src = make_engine()
+        greedy(src, "px-e", prompt, export_only=True)
+        ids = src.tokenizer.encode(prompt, add_bos=True)
+        export = src.export_prefix_pages(ids)
+        n_pages = len(export["tokens"]) // PS
+        assert n_pages >= 3
+        # destination pool with room for fewer pages than offered
+        dst = make_engine(num_pages=n_pages - 1, max_pages_per_slot=n_pages)
+        header, payload = build_header(
+            "px", "tiny-llama", export["tokens"], export["k"], export["v"])
+        tokens, k, v = roundtrip(header, payload)
+        n = dst.import_prefix_pages(tokens, k, v, header)
+        assert n == (n_pages - 1) * PS
+        assert dst.alloc.free_pages == 0
+        assert dst.alloc.cached_pages == n_pages - 1
