@@ -33,6 +33,8 @@ import sys
 import time
 import uuid
 
+from gridllm_tpu.utils.config import env_raw
+
 # Approximate public Ollama single-stream numbers on A100 (the BASELINE.json
 # comparison anchor; nothing is published by the reference itself).
 A100_OLLAMA_TOK_S = {
@@ -106,7 +108,6 @@ async def _teardown_stack(bus, registry, scheduler, worker, client=None):
 async def run_bench(model: str, n_requests: int, n_tokens: int,
                     max_slots: int, prompt_len: int,
                     profile_dir: str | None = None) -> dict:
-    import os
 
     from gridllm_tpu.engine import EngineConfig, InferenceEngine
     from gridllm_tpu.worker.main import resolve_checkpoint
@@ -116,7 +117,7 @@ async def run_bench(model: str, n_requests: int, n_tokens: int,
     # unrepresentative tokenization) and the metric string says so. Same
     # resolution logic as the worker entrypoint — one source of truth.
     ckpt, tok = resolve_checkpoint(
-        os.environ.get("GRIDLLM_CHECKPOINT_DIR"), model
+        env_raw("GRIDLLM_CHECKPOINT_DIR"), model
     )
     engine = InferenceEngine(EngineConfig(
         model=model,
@@ -332,7 +333,6 @@ async def run_shared_prefix_bench(model: str, n_requests: int,
     cache; round 2 (warm) re-issues the same prompts and skips the cached
     prefix. Reports cold vs warm p50 TTFT and the warm round's prompt-page
     hit rate — the headline numbers for automatic prefix caching."""
-    import os
 
     import aiohttp
     from aiohttp.test_utils import TestClient, TestServer
@@ -341,7 +341,7 @@ async def run_shared_prefix_bench(model: str, n_requests: int,
     from gridllm_tpu.worker.main import resolve_checkpoint
 
     ckpt, tok = resolve_checkpoint(
-        os.environ.get("GRIDLLM_CHECKPOINT_DIR"), model
+        env_raw("GRIDLLM_CHECKPOINT_DIR"), model
     )
     # Chunks sized so BOTH rounds run the chunked-prefill program and the
     # warm round's win is purely the skipped chunk invocations. The tiny
@@ -481,7 +481,6 @@ async def run_spec_bench(model: str, n_requests: int, n_tokens: int,
     is not artificially damped. Reports both arms' ITL + tok/s plus the
     spec arm's acceptance rate and emitted tokens per verify step (> 1 =
     speculation is paying for its verify overhead)."""
-    import os
 
     import aiohttp
     from aiohttp.test_utils import TestClient, TestServer
@@ -490,7 +489,7 @@ async def run_spec_bench(model: str, n_requests: int, n_tokens: int,
     from gridllm_tpu.worker.main import resolve_checkpoint
 
     ckpt, tok = resolve_checkpoint(
-        os.environ.get("GRIDLLM_CHECKPOINT_DIR"), model
+        env_raw("GRIDLLM_CHECKPOINT_DIR"), model
     )
     # tiny CPU models cap context at 256 byte-tokens — the prompt must
     # leave room for the measured decode or every stream dies at capacity
@@ -611,7 +610,6 @@ async def run_mixed_bench(model: str, n_requests: int, n_tokens: int,
     chunk and the running decodes share one launch, so the decode arm's
     ITL should NOT degrade while prefills churn; `--compare` gates both
     p50 ITL and p50 TTFT (plus tok/s) against a previous record."""
-    import os
 
     import aiohttp
     from aiohttp.test_utils import TestClient, TestServer
@@ -620,7 +618,7 @@ async def run_mixed_bench(model: str, n_requests: int, n_tokens: int,
     from gridllm_tpu.worker.main import resolve_checkpoint
 
     ckpt, tok = resolve_checkpoint(
-        os.environ.get("GRIDLLM_CHECKPOINT_DIR"), model
+        env_raw("GRIDLLM_CHECKPOINT_DIR"), model
     )
     tiny = model.startswith("tiny")
     engine = InferenceEngine(EngineConfig(
@@ -747,7 +745,6 @@ async def run_disagg_bench(model: str, n_requests: int, n_tokens: int,
     — plus migration volume/latency from the transfer layer's metrics.
     Measured at the scheduler boundary (submit_streaming_job) so both
     arms pay identical harness overhead."""
-    import os
 
     from gridllm_tpu.bus.memory import InMemoryBus
     from gridllm_tpu.engine import EngineConfig, InferenceEngine
@@ -763,7 +760,7 @@ async def run_disagg_bench(model: str, n_requests: int, n_tokens: int,
     from gridllm_tpu.worker.service import WorkerService
 
     ckpt, tok = resolve_checkpoint(
-        os.environ.get("GRIDLLM_CHECKPOINT_DIR"), model
+        env_raw("GRIDLLM_CHECKPOINT_DIR"), model
     )
     tiny = model.startswith("tiny")
 
@@ -1329,8 +1326,12 @@ def main() -> int:
                     "INTERNAL", "Mosaic", "XLA", "RESOURCE_EXHAUSTED",
                     "jaxlib", "TPU", "runner died", "device",
                 )) or type(first_err).__module__.startswith("jax")
+                # same kernels-disabled spellings _env_mode accepts: a run
+                # under GRIDLLM_PALLAS=off already has no kernel path, so
+                # retrying with =0 would just repeat the identical failure
                 if (platform == "cpu" or not device_like
-                        or _os.environ.get("GRIDLLM_PALLAS") == "0"):
+                        or (env_raw("GRIDLLM_PALLAS") or "").lower()
+                        in ("0", "off", "false")):
                     raise  # not a kernel-path problem — don't mislabel it
                 # kernel-path safety net: a Pallas kernel failing on REAL
                 # hardware (interpret-mode tests can't catch every Mosaic

@@ -1,0 +1,26 @@
+"""gridllm_tpu.analysis — repo-wide static invariant analyzer + runtime
+lock-discipline sanitizer (ISSUE 8).
+
+Static half: ``python -m gridllm_tpu.analysis`` runs AST-based rules
+(config-discipline, lock-discipline, dashboard-drift, jit-discipline,
+span-pairing, metric-hygiene) over the repo and reports ``file:line``
+findings in human or JSON form; ``--strict`` exits nonzero on any
+finding and gates tier-1 CI.
+
+Runtime half: ``analysis/lockcheck.py`` (``GRIDLLM_SANITIZE=1``)
+instruments ``threading.Lock``/``RLock`` during tests, builds the
+process lock-order graph, and fails on cycles or unlocked
+``PageAllocator`` mutation.
+"""
+
+from gridllm_tpu.analysis.core import (  # noqa: F401
+    Finding,
+    MetricReg,
+    Repo,
+    Rule,
+    RULES,
+    collect_metric_registrations,
+    load_rules,
+    rule,
+    run,
+)

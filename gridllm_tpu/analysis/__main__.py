@@ -1,0 +1,69 @@
+"""CLI: ``python -m gridllm_tpu.analysis [--strict] [--json] [--rule R]``.
+
+Exit codes: 0 = clean, 1 = findings, 2 = bad usage. ``--strict`` is the
+CI gate spelling — identical checks, and the exit code is the contract
+(tier1.yml static-analysis job). Run from the repo root, or point
+``--root`` at one.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+from gridllm_tpu.analysis.core import RULES, load_rules, run
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m gridllm_tpu.analysis",
+        description="GridLLM-TPU repo-wide static invariant analyzer.")
+    ap.add_argument("--root", default=".",
+                    help="repo root to analyze (default: cwd)")
+    ap.add_argument("--rule", action="append", default=None, metavar="NAME",
+                    help="run only this rule (repeatable)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable findings on stdout")
+    ap.add_argument("--strict", action="store_true",
+                    help="CI spelling: exit 1 on any finding (the default "
+                         "behavior; kept explicit so gates read as gates)")
+    ap.add_argument("--list", action="store_true",
+                    help="list registered rules and exit")
+    args = ap.parse_args(argv)
+
+    if args.list:
+        load_rules()
+        for name in sorted(RULES):
+            print(f"{name}: {RULES[name].description}")
+        return 0
+
+    root = Path(args.root)
+    if not (root / "gridllm_tpu").is_dir():
+        print(f"error: {root.resolve()} does not look like a repo root "
+              "(no gridllm_tpu/ package)", file=sys.stderr)
+        return 2
+    try:
+        findings = run(root, args.rule)
+    except KeyError as e:
+        print(f"error: {e.args[0]}", file=sys.stderr)
+        return 2
+
+    if args.json:
+        print(json.dumps({
+            "version": "gridllm-analysis/v1",
+            "root": str(root.resolve()),
+            "rules": args.rule or sorted(RULES),
+            "findings": [f.to_dict() for f in findings],
+        }, indent=2))
+    else:
+        for f in findings:
+            print(f.render())
+        n_rules = len(args.rule) if args.rule else len(RULES)
+        print(f"{len(findings)} finding(s) from {n_rules} rule(s).")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,324 @@
+"""Runtime lock-discipline sanitizer (``GRIDLLM_SANITIZE=1``, ISSUE 8).
+
+The static lock-discipline rule proves the engine's documented protocol
+lexically; this module proves it dynamically, on whatever paths the test
+actually executes — the two checkers share one invariant set.
+
+What it does when installed (tests/conftest.py installs it when
+``GRIDLLM_SANITIZE`` is truthy):
+
+1. **Lock-order graph.** ``threading.Lock``/``RLock`` factories are
+   replaced with proxies that record, per thread, the stack of held
+   locks. Acquiring lock B while holding lock A adds the edge A→B,
+   keyed by each lock's CREATION SITE (``file:line``) so per-engine
+   twin instances collapse into one node. A cycle in the site graph is
+   a lock-order inversion two threads can interleave into a deadlock —
+   ``cycles()`` reports it and the pytest hook fails the run.
+2. **Allocator guard.** The engine registers its ``PageAllocator``
+   against its ``_alloc_lock`` (:func:`guard_allocator`); every mutating
+   allocator call then asserts the calling thread owns the lock and
+   raises :class:`LockDisciplineError` immediately — pointing at the
+   unguarded call site, not at the refcount corruption three requests
+   later.
+
+Reentrant re-acquisition of the same lock instance and edges between
+two instances from the same creation site are not edges (an RLock
+re-enter and per-engine twins are both benign).
+
+Everything here is stdlib-only and dormant unless explicitly enabled;
+the proxies add one monitor-lock round trip per acquire/release (held
+stacks are shared state: a cross-thread release mutates the acquirer's).
+"""
+
+from __future__ import annotations
+
+import threading
+import traceback
+from typing import Any, Callable
+
+from gridllm_tpu.utils.config import env_bool
+
+_REAL_LOCK: Callable[[], Any] = threading.Lock
+_REAL_RLOCK: Callable[[], Any] = threading.RLock
+
+
+class LockDisciplineError(AssertionError):
+    """A lock-order cycle or an unguarded allocator mutation."""
+
+
+def enabled() -> bool:
+    return env_bool("GRIDLLM_SANITIZE")
+
+
+# -- monitor ----------------------------------------------------------------
+
+class _Monitor:
+    """Process-wide acquisition recorder: per-thread held stacks plus the
+    site-level order graph."""
+
+    def __init__(self) -> None:
+        self._mu = _REAL_LOCK()
+        self._tls = threading.local()
+        # thread ident -> that thread's held stack, so a cross-thread
+        # release (see on_released) can find the acquirer's entry
+        self._stacks: dict[int, list[tuple[str, int]]] = {}
+        # (site_a, site_b) -> observation count
+        self.edges: dict[tuple[str, str], int] = {}
+
+    def _held(self) -> list[tuple[str, int]]:
+        held = getattr(self._tls, "held", None)
+        if held is None:
+            held = self._tls.held = []
+            with self._mu:
+                # ident reuse after thread death replaces the dead
+                # thread's (empty) stack — exactly what we want
+                self._stacks[threading.get_ident()] = held
+        return held
+
+    def on_acquired(self, proxy: "_LockProxy") -> None:
+        # _held() before _mu: first-call registration takes _mu itself.
+        # All stack mutation happens under _mu because a cross-thread
+        # release (below) may delete from THIS thread's stack concurrently.
+        held = self._held()
+        with self._mu:
+            for site, lock_id in held:
+                if lock_id == id(proxy) or site == proxy.site:
+                    continue  # reentry / same-creation-site twin
+                e = (site, proxy.site)
+                self.edges[e] = self.edges.get(e, 0) + 1
+            held.append((proxy.site, id(proxy)))
+
+    def on_released(self, proxy: "_LockProxy") -> None:
+        held = self._held()
+        with self._mu:
+            for i in range(len(held) - 1, -1, -1):
+                if held[i][1] == id(proxy):
+                    del held[i]
+                    return
+            # plain Lock legally allows release from a thread other than
+            # the acquirer (handoff patterns). The entry lives on the
+            # ACQUIRER's stack — drop it there, or it sticks forever and
+            # every later acquire on that thread records bogus edges
+            # (false cycles).
+            for other in self._stacks.values():
+                for i in range(len(other) - 1, -1, -1):
+                    if other[i][1] == id(proxy):
+                        del other[i]
+                        return
+
+    def snapshot_edges(self) -> dict[tuple[str, str], int]:
+        with self._mu:
+            return dict(self.edges)
+
+    def cycles(self) -> list[list[str]]:
+        """Cycles in the site-level order graph (DFS, each reported once)."""
+        edges = self.snapshot_edges()
+        graph: dict[str, set[str]] = {}
+        for a, b in edges:
+            graph.setdefault(a, set()).add(b)
+            graph.setdefault(b, set())
+        seen: set[str] = set()
+        out: list[list[str]] = []
+
+        def dfs(node: str, stack: list[str], on_stack: set[str]) -> None:
+            seen.add(node)
+            stack.append(node)
+            on_stack.add(node)
+            for nxt in sorted(graph.get(node, ())):
+                if nxt in on_stack:
+                    out.append(stack[stack.index(nxt):] + [nxt])
+                elif nxt not in seen:
+                    dfs(nxt, stack, on_stack)
+            stack.pop()
+            on_stack.discard(node)
+
+        for node in sorted(graph):
+            if node not in seen:
+                dfs(node, [], set())
+        return out
+
+    def reset(self) -> None:
+        with self._mu:
+            self.edges.clear()
+
+    def restore(self, edges: dict[tuple[str, str], int]) -> None:
+        """Merge a previously snapshotted edge set back in — lets tests
+        that reset the process-global graph hand back what earlier suites
+        recorded, so a sanitized session's final verdict still covers them."""
+        with self._mu:
+            for e, n in edges.items():
+                self.edges[e] = self.edges.get(e, 0) + n
+
+
+_MON = _Monitor()
+
+
+def _creation_site() -> str:
+    """file:line of the frame that called Lock()/RLock(), skipping this
+    module and threading internals (Condition() creating its RLock should
+    attribute to the Condition's owner, best-effort)."""
+    for frame in reversed(traceback.extract_stack()):
+        fn = frame.filename
+        # exact-match this module (endswith would also skip callers whose
+        # file merely ends in "lockcheck.py", e.g. tests/test_lockcheck.py)
+        if fn == __file__ or fn.rsplit("/", 1)[-1] == "threading.py":
+            continue
+        return f"{fn}:{frame.lineno}"
+    return "<unknown>"
+
+
+class _LockProxy:
+    """Wraps a real Lock/RLock; records acquire/release with the monitor.
+    Unknown attributes (``_is_owned``, ``_release_save``, …) forward to
+    the real lock, so ``threading.Condition`` keeps working."""
+
+    def __init__(self, real: Any, site: str):
+        self._real = real
+        self.site = site
+
+    def acquire(self, *args: Any, **kwargs: Any) -> bool:
+        got = self._real.acquire(*args, **kwargs)
+        if got:
+            _MON.on_acquired(self)
+        return got
+
+    def release(self) -> None:
+        # record BEFORE the real release: once the real lock is free,
+        # another thread's acquire can append its own entry for this
+        # proxy, and a cross-thread release's fallback scan could then
+        # delete the fresh entry instead of the stale one. While we still
+        # hold the real lock, at most one entry for this proxy exists.
+        # (Releasing an unheld lock: the scan finds nothing, then the
+        # real release raises as it should.)
+        _MON.on_released(self)
+        self._real.release()
+
+    def __enter__(self) -> "_LockProxy":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.release()
+
+    def locked(self) -> bool:
+        return self._real.locked()
+
+    def __getattr__(self, name: str) -> Any:
+        return getattr(self._real, name)
+
+    def __repr__(self) -> str:
+        return f"<sanitized {self._real!r} from {self.site}>"
+
+
+def make_lock() -> _LockProxy:
+    return _LockProxy(_REAL_LOCK(), _creation_site())
+
+
+def make_rlock() -> _LockProxy:
+    return _LockProxy(_REAL_RLOCK(), _creation_site())
+
+
+_installed = False
+
+
+def install() -> None:
+    """Replace the threading lock factories with sanitized proxies. Locks
+    created BEFORE install (import-time locks in third-party modules) stay
+    real — the engine/scheduler locks this exists for are created per
+    instance, after conftest runs."""
+    global _installed
+    if _installed:
+        return
+    threading.Lock = make_lock  # type: ignore[assignment]
+    threading.RLock = make_rlock  # type: ignore[assignment]
+    _installed = True
+
+
+def uninstall() -> None:
+    global _installed
+    threading.Lock = _REAL_LOCK  # type: ignore[assignment]
+    threading.RLock = _REAL_RLOCK  # type: ignore[assignment]
+    _installed = False
+
+
+def installed() -> bool:
+    return _installed
+
+
+def reset() -> None:
+    _MON.reset()
+
+
+def restore(edges: dict[tuple[str, str], int]) -> None:
+    _MON.restore(edges)
+
+
+def edges() -> dict[tuple[str, str], int]:
+    return _MON.snapshot_edges()
+
+
+def cycles() -> list[list[str]]:
+    return _MON.cycles()
+
+
+def report() -> dict[str, Any]:
+    cyc = cycles()
+    return {
+        "installed": _installed,
+        "edges": [{"from": a, "to": b, "count": n}
+                  for (a, b), n in sorted(_MON.snapshot_edges().items())],
+        "cycles": cyc,
+        "ok": not cyc,
+    }
+
+
+def assert_clean() -> None:
+    cyc = cycles()
+    if cyc:
+        lines = [" -> ".join(c) for c in cyc]
+        raise LockDisciplineError(
+            "lock-order cycle(s) observed (sites are lock creation "
+            "points):\n  " + "\n  ".join(lines))
+
+
+# -- allocator guard --------------------------------------------------------
+
+# PageAllocator methods that mutate free lists / refcounts / the reuse LRU:
+# ONE set, owned by the static rule — importing it here means a mutator
+# added to the analyzer is automatically guarded at runtime too, so the
+# two checkers cannot drift apart
+from gridllm_tpu.analysis.rules.lock_discipline import (  # noqa: E402
+    MUTATORS as GUARDED_MUTATORS,
+)
+
+
+def _owned(lock: Any) -> bool:
+    is_owned = getattr(lock, "_is_owned", None)
+    if is_owned is not None:
+        return bool(is_owned())
+    return bool(lock.locked())  # plain Lock: held by someone, best-effort
+
+
+def guard_allocator(allocator: Any, lock: Any) -> Any:
+    """Wrap ``allocator``'s mutating methods to assert ``lock`` is owned
+    by the calling thread. Instance-level patch: other allocators (unit
+    tests poking PageAllocator directly) are untouched."""
+    if getattr(allocator, "_sanitize_guarded", False):
+        return allocator
+
+    def wrap(name: str, fn: Callable[..., Any]) -> Callable[..., Any]:
+        def checked(*args: Any, **kwargs: Any) -> Any:
+            if not _owned(lock):
+                raise LockDisciplineError(
+                    f"PageAllocator.{name}() called without the engine's "
+                    "_alloc_lock held — allocator mutation from an "
+                    "unguarded path (see engine/engine.py lock protocol)")
+            return fn(*args, **kwargs)
+
+        checked.__name__ = f"sanitized_{name}"
+        return checked
+
+    for name in GUARDED_MUTATORS:
+        setattr(allocator, name, wrap(name, getattr(allocator, name)))
+    allocator._sanitize_guarded = True
+    return allocator

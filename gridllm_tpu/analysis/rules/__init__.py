@@ -1,0 +1,11 @@
+"""Rule modules for gridllm_tpu.analysis — one invariant per module.
+
+Every module here is imported by ``core.load_rules()``; its ``@rule``
+decorators register checks. To add a rule, add a module with::
+
+    from gridllm_tpu.analysis.core import Finding, Repo, rule
+
+    @rule("my-rule", "one-line description")
+    def check(repo: Repo) -> list[Finding]:
+        ...
+"""

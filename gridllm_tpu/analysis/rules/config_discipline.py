@@ -1,0 +1,195 @@
+"""config-discipline: every ``GRIDLLM_*`` env read goes through the
+central registry in ``utils/config.py``.
+
+Three invariants:
+
+1. No direct ``os.environ`` / ``os.getenv`` read of a ``GRIDLLM_*`` name
+   anywhere outside ``utils/config.py`` (tests excepted — they own their
+   environment). Reads must use the typed accessors
+   (``env_str``/``env_int``/``env_float``/``env_bool``/``env_raw``).
+2. Every ``GRIDLLM_*`` token that appears in package source (accessor
+   calls, docstrings, error messages alike) names a REGISTERED variable —
+   stale knob names in docs are drift too.
+3. The README "Configuration" table and the registry agree both ways:
+   every registered variable is documented, every documented variable is
+   registered.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from gridllm_tpu.analysis.core import Finding, Repo, dotted_name, rule, str_const
+
+RULE = "config-discipline"
+CONFIG_MODULE = "gridllm_tpu/utils/config.py"
+ACCESSORS = {"env_str", "env_int", "env_float", "env_bool", "env_raw",
+             "env_int_lenient", "env_float_lenient"}
+_ENV_TOKEN = re.compile(r"\bGRIDLLM_[A-Z][A-Z0-9_]+\b")
+
+
+def _registered_vars(repo: Repo) -> dict[str, str]:
+    """name -> default, parsed from the ANALYZED tree's utils/config.py —
+    ``--root`` on another checkout must validate against THAT checkout's
+    registry, not whatever version this process imported. register_env
+    calls are literal by construction (the rule itself enforces literal
+    names). Fixture repos without a config module fall back to the
+    imported registry, which for them is the source of truth."""
+    for f in repo.files:
+        if f.rel == CONFIG_MODULE:
+            out: dict[str, str] = {}
+            for node in f.walk():
+                if isinstance(node, ast.Call) \
+                        and dotted_name(node.func).endswith("register_env") \
+                        and node.args:
+                    name = str_const(node.args[0])
+                    if name:
+                        default = (str_const(node.args[1])
+                                   if len(node.args) > 1 else None)
+                        out[name] = default if default is not None else ""
+            if out:
+                return out
+    from gridllm_tpu.utils.config import ENV_VARS
+
+    return {v.name: v.default for v in ENV_VARS.values()}
+
+
+def _is_environ_read(node: ast.AST) -> str | None:
+    """Return the env-var name when ``node`` reads the process environment
+    directly: os.environ.get/[...]/setdefault/pop or os.getenv."""
+    if isinstance(node, ast.Call):
+        fn = dotted_name(node.func)
+        # setdefault/pop are WRITES (launchers establishing defaults,
+        # tests cleaning up) — only true reads are in scope
+        if fn.endswith("environ.get") or fn.endswith("getenv"):
+            return str_const(node.args[0]) if node.args else "?"
+    if isinstance(node, ast.Subscript) and isinstance(node.ctx, ast.Load):
+        if dotted_name(node.value).endswith("environ"):
+            return str_const(node.slice) or "?"
+    return None
+
+
+@rule(RULE, "GRIDLLM_* env reads must go through utils/config.py's "
+            "registry; registry and README table must agree")
+def check(repo: Repo) -> list[Finding]:
+    findings: list[Finding] = []
+    registered = _registered_vars(repo)
+
+    for f in repo.files:
+        is_config = f.rel == CONFIG_MODULE
+        is_test = f.rel.startswith("tests/")
+        for node in f.walk():
+            # 1. direct environment reads of GRIDLLM_* outside config.py
+            # (tests own their environment — read ban does not apply)
+            name = _is_environ_read(node)
+            if name is not None and not is_config and not is_test \
+                    and name.startswith("GRIDLLM_"):
+                findings.append(Finding(
+                    RULE, f.rel, node.lineno,
+                    f"direct os.environ read of {name}: route it through "
+                    "the env registry (utils/config.py env_str/env_int/"
+                    "env_float/env_bool/env_raw)"))
+            # 2a. accessor calls must name registered vars
+            if isinstance(node, ast.Call) and isinstance(node.func, ast.Name) \
+                    and node.func.id in ACCESSORS and node.args:
+                var = str_const(node.args[0])
+                if var is None:
+                    # inside config.py the accessors delegate to each other
+                    # with a pass-through name (env_int_lenient -> env_int);
+                    # that is the implementation, not a call site
+                    if not is_config:
+                        findings.append(Finding(
+                            RULE, f.rel, node.lineno,
+                            f"{node.func.id}() needs a literal env-var name "
+                            "for static checking"))
+                elif var not in registered:
+                    findings.append(Finding(
+                        RULE, f.rel, node.lineno,
+                        f"{node.func.id}({var!r}): not in ENV_VARS — "
+                        "register_env it in utils/config.py"))
+        # 2b. any GRIDLLM_* token in source text (docstrings, launcher
+        # env dicts, error messages) must name a registered var — a knob
+        # nothing reads, or a stale name in docs, is drift. Tests are
+        # exempt: analyzer fixtures seed intentionally unregistered names
+        if is_test:
+            continue
+        for i, line in enumerate(f.text.splitlines(), 1):
+            for tok in _ENV_TOKEN.findall(line):
+                if tok not in registered:
+                    findings.append(Finding(
+                        RULE, f.rel, i,
+                        f"{tok} is not a registered env var (ENV_VARS); "
+                        "register it or fix the reference"))
+
+    # 3. README table <-> registry, both directions. "Documented" means a
+    # row of the "## Configuration" section's table specifically — a knob
+    # name quoted in some OTHER table (the metrics table explains
+    # gridllm_recompile_storms_total in terms of GRIDLLM_RECOMPILE_BUDGET)
+    # must not satisfy the check, or deleting the real row stays green.
+    readme = repo.read_text("README.md")
+    if readme is None:
+        findings.append(Finding(RULE, "README.md", 0, "README.md missing"))
+        return findings
+    documented: dict[str, int] = {}
+    doc_defaults: dict[str, tuple[str, int]] = {}
+    in_config_section = False
+    for i, line in enumerate(readme.splitlines(), 1):
+        if line.startswith("#"):
+            in_config_section = (
+                line.lstrip("#").strip().lower() == "configuration")
+            continue
+        if not line.lstrip().startswith("|"):
+            continue
+        for tok in _ENV_TOKEN.findall(line):
+            if in_config_section:
+                documented.setdefault(tok, i)
+                # the Default column is part of the contract too — a row
+                # is | `VAR` | `default`-or-*(empty)* | description |
+                cells = [c.strip() for c in line.strip().strip("|").split("|")]
+                if len(cells) >= 2 and tok in cells[0]:
+                    default = _parse_default_cell(cells[1])
+                    if default is not None:
+                        doc_defaults.setdefault(tok, (default, i))
+            elif tok not in registered:
+                # stale knob name in some other README table is drift too
+                findings.append(Finding(
+                    RULE, "README.md", i,
+                    f"README references {tok}, which is not registered "
+                    "in ENV_VARS"))
+    if not documented:
+        findings.append(Finding(
+            RULE, "README.md", 0,
+            "README has no Configuration-section table documenting "
+            "GRIDLLM_* variables"))
+    for var in registered:
+        if var not in documented:
+            findings.append(Finding(
+                RULE, "README.md", 0,
+                f"registered env var {var} missing from the README "
+                "Configuration table"))
+    for var, line in sorted(documented.items()):
+        if var not in registered:
+            findings.append(Finding(
+                RULE, "README.md", line,
+                f"README documents {var}, which is not registered in "
+                "ENV_VARS"))
+    for var, (default, line) in sorted(doc_defaults.items()):
+        reg_default = registered.get(var)
+        if reg_default is not None and default != reg_default:
+            findings.append(Finding(
+                RULE, "README.md", line,
+                f"README documents default {default!r} for {var} but the "
+                f"registry default is {reg_default!r}"))
+    return findings
+
+
+def _parse_default_cell(cell: str) -> str | None:
+    """The Default-column cell as a registry default string: ``*(empty)*``
+    means \"\", a backticked value means its contents. Anything else is
+    prose we can't compare — return None and skip (the name/description
+    checks still apply)."""
+    if cell == "*(empty)*":
+        return ""
+    m = re.fullmatch(r"`([^`]*)`", cell)
+    return m.group(1) if m else None

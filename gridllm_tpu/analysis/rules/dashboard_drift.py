@@ -1,0 +1,140 @@
+"""dashboard-drift: Grafana panels, Prometheus alerts, the README metrics
+table, and the code's metric registrations must all agree.
+
+Direction 1: every ``gridllm_*`` series referenced by
+``deploy/grafana-dashboard.json`` or ``deploy/prometheus-alerts.yml``
+must be exported by a registration in code (histogram registrations
+export ``_bucket``/``_sum``/``_count``; the bare family name is also
+accepted — alert annotations name families).
+
+Direction 2: every metric registered in code must appear in the README
+metrics table (brace shorthand like ``gridllm_engine_kv_pages_{used,free}``
+expands), and every name the table documents must exist in code.
+
+A dashboard querying a renamed metric renders flat zeros during the
+exact incident it was built for — this rule makes that a CI failure
+instead of a 3am discovery.
+"""
+
+from __future__ import annotations
+
+import itertools
+import re
+
+from gridllm_tpu.analysis.core import Finding, Repo, collect_metric_registrations, rule
+
+RULE = "dashboard-drift"
+DEPLOY_REFS = ("deploy/grafana-dashboard.json", "deploy/prometheus-alerts.yml")
+_NAME = re.compile(r"\bgridllm_[a-z0-9_]+\b")
+_HIST_SUFFIXES = ("_bucket", "_sum", "_count")
+_BRACE = re.compile(r"\{([a-z0-9_,]+)\}")
+
+
+def expand_braces(token: str) -> list[str]:
+    """``a_{x,y}_b`` → [``a_x_b``, ``a_y_b``] (multiple groups multiply)."""
+    groups = _BRACE.findall(token)
+    if not groups:
+        return [token]
+    template = _BRACE.sub("{}", token)
+    out = []
+    for combo in itertools.product(*(g.split(",") for g in groups)):
+        out.append(template.format(*combo))
+    return out
+
+
+def readme_table_metrics(readme: str) -> dict[str, int]:
+    """Metric names documented in README table rows (lines starting with
+    ``|``), brace shorthand expanded → first line number seen."""
+    out: dict[str, int] = {}
+    for i, line in enumerate(readme.splitlines(), 1):
+        if not line.lstrip().startswith("|"):
+            continue
+        for raw in re.findall(r"`([^`]*gridllm_[^`]*)`", line):
+            # brace groups don't end on a \b boundary — match them
+            # explicitly; require a name char after the prefix so a bare
+            # "`gridllm_`" (prose about the namespace) is not a metric
+            for tok in re.findall(
+                    r"\bgridllm_[a-z0-9][a-z0-9_]*(?:\{[a-z0-9_,]+\}[a-z0-9_]*)*",
+                    raw):
+                for name in expand_braces(tok):
+                    out.setdefault(name, i)
+    return out
+
+
+@rule(RULE, "grafana/prometheus metric references exist in code; "
+            "registered metrics are documented in the README table")
+def check(repo: Repo) -> list[Finding]:
+    findings: list[Finding] = []
+    regs = collect_metric_registrations(repo)
+    registered = {r.name: r for r in regs}
+    exported: set[str] = set()
+    for r in regs:
+        if r.kind == "histogram":
+            exported.update(r.name + s for s in _HIST_SUFFIXES)
+        exported.add(r.name)  # family name: legal in annotations/docs
+
+    # 1. deploy artifacts reference only exported/registered series
+    for rel in DEPLOY_REFS:
+        text = repo.read_text(rel)
+        if text is None:
+            findings.append(Finding(RULE, rel, 0, f"{rel} missing"))
+            continue
+        for i, line in enumerate(text.splitlines(), 1):
+            for name in _NAME.findall(line):
+                base = name
+                for s in _HIST_SUFFIXES:
+                    if name.endswith(s):
+                        base = name[: -len(s)]
+                        break
+                if name in exported:
+                    # suffixed reference must belong to a histogram
+                    if base != name and registered.get(base) \
+                            and registered[base].kind != "histogram":
+                        findings.append(Finding(
+                            RULE, rel, i,
+                            f"{name} uses histogram suffix but "
+                            f"{base} is a {registered[base].kind}"))
+                    # a bare histogram family inside a Grafana QUERY is a
+                    # series that never exists — the panel renders flat
+                    # zeros. Family names stay legal in alert annotations
+                    # and dashboard prose (titles, descriptions).
+                    elif base == name and '"expr"' in line \
+                            and rel.endswith(".json") \
+                            and registered.get(name) \
+                            and registered[name].kind == "histogram":
+                        findings.append(Finding(
+                            RULE, rel, i,
+                            f"{name} is a histogram family; queries must "
+                            "use the _bucket/_sum/_count series"))
+                    continue
+                if base in registered and base != name:
+                    # e.g. counter referenced with _bucket
+                    findings.append(Finding(
+                        RULE, rel, i,
+                        f"{name}: {base} is a {registered[base].kind}, "
+                        "which does not export this series"))
+                else:
+                    findings.append(Finding(
+                        RULE, rel, i,
+                        f"{name} is referenced here but no code registers "
+                        "it — dashboard/alert drift"))
+
+    # 2. README metrics table <-> registrations, both directions
+    readme = repo.read_text("README.md")
+    if readme is None:
+        findings.append(Finding(RULE, "README.md", 0, "README.md missing"))
+        return findings
+    documented = readme_table_metrics(readme)
+    for r in regs:
+        if r.name not in documented:
+            findings.append(Finding(
+                RULE, r.file, r.line,
+                f"{r.name} is registered here but missing from the README "
+                "metrics table"))
+    for name, line in sorted(documented.items()):
+        if name not in registered:
+            findings.append(Finding(
+                RULE, "README.md", line,
+                f"README metrics table documents {name}, which no code "
+                "registers"))
+    return findings

@@ -1,0 +1,138 @@
+"""jit-discipline: every jitted engine entry point is observable and
+shape-honest.
+
+Invariants over ``engine/engine.py`` (the module that owns every jitted
+serving-path program):
+
+1. **Tripwire coverage** — every ``jax.jit`` (decorator or inline call)
+   is wrapped by the recompile-tripwire probe (``self.perf.wrap``,
+   obs/perf.py). An unwrapped jit is a program whose steady-state
+   recompiles are invisible to ``gridllm_recompiles_total`` and the
+   storm diagnosis — the exact blind spot PR 4 closed.
+2. **No host sync / trace-variant branching inside** — within a jitted
+   function body: no ``.item()`` (device sync per call), and no
+   ``if``/``while`` whose condition reads a traced (non-static)
+   parameter, except ``is``/``is not None`` structure checks, which are
+   resolved at trace time. Branching on traced values either crashes at
+   trace time or silently multiplies compile signatures.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from gridllm_tpu.analysis.core import Finding, Repo, ancestors, dotted_name, rule, str_const
+
+RULE = "jit-discipline"
+ENGINE = "gridllm_tpu/engine/engine.py"
+
+
+def _jit_decorator(dec: ast.AST) -> tuple[bool, set[str]]:
+    """(is_jax_jit, static_argnames) for one decorator expression."""
+    name = dotted_name(dec)
+    if name in ("jax.jit", "jit"):
+        return True, set()
+    if isinstance(dec, ast.Call) and dotted_name(dec.func).endswith("partial") \
+            and dec.args and dotted_name(dec.args[0]) in ("jax.jit", "jit"):
+        statics: set[str] = set()
+        for kw in dec.keywords:
+            if kw.arg == "static_argnames":
+                if isinstance(kw.value, (ast.Tuple, ast.List)):
+                    statics = {s for e in kw.value.elts
+                               if (s := str_const(e)) is not None}
+                elif (s := str_const(kw.value)) is not None:
+                    statics = {s}
+        return True, statics
+    return False, set()
+
+
+def _is_none_check_only(test: ast.expr, param: str) -> bool:
+    """True when every use of ``param`` in the condition is an
+    ``is``/``is not`` comparison (trace-time structure check)."""
+    for node in ast.walk(test):
+        if isinstance(node, ast.Name) and node.id == param:
+            ok = False
+            for anc in ancestors(node):
+                if isinstance(anc, ast.Compare) and all(
+                        isinstance(op, (ast.Is, ast.IsNot))
+                        for op in anc.ops):
+                    ok = True
+                    break
+                if anc is test:
+                    break
+            if not ok:
+                return False
+    return True
+
+
+@rule(RULE, "every jax.jit in the engine is tripwire-wrapped; no .item() "
+            "or traced-value branching inside jitted bodies")
+def check(repo: Repo) -> list[Finding]:
+    findings: list[Finding] = []
+    f = repo.file(ENGINE)
+    if f is None or f.tree is None:
+        return [Finding(RULE, ENGINE, 0, "engine module missing/unparsable")]
+
+    wrapped_names: set[str] = set()       # fn names passed to *.wrap(...)
+    for node in f.walk():
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "wrap":
+            for arg in node.args:
+                if isinstance(arg, ast.Name):
+                    wrapped_names.add(arg.id)
+
+    for node in f.walk():
+        # decorated jitted functions
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            statics: set[str] = set()
+            jitted = False
+            for dec in node.decorator_list:
+                is_jit, st = _jit_decorator(dec)
+                if is_jit:
+                    jitted, statics = True, st
+            if not jitted:
+                continue
+            if node.name not in wrapped_names:
+                findings.append(Finding(
+                    RULE, f.rel, node.lineno,
+                    f"jitted function {node.name}() is never passed to the "
+                    "recompile-tripwire probe (self.perf.wrap) — its "
+                    "steady-state recompiles are invisible"))
+            params = {a.arg for a in node.args.args
+                      + node.args.posonlyargs + node.args.kwonlyargs}
+            traced = params - statics - {"self"}
+            for inner in ast.walk(node):
+                if isinstance(inner, ast.Call) \
+                        and isinstance(inner.func, ast.Attribute) \
+                        and inner.func.attr == "item" and not inner.args:
+                    findings.append(Finding(
+                        RULE, f.rel, inner.lineno,
+                        f".item() inside jitted {node.name}() — per-call "
+                        "device sync; compute it outside the jit"))
+                if isinstance(inner, (ast.If, ast.While)):
+                    used = {n.id for n in ast.walk(inner.test)
+                            if isinstance(n, ast.Name)} & traced
+                    bad = {p for p in used
+                           if not _is_none_check_only(inner.test, p)}
+                    if bad:
+                        findings.append(Finding(
+                            RULE, f.rel, inner.lineno,
+                            f"python branch on traced value(s) "
+                            f"{sorted(bad)} inside jitted {node.name}() — "
+                            "crashes at trace time or forks compile "
+                            "signatures; use jnp.where/lax.cond or make "
+                            "the arg static"))
+        # inline jax.jit(...) calls must sit inside a *.wrap(...) call
+        if isinstance(node, ast.Call) and dotted_name(node.func) in (
+                "jax.jit", "jit"):
+            in_wrap = any(
+                isinstance(anc, ast.Call)
+                and isinstance(anc.func, ast.Attribute)
+                and anc.func.attr == "wrap"
+                for anc in ancestors(node))
+            if not in_wrap:
+                findings.append(Finding(
+                    RULE, f.rel, node.lineno,
+                    "inline jax.jit(...) not wrapped by the recompile-"
+                    "tripwire probe (self.perf.wrap)"))
+    return findings

@@ -1,0 +1,97 @@
+"""lock-discipline: the engine's documented lock protocol, checked.
+
+Two invariants from ``engine/engine.py`` (both previously enforced only
+by a comment at the ``_alloc_lock`` declaration):
+
+1. **Guarded mutation** — every mutating ``PageAllocator`` call
+   (``something.alloc.<mutator>(...)``) happens lexically inside a
+   ``with`` block that acquires ``_alloc_lock``. Page allocation runs on
+   the driving thread while KV export/import mutates the same free lists
+   from executor threads; one unguarded call is a refcount corruption.
+2. **Lock order** — where both are held, ``_alloc_lock`` comes BEFORE
+   ``dispatch_lock``: never acquire ``_alloc_lock`` inside a block that
+   already holds ``dispatch_lock`` (including item order within a single
+   ``with a, b:``). The inversion is the classic two-thread deadlock.
+
+The runtime sanitizer (``analysis/lockcheck.py``) proves the same
+properties dynamically under the chaos/disagg suites; this rule catches
+them at review time, on paths the suites never execute.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from gridllm_tpu.analysis.core import Finding, Repo, ancestors, dotted_name, rule
+
+RULE = "lock-discipline"
+
+# PageAllocator methods that mutate free lists / refcounts / the reuse LRU
+MUTATORS = {"alloc", "free", "match_prefix", "pin_prefix", "unpin_pages",
+            "claim_page", "register_claimed"}
+ALLOC_LOCK = "_alloc_lock"
+DISPATCH_LOCK = "dispatch_lock"
+
+
+def _lock_items(node: ast.With) -> list[str]:
+    """Which of the two protocol locks a with-statement acquires, in
+    item order (by dotted-name suffix, so self._alloc_lock and
+    eng.dispatch_lock both resolve)."""
+    out = []
+    for item in node.items:
+        name = dotted_name(item.context_expr)
+        if name.endswith(ALLOC_LOCK):
+            out.append(ALLOC_LOCK)
+        elif name.endswith(DISPATCH_LOCK):
+            out.append(DISPATCH_LOCK)
+    return out
+
+
+def _holds(node: ast.AST, lock: str) -> bool:
+    """Is ``node`` lexically inside a with-block acquiring ``lock``?"""
+    for anc in ancestors(node):
+        if isinstance(anc, ast.With) and lock in _lock_items(anc):
+            return True
+    return False
+
+
+@rule(RULE, "PageAllocator mutation only under _alloc_lock; "
+            "never _alloc_lock inside dispatch_lock (order inversion)")
+def check(repo: Repo) -> list[Finding]:
+    findings: list[Finding] = []
+    for f in repo.package_files():
+        for node in f.walk():
+            # 1. guarded mutation: <recv>.alloc.<mutator>(...)
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in MUTATORS \
+                    and isinstance(node.func.value, ast.Attribute) \
+                    and node.func.value.attr == "alloc":
+                if not _holds(node, ALLOC_LOCK):
+                    findings.append(Finding(
+                        RULE, f.rel, node.lineno,
+                        f"{dotted_name(node.func)}() mutates PageAllocator "
+                        f"state outside a `with ... {ALLOC_LOCK}` block"))
+            # 2. order: _alloc_lock acquired while dispatch_lock held
+            if isinstance(node, ast.With):
+                items = _lock_items(node)
+                if ALLOC_LOCK in items and DISPATCH_LOCK in items \
+                        and items.index(DISPATCH_LOCK) < items.index(ALLOC_LOCK):
+                    findings.append(Finding(
+                        RULE, f.rel, node.lineno,
+                        "lock-order inversion in one statement: "
+                        f"{DISPATCH_LOCK} listed before {ALLOC_LOCK} "
+                        f"(documented order is {ALLOC_LOCK} first)"))
+                elif ALLOC_LOCK in items and DISPATCH_LOCK not in items:
+                    for anc in ancestors(node):
+                        if isinstance(anc, ast.With) \
+                                and DISPATCH_LOCK in _lock_items(anc) \
+                                and ALLOC_LOCK not in _lock_items(anc):
+                            findings.append(Finding(
+                                RULE, f.rel, node.lineno,
+                                f"lock-order inversion: {ALLOC_LOCK} "
+                                f"acquired inside a {DISPATCH_LOCK} block "
+                                f"(documented order is {ALLOC_LOCK} first, "
+                                f"engine/engine.py)"))
+                            break
+    return findings

@@ -1,0 +1,91 @@
+"""metric-hygiene: naming + label-cardinality policy, one rule shared by
+static and runtime checkers (ISSUE 8 satellite — folded in from
+tests/test_metric_hygiene.py, which now imports THIS module, so the
+policy lives in exactly one place).
+
+Policy, applied to every instrument:
+
+- name matches ``gridllm_[a-z][a-z0-9_]*`` (prefixed, lowercase,
+  snake_case — the scrape namespace stays greppable);
+- no unbounded-cardinality label (per-request/job/trace ids, raw text):
+  one bad label turns a scrape into a memory leak and kills the TSDB;
+- non-empty help text (the dashboard hover IS the documentation).
+
+The static half checks registration call sites (literal name/help/label
+args — a non-literal name is itself a finding, since nothing can audit
+it); the runtime half (:func:`lint_registry`) lints live registries so
+dynamically built instruments are covered by the test suite.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from gridllm_tpu.analysis.core import Finding, Repo, collect_metric_registrations, rule
+
+RULE = "metric-hygiene"
+
+NAME_RE = re.compile(r"^gridllm_[a-z][a-z0-9_]*$")
+
+# labels whose value space grows with traffic — forbidden on any instrument
+FORBIDDEN_LABELS = {
+    "request_id", "requestid", "job_id", "jobid", "id", "trace_id",
+    "traceid", "span_id", "prompt", "text", "user", "session",
+}
+
+
+def lint_registry(registry, origin: str) -> list[str]:
+    """Runtime lint over a live MetricsRegistry (obs/metrics.py) — used by
+    tests/test_metric_hygiene.py against the instance + process-global
+    registries after building a full gateway stack."""
+    problems = []
+    with registry._lock:
+        metrics = list(registry._metrics.values())
+    if not metrics:
+        problems.append(f"{origin}: no metrics registered — lint is vacuous")
+    for m in metrics:
+        if not NAME_RE.match(m.name):
+            problems.append(f"{origin}: {m.name!r} violates "
+                            "gridllm_[a-z0-9_]+ naming")
+        for label in m.labelnames:
+            if label.lower() in FORBIDDEN_LABELS:
+                problems.append(f"{origin}: {m.name!r} carries unbounded-"
+                                f"cardinality label {label!r}")
+        if not m.help:
+            problems.append(f"{origin}: {m.name!r} has no help text")
+    return problems
+
+
+@rule(RULE, "metric names gridllm_-prefixed snake_case, no unbounded-"
+            "cardinality labels, non-empty help text")
+def check(repo: Repo) -> list[Finding]:
+    findings: list[Finding] = []
+    for r in collect_metric_registrations(repo):
+        if not NAME_RE.match(r.name):
+            findings.append(Finding(
+                RULE, r.file, r.line,
+                f"metric name {r.name!r} violates gridllm_[a-z0-9_]+ "
+                "naming"))
+        if r.help is None or not r.help.strip():
+            findings.append(Finding(
+                RULE, r.file, r.line,
+                f"{r.name}: help text missing or not a string literal"))
+        if r.labels is None:
+            findings.append(Finding(
+                RULE, r.file, r.line,
+                f"{r.name}: labels are not a literal tuple — the label "
+                "policy cannot be audited statically"))
+        else:
+            for label in r.labels:
+                if label.lower() in FORBIDDEN_LABELS:
+                    findings.append(Finding(
+                        RULE, r.file, r.line,
+                        f"{r.name}: unbounded-cardinality label "
+                        f"{label!r}"))
+    # a static scan that sees nothing is itself broken
+    if not findings and not collect_metric_registrations(repo):
+        findings.append(Finding(
+            RULE, "gridllm_tpu", 0,
+            "no metric registrations found — the static scan is vacuous"))
+    return findings

@@ -1,0 +1,150 @@
+"""span-pairing: a tracer span opened with ``begin()`` must be closed on
+all paths.
+
+``Tracer.begin`` hands back an open span; a span that never reaches
+``end()`` pins its request's trace in the active table until the LRU
+seal and reports a phase that never finished — the watchdog then reads
+it as a hang. The safe shapes, which this rule enforces for every
+``<...tracer...>.begin(...)`` call site outside tests:
+
+- the span is closed by an ``end(span)`` call inside a ``finally`` block
+  whose ``try`` covers the ``begin()`` — begin inside the try body, or as
+  the statement immediately before the try (a statement in between can
+  raise with the span already open), or
+- ownership is handed off: the span is stored into an attribute or
+  mapping (``self._queue_spans[id] = tracer.begin(...)``) or returned,
+  where the holder's lifecycle closes it, or
+- the context-manager form ``with tracer.span(...)`` is used instead
+  (closed by construction, not begin()).
+
+Dropping the result of ``begin()`` on the floor is always a finding.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from gridllm_tpu.analysis.core import (
+    Finding,
+    Repo,
+    ancestors,
+    dotted_name,
+    enclosing_function,
+    rule,
+)
+
+RULE = "span-pairing"
+
+
+def _is_tracer_begin(node: ast.AST) -> bool:
+    return (isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "begin"
+            and "tracer" in dotted_name(node.func.value).lower())
+
+
+def _finally_try(node: ast.AST) -> ast.Try | None:
+    """The Try whose ``finally`` block contains ``node``, if any."""
+    for anc in ancestors(node):
+        if isinstance(anc, ast.Try) and any(
+                any(node is d for d in ast.walk(stmt))
+                for stmt in anc.finalbody):
+            return anc
+    return None
+
+
+def _stmt_of(node: ast.AST) -> ast.stmt | None:
+    cur: ast.AST | None = node
+    while cur is not None and not isinstance(cur, ast.stmt):
+        cur = getattr(cur, "parent", None)
+    return cur
+
+
+def _try_covers_begin(try_node: ast.Try, begin_stmt: ast.stmt) -> bool:
+    """Does the try whose finally ends the span actually protect the code
+    after begin()? True when begin() is inside the try body, or is the
+    statement immediately preceding the try in the same block — any
+    statement in between can raise with the span already open, which is
+    exactly the leak this rule exists to flag."""
+    for stmt in try_node.body:
+        if begin_stmt is stmt or any(begin_stmt is d for d in ast.walk(stmt)):
+            return True
+    block_holder = getattr(begin_stmt, "parent", None)
+    if block_holder is not getattr(try_node, "parent", None):
+        return False
+    for field in ("body", "orelse", "finalbody"):
+        block = getattr(block_holder, field, None)
+        if (isinstance(block, list) and begin_stmt in block
+                and try_node in block):
+            return block.index(try_node) == block.index(begin_stmt) + 1
+    return False
+
+
+@rule(RULE, "tracer spans opened with begin() close on all paths "
+            "(end() in a finally, or ownership handed off)")
+def check(repo: Repo) -> list[Finding]:
+    findings: list[Finding] = []
+    for f in repo.package_files():
+        for node in f.walk():
+            if not _is_tracer_begin(node):
+                continue
+            parent = getattr(node, "parent", None)
+            # dropped on the floor
+            if isinstance(parent, ast.Expr):
+                findings.append(Finding(
+                    RULE, f.rel, node.lineno,
+                    "tracer.begin() result discarded — the span can never "
+                    "be end()ed; bind it or use `with tracer.span(...)`"))
+                continue
+            # handoff: assigned into an attribute / mapping slot
+            if isinstance(parent, ast.Assign):
+                targets = parent.targets
+                if all(isinstance(t, (ast.Attribute, ast.Subscript))
+                       for t in targets):
+                    continue
+                names = [t.id for t in targets if isinstance(t, ast.Name)]
+            elif isinstance(parent, ast.Return):
+                continue  # caller owns it
+            else:
+                names = []
+            if not names:
+                findings.append(Finding(
+                    RULE, f.rel, node.lineno,
+                    "tracer.begin() in a form this rule cannot prove "
+                    "closed — bind to a local and end() it in a finally"))
+                continue
+            fn = enclosing_function(node)
+            scope = fn if fn is not None else f.tree
+            var = names[0]
+            begin_stmt = _stmt_of(node)
+            closed = handed_off = False
+            for inner in ast.walk(scope):
+                if isinstance(inner, ast.Call) \
+                        and isinstance(inner.func, ast.Attribute) \
+                        and inner.func.attr == "end" and inner.args \
+                        and isinstance(inner.args[0], ast.Name) \
+                        and inner.args[0].id == var:
+                    t = _finally_try(inner)
+                    if t is not None and begin_stmt is not None \
+                            and _try_covers_begin(t, begin_stmt):
+                        closed = True
+                # later handoff: self._spans[x] = span / return span
+                if isinstance(inner, ast.Assign) \
+                        and isinstance(inner.value, ast.Name) \
+                        and inner.value.id == var \
+                        and all(isinstance(t, (ast.Attribute, ast.Subscript))
+                                for t in inner.targets):
+                    handed_off = True
+                if isinstance(inner, ast.Return) \
+                        and isinstance(inner.value, ast.Name) \
+                        and inner.value.id == var:
+                    handed_off = True
+            if not closed and not handed_off:
+                findings.append(Finding(
+                    RULE, f.rel, node.lineno,
+                    f"span {var!r} from tracer.begin() has no end({var}) "
+                    "in a finally whose try covers the begin() (begin must "
+                    "be inside the try or immediately precede it) and is "
+                    "never handed off — it leaks open on the exception "
+                    "path"))
+    return findings

@@ -51,7 +51,6 @@ window buffer (ops/sampling.py) capped at EngineConfig.repeat_window.
 from __future__ import annotations
 
 import dataclasses
-import os
 import random
 import threading
 import time
@@ -89,6 +88,7 @@ from gridllm_tpu.ops.sampling import (
 from gridllm_tpu.ops.spec import make_drafter
 from gridllm_tpu.parallel.mesh import MeshConfig, build_mesh
 from gridllm_tpu.parallel.sharding import shard_cache, shard_params
+from gridllm_tpu.utils.config import env_bool, env_int
 from gridllm_tpu.utils.logging import get_logger
 
 log = get_logger("engine")
@@ -513,14 +513,12 @@ class InferenceEngine:
         0 ALSO disables, matching PageAllocator.cache_pages)."""
         on = self.config.prefix_cache
         if on is None:
-            on = os.environ.get("GRIDLLM_PREFIX_CACHE", "1").lower() not in (
-                "0", "off", "false")
+            on = env_bool("GRIDLLM_PREFIX_CACHE")
         if not on:
             return 0
         pages = self.config.prefix_cache_pages
         if pages is None:
-            raw = os.environ.get("GRIDLLM_PREFIX_CACHE_PAGES", "")
-            pages = int(raw) if raw else -1
+            pages = env_int("GRIDLLM_PREFIX_CACHE_PAGES")
         return max(pages, -1)
 
     def _resolve_spec_k(self) -> int:
@@ -530,14 +528,12 @@ class InferenceEngine:
         static jit arg, so a single verify program serves steady state."""
         on = self.config.spec_decode
         if on is None:
-            on = os.environ.get("GRIDLLM_SPEC_DECODE", "1").lower() not in (
-                "0", "off", "false")
+            on = env_bool("GRIDLLM_SPEC_DECODE")
         if not on:
             return 0
         k = self.config.spec_k
         if k is None:
-            raw = os.environ.get("GRIDLLM_SPEC_K", "")
-            k = int(raw) if raw else 4
+            k = env_int("GRIDLLM_SPEC_K")
         return max(int(k), 0)
 
     def _pool_head_dim(self) -> int:
@@ -562,7 +558,7 @@ class InferenceEngine:
         use, interpret = _pallas_mode(self.cfg.use_pallas)
         if not use:
             return d
-        if interpret and os.environ.get("GRIDLLM_POOL_PAD") != "1":
+        if interpret and not env_bool("GRIDLLM_POOL_PAD"):
             return d
         kvh = local_kv_heads(self.cfg.num_kv_heads, self.mesh)
         if self._ragged and flat_lanes_ok(kvh, d):
@@ -603,6 +599,14 @@ class InferenceEngine:
             c.num_pages, c.page_size, c.max_pages_per_slot,
             cache_pages=self._prefix_cache_cap, model=mc.name,
         )
+        # lock-discipline sanitizer (ISSUE 8): under GRIDLLM_SANITIZE=1
+        # every mutating allocator call asserts _alloc_lock ownership at
+        # the call site instead of corrupting refcounts three requests
+        # later; dormant (no import, no wrap) otherwise
+        if env_bool("GRIDLLM_SANITIZE"):
+            from gridllm_tpu.analysis.lockcheck import guard_allocator
+
+            guard_allocator(self.alloc, self._alloc_lock)
         self.sampling = SamplingParams.defaults(c.max_slots)
         self.counts = jnp.zeros((c.max_slots, mc.vocab_size), jnp.int32)
         # repeat-penalty window: last ≤ repeat_last_n context tokens per

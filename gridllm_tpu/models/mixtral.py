@@ -32,6 +32,7 @@ import jax.numpy as jnp
 from gridllm_tpu.models import llama
 from gridllm_tpu.models.configs import ModelConfig
 from gridllm_tpu.ops.kvcache import PagedKVCache
+from gridllm_tpu.utils.config import env_str
 
 Params = dict[str, Any]
 
@@ -171,9 +172,7 @@ def _moe_mlp_ragged_ep(
 
 
 def _ragged_enabled() -> bool:
-    import os
-
-    raw = os.environ.get("GRIDLLM_MOE_RAGGED", "auto").lower()
+    raw = env_str("GRIDLLM_MOE_RAGGED").lower()
     if raw == "auto":
         # CPU's ragged_dot lowering is a serial group loop, measured ~25%
         # SLOWER than dense even at X=8 — the grouped matmul win is a

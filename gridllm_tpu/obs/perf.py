@@ -50,6 +50,7 @@ from typing import Any, Callable
 
 from gridllm_tpu.obs.flightrec import default_flight_recorder
 from gridllm_tpu.obs.metrics import default_registry
+from gridllm_tpu.utils.config import ENV_VARS, env_float, env_int, env_raw
 from gridllm_tpu.utils.logging import get_logger
 
 log = get_logger("obs.perf")
@@ -144,22 +145,6 @@ def jax_loaded() -> bool:
     import sys
 
     return "jax" in sys.modules
-
-
-def _env_float(name: str, default: float) -> float:
-    raw = os.environ.get(name)
-    try:
-        return float(raw) if raw else default
-    except ValueError:
-        return default
-
-
-def _env_int(name: str, default: int) -> int:
-    raw = os.environ.get(name)
-    try:
-        return int(raw) if raw else default
-    except ValueError:
-        return default
 
 
 # ---------------------------------------------------------------------------
@@ -344,8 +329,14 @@ class RecompileTripwire:
         )
         log.warning("steady-state recompile", fn=probe.name, reason=reason,
                     context=self.context, shapes=shapes)
-        budget = _env_int("GRIDLLM_RECOMPILE_BUDGET", 4)
-        window = _env_float("GRIDLLM_RECOMPILE_WINDOW", 60.0)
+        try:
+            budget = env_int("GRIDLLM_RECOMPILE_BUDGET")
+            window = env_float("GRIDLLM_RECOMPILE_WINDOW")
+        except ValueError:
+            # this runs on the engine step path mid-incident: a malformed
+            # telemetry knob must degrade to the registry default, not crash
+            budget = int(ENV_VARS["GRIDLLM_RECOMPILE_BUDGET"].default)
+            window = float(ENV_VARS["GRIDLLM_RECOMPILE_WINDOW"].default)
         now = time.monotonic()
         with RecompileTripwire._storm_lock:
             ev = RecompileTripwire._storm_events
@@ -587,13 +578,19 @@ class ProfilerCapture:
     @property
     def base_dir(self) -> str:
         return (self._base_dir
-                or os.environ.get("GRIDLLM_PROFILE_DIR")
+                or env_raw("GRIDLLM_PROFILE_DIR")
                 or "/tmp/gridllm-profiles")
 
     @property
     def keep(self) -> int:
-        return self._keep if self._keep is not None else _env_int(
-            "GRIDLLM_PROFILE_KEEP", 4)
+        if self._keep is not None:
+            return self._keep
+        try:
+            return env_int("GRIDLLM_PROFILE_KEEP")
+        except ValueError:
+            # read during artifact rotation (watchdog auto-capture thread
+            # included) — degrade to the registry default, not an exception
+            return int(ENV_VARS["GRIDLLM_PROFILE_KEEP"].default)
 
     @property
     def active(self) -> dict[str, Any] | None:

@@ -18,12 +18,12 @@ materialized repeat).
 
 from __future__ import annotations
 
-import os
 from functools import partial
 
 import jax
 import jax.numpy as jnp
 
+from gridllm_tpu.utils.config import env_bool
 from gridllm_tpu.ops.kvcache import (
     _env_mode,
     _pallas_mode,
@@ -51,8 +51,7 @@ def ragged_attention_enabled() -> bool:
     through `ragged_paged_attention`; "0" is the escape hatch restoring
     the legacy dispatchers exactly. Resolved at trace time — flip it
     before building an engine, not mid-serving."""
-    return os.environ.get("GRIDLLM_RAGGED_ATTN", "1").lower() not in (
-        "0", "off", "false")
+    return env_bool("GRIDLLM_RAGGED_ATTN")
 
 _NEG_INF = -1e30
 

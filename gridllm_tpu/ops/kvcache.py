@@ -23,7 +23,6 @@ from __future__ import annotations
 import dataclasses
 import functools
 import hashlib
-import os
 from collections import OrderedDict
 from functools import partial
 
@@ -31,6 +30,7 @@ import jax
 import jax.numpy as jnp
 
 from gridllm_tpu.obs import default_registry
+from gridllm_tpu.utils.config import env_str
 
 # Which implementation each traced program took: "pallas" (kernel) or
 # "jnp" (fallback scatter/reference). Incremented at TRACE time — once per
@@ -89,7 +89,7 @@ def _env_mode() -> tuple[bool, bool]:
     and the KV-write kernels below: env `GRIDLLM_PALLAS` = "auto"
     (default: kernels on TPU backends only), "1" (force on), "0" (force
     off), "interpret" (kernels in interpreter mode — CPU testing)."""
-    raw = os.environ.get("GRIDLLM_PALLAS", "auto").lower()
+    raw = env_str("GRIDLLM_PALLAS").lower()
     if raw in ("0", "off", "false"):
         return False, False
     if raw in ("1", "on", "true"):

@@ -25,8 +25,9 @@ only ever calls `draft(ids, k)` per slot between verify steps.
 
 from __future__ import annotations
 
-import os
 from typing import Protocol, Sequence
+
+from gridllm_tpu.utils.config import env_int, env_str
 
 
 class Drafter(Protocol):
@@ -85,11 +86,11 @@ def make_drafter(kind: str | None = None) -> Drafter:
     implementation ("ngram" is the only phase-1 option; a draft-model
     drafter slots in here later), GRIDLLM_SPEC_NGRAM_MAX / _MIN /
     GRIDLLM_SPEC_LOOKBACK tune the n-gram matcher."""
-    kind = kind or os.environ.get("GRIDLLM_SPEC_DRAFTER", "ngram")
+    kind = kind or env_str("GRIDLLM_SPEC_DRAFTER")
     if kind == "ngram":
         return NgramDrafter(
-            max_n=int(os.environ.get("GRIDLLM_SPEC_NGRAM_MAX", "4")),
-            min_n=int(os.environ.get("GRIDLLM_SPEC_NGRAM_MIN", "1")),
-            lookback=int(os.environ.get("GRIDLLM_SPEC_LOOKBACK", "0")),
+            max_n=env_int("GRIDLLM_SPEC_NGRAM_MAX"),
+            min_n=env_int("GRIDLLM_SPEC_NGRAM_MIN"),
+            lookback=env_int("GRIDLLM_SPEC_LOOKBACK"),
         )
     raise ValueError(f"unknown drafter: {kind!r}")

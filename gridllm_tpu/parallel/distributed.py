@@ -12,14 +12,13 @@ Env contract (all optional; absent → single-host, no-op):
   GRIDLLM_COORD_ADDR   host:port of process 0 (jax coordinator)
   GRIDLLM_NUM_PROCS    total processes in the slice
   GRIDLLM_PROC_ID      this process's id (0 = liaison)
-  GRIDLLM_LOCAL_DEVICES  optional device count override (CPU testing)
 """
 
 from __future__ import annotations
 
 import dataclasses
-import os
 
+from gridllm_tpu.utils.config import env_int, env_raw
 from gridllm_tpu.utils.logging import get_logger
 
 log = get_logger("parallel.distributed")
@@ -36,9 +35,9 @@ class GroupConfig:
     @staticmethod
     def from_env() -> "GroupConfig":
         return GroupConfig(
-            coordinator=os.environ.get("GRIDLLM_COORD_ADDR") or None,
-            num_processes=int(os.environ.get("GRIDLLM_NUM_PROCS", "1")),
-            process_id=int(os.environ.get("GRIDLLM_PROC_ID", "0")),
+            coordinator=env_raw("GRIDLLM_COORD_ADDR") or None,
+            num_processes=env_int("GRIDLLM_NUM_PROCS"),
+            process_id=env_int("GRIDLLM_PROC_ID"),
         )
 
     @property

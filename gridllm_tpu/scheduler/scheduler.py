@@ -320,11 +320,13 @@ class JobScheduler(EventEmitter):
         md = request.metadata or {}
         endpoint = (md.get("openaiEndpoint") or md.get("ollamaEndpoint")
                     or md.get("endpoint") or "")
-        root = self.tracer.begin(request.id, "gateway.request",
-                                 endpoint=endpoint, model=request.model)
         subs: list[Subscription] = []
         outcome = "error"
         with bind_request_id(request.id):
+            # begin() directly before the try whose finally ends it — a
+            # raise in between would leak the span open (span-pairing rule)
+            root = self.tracer.begin(request.id, "gateway.request",
+                                     endpoint=endpoint, model=request.model)
             try:
                 for channel, handler in extra_subs or []:
                     subs.append(await self.bus.subscribe(channel, handler))

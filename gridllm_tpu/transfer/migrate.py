@@ -43,8 +43,8 @@ import time
 from typing import Any, Callable
 
 from gridllm_tpu.obs import default_flight_recorder, default_registry
-from gridllm_tpu.obs.perf import _env_int
 from gridllm_tpu.transfer.wire import Assembler, WireError, iter_chunks
+from gridllm_tpu.utils.config import env_int_lenient
 from gridllm_tpu.utils.logging import get_logger
 
 log = get_logger("transfer")
@@ -89,11 +89,14 @@ def ack_key(xfer_id: str) -> str:
 
 
 def kvx_settings() -> dict[str, int]:
+    # lenient reads: these are resolved mid-migration, never at startup —
+    # a malformed knob must degrade to the registry default, not fail the
+    # handoff after prefill+export already succeeded
     return {
-        "chunk_bytes": max(_env_int("GRIDLLM_KVX_CHUNK_BYTES", 256 * 1024), 1),
-        "window": max(_env_int("GRIDLLM_KVX_WINDOW", 8), 1),
-        "timeout_ms": max(_env_int("GRIDLLM_KVX_TIMEOUT_MS", 15_000), 1),
-        "http_bytes": max(_env_int("GRIDLLM_KVX_HTTP_BYTES", 8 * 1024 * 1024), 0),
+        "chunk_bytes": max(env_int_lenient("GRIDLLM_KVX_CHUNK_BYTES"), 1),
+        "window": max(env_int_lenient("GRIDLLM_KVX_WINDOW"), 1),
+        "timeout_ms": max(env_int_lenient("GRIDLLM_KVX_TIMEOUT_MS"), 1),
+        "http_bytes": max(env_int_lenient("GRIDLLM_KVX_HTTP_BYTES"), 0),
     }
 
 

@@ -17,11 +17,304 @@ Defaults preserved from the reference:
 
 from __future__ import annotations
 
+import dataclasses
 import os
 import uuid
 from typing import Any, Literal
 
 from pydantic import BaseModel, Field, ValidationError
+
+
+# ---------------------------------------------------------------------------
+# GRIDLLM_* environment registry (ISSUE 8)
+#
+# Every ``GRIDLLM_*`` variable the system reads is declared here ONCE with
+# its default and a one-line description, and read ONLY through the typed
+# accessors below. The config-discipline rule (gridllm_tpu/analysis/)
+# enforces both halves statically: a direct ``os.environ`` read of a
+# GRIDLLM_* name outside this module is a finding, and so is an accessor
+# call for an unregistered name. The README "Configuration" table is
+# cross-checked against this registry by the same rule, so docs cannot
+# drift from code.
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class EnvVar:
+    """One registered environment knob: the single source of truth for its
+    default and documentation."""
+
+    name: str
+    default: str          # raw string form; "" means unset/empty default
+    description: str
+
+
+ENV_VARS: dict[str, EnvVar] = {}
+
+
+def register_env(name: str, default: str, description: str) -> None:
+    if name in ENV_VARS:
+        # silent last-writer-wins would let two registrations (a bad
+        # merge) disagree on the default with no signal anywhere — the
+        # registry is single-source or it is nothing
+        raise ValueError(f"duplicate register_env({name!r})")
+    ENV_VARS[name] = EnvVar(name, default, description)
+
+
+def _registered(name: str) -> EnvVar:
+    var = ENV_VARS.get(name)
+    if var is None:
+        raise KeyError(
+            f"unregistered env var {name!r}: declare it in "
+            "gridllm_tpu/utils/config.py ENV_VARS (register_env) so the "
+            "default and description live in one place"
+        )
+    return var
+
+
+def env_raw(name: str) -> str | None:
+    """The raw environment value, or None when unset. The name must be
+    registered — callers with bespoke parsing start here."""
+    _registered(name)
+    return os.environ.get(name)
+
+
+def env_str(name: str) -> str:
+    var = _registered(name)
+    raw = os.environ.get(name)
+    return raw if raw is not None else var.default
+
+
+def env_int(name: str) -> int:
+    """Fail-fast: a set-but-malformed value raises (load_config turns that
+    into a startup SystemExit) rather than silently serving the default —
+    GRIDLLM_PROC_ID=two colliding with the real liaison process is exactly
+    the failure mode a registry exists to prevent."""
+    var = _registered(name)
+    raw = os.environ.get(name)
+    if not raw:
+        return int(var.default or 0)
+    try:
+        return int(raw)
+    except ValueError:
+        raise ValueError(
+            f"{name}={raw!r} is not a valid integer "
+            f"(default: {var.default or 0})") from None
+
+
+def env_float(name: str) -> float:
+    var = _registered(name)
+    raw = os.environ.get(name)
+    if not raw:
+        return float(var.default or 0.0)
+    try:
+        return float(raw)
+    except ValueError:
+        raise ValueError(
+            f"{name}={raw!r} is not a valid number "
+            f"(default: {var.default or 0.0})") from None
+
+
+def env_int_lenient(name: str) -> int:
+    """Like env_int, but a malformed value degrades to the registry
+    default instead of raising — for reads on serving paths (engine step,
+    KV migration mid-handoff) where an operator typo must fail the launch
+    if anything, never a request already in flight."""
+    try:
+        return env_int(name)
+    except ValueError:
+        return int(_registered(name).default or 0)
+
+
+def env_float_lenient(name: str) -> float:
+    try:
+        return env_float(name)
+    except ValueError:
+        return float(_registered(name).default or 0.0)
+
+
+_FALSY = ("0", "off", "false", "no")
+_TRUTHY = ("1", "on", "true", "yes")
+
+
+def env_bool(name: str) -> bool:
+    """One boolean grammar for every knob: the truthy/falsy sets below,
+    anything else raises. The per-site parsers this replaced disagreed on
+    unrecognized values (truthy-set sites read GRIDLLM_DISAGG=disable as
+    off, falsy-set sites read it as on) — failing fast beats silently
+    picking either side."""
+    var = _registered(name)
+    raw = os.environ.get(name)
+    if not raw:
+        return var.default.lower() in _TRUTHY
+    low = raw.lower()
+    if low in _TRUTHY:
+        return True
+    if low in _FALSY:
+        return False
+    raise ValueError(
+        f"{name}={raw!r} is not a valid boolean "
+        f"(truthy: {'/'.join(_TRUTHY)}; falsy: {'/'.join(_FALSY)})")
+
+
+# -- registry: one entry per GRIDLLM_* knob, grouped by subsystem -----------
+
+register_env("GRIDLLM_ENV", "development",
+             "Deployment environment name (NODE_ENV also honored).")
+register_env("GRIDLLM_LOG_LEVEL", "info",
+             "Log level for the structured logger (debug/info/warning/error).")
+register_env("GRIDLLM_BUS_URL", "",
+             "Message-bus endpoint; empty = in-memory bus, "
+             "resp://host:port = wire broker/Redis.")
+
+# engine
+register_env("GRIDLLM_MODELS", "",
+             "Comma-separated model registry names this worker serves.")
+register_env("GRIDLLM_CHECKPOINT_DIR", "",
+             "Directory holding model checkpoints (safetensors layouts).")
+register_env("GRIDLLM_DTYPE", "bfloat16",
+             "Model compute/weight dtype.")
+register_env("GRIDLLM_MAX_SEQ_LEN", "8192",
+             "Maximum sequence length (prompt + generation) per request.")
+register_env("GRIDLLM_MAX_BATCH_SLOTS", "8",
+             "Continuous-batching slot count per engine.")
+register_env("GRIDLLM_KV_PAGE_SIZE", "128",
+             "Tokens per KV-cache page.")
+register_env("GRIDLLM_STREAM_FLUSH_MS", "20",
+             "Token-frame batching window for streamed responses (ms).")
+register_env("GRIDLLM_PREFILL_BUCKETS", "512,1024,2048,4096,8192",
+             "Comma-separated prefill padding buckets (tokens); prompts "
+             "compile per bucket, not per length.")
+register_env("GRIDLLM_MESH_SHAPE", "",
+             "Device-mesh axes, e.g. \"tp:8\" or \"pp:2,tp:4\"; empty = "
+             "single device.")
+register_env("GRIDLLM_ALLOW_SYNTHETIC_WEIGHTS", "0",
+             "Serve randomly initialized weights when no checkpoint is "
+             "found (test/bench only).")
+register_env("GRIDLLM_POOL_PAD", "0",
+             "Force the lane-padded KV pool layout in Pallas interpret "
+             "mode (kernel-coverage testing).")
+
+# ops / kernels
+register_env("GRIDLLM_PALLAS", "auto",
+             "Pallas kernel policy: auto (TPU only), 1 (force on), "
+             "0 (force off), interpret (CPU interpreter mode).")
+register_env("GRIDLLM_RAGGED_ATTN", "1",
+             "Unified ragged paged-attention kernel for prefill/decode/"
+             "verify; 0 restores the legacy per-phase dispatchers.")
+register_env("GRIDLLM_MOE_RAGGED", "auto",
+             "MoE grouped-matmul via ragged_dot: auto (TPU only), "
+             "1 (force on), 0 (dense fallback).")
+
+# prefix caching
+register_env("GRIDLLM_PREFIX_CACHE", "1",
+             "Automatic prefix caching of completed requests' KV pages; "
+             "0 disables.")
+register_env("GRIDLLM_PREFIX_CACHE_PAGES", "-1",
+             "Reuse-LRU capacity in pages; -1 = unbounded (whole pool), "
+             "0 = off.")
+register_env("GRIDLLM_PREFIX_AFFINITY_WEIGHT", "0.25",
+             "Load-score bonus for workers whose heartbeat digest holds "
+             "the request's prefix key; 0 disables affinity routing.")
+
+# speculative decoding
+register_env("GRIDLLM_SPEC_DECODE", "1",
+             "Speculative decoding (n-gram drafting + batched "
+             "verification); 0 disables.")
+register_env("GRIDLLM_SPEC_K", "4",
+             "Speculation depth: drafted tokens per slot per verify step "
+             "(static per process); 0 disables.")
+register_env("GRIDLLM_SPEC_DRAFTER", "ngram",
+             "Drafter implementation (\"ngram\" is the phase-1 option).")
+register_env("GRIDLLM_SPEC_NGRAM_MAX", "4",
+             "Longest n-gram the prompt-lookup drafter matches on.")
+register_env("GRIDLLM_SPEC_NGRAM_MIN", "1",
+             "Shortest n-gram the prompt-lookup drafter falls back to.")
+register_env("GRIDLLM_SPEC_LOOKBACK", "0",
+             "Drafter match window over the slot history in tokens; "
+             "0 = unbounded.")
+
+# multi-host SPMD
+register_env("GRIDLLM_COORD_ADDR", "",
+             "host:port of process 0 (jax distributed coordinator).")
+register_env("GRIDLLM_NUM_PROCS", "1",
+             "Total processes in the worker slice.")
+register_env("GRIDLLM_PROC_ID", "0",
+             "This process's id in the slice (0 = liaison).")
+
+# scheduler / gateway / worker roles
+register_env("GRIDLLM_DISAGG", "1",
+             "Two-phase prefill/decode placement on split fleets; "
+             "0 forces whole-request placement.")
+register_env("GRIDLLM_WORKER_ROLE", "unified",
+             "Fleet role of this worker: unified, prefill, or decode.")
+register_env("GRIDLLM_WORKER_ADVERTISE_ADDR", "",
+             "host:port other workers reach this worker's health server "
+             "at (direct KV-transfer fallback); empty = 127.0.0.1:port.")
+register_env("GRIDLLM_ENFORCE_KEEP_ALIVE", "0",
+             "Unload models whose keep_alive window lapses (Ollama "
+             "semantics); off by default — TPU reloads cost minutes.")
+
+# KV migration (disaggregated serving)
+register_env("GRIDLLM_KVX_CHUNK_BYTES", "262144",
+             "KV-migration chunk size on the bus path (bytes).")
+register_env("GRIDLLM_KVX_WINDOW", "8",
+             "KV-migration chunks in flight before awaiting receiver "
+             "progress.")
+register_env("GRIDLLM_KVX_TIMEOUT_MS", "15000",
+             "End-to-end KV-transfer deadline (ms).")
+register_env("GRIDLLM_KVX_HTTP_BYTES", "8388608",
+             "Payload size beyond which migration uses one direct "
+             "worker-to-worker HTTP POST instead of bus chunks.")
+
+# observability: SLO / watchdog / flight recorder
+register_env("GRIDLLM_SLO_ENABLED", "1",
+             "SLO engine (attainment, burn rate, goodput); 0 disables.")
+register_env("GRIDLLM_SLO_CLASSES", "",
+             "JSON object replacing the default per-class objective table "
+             "({class: {ttft_ms, itl_ms, e2e_ms, target}}).")
+register_env("GRIDLLM_SLO_WINDOWS", "",
+             "Comma list of burn-rate window seconds (default 300,3600).")
+register_env("GRIDLLM_WATCHDOG_ENABLED", "1",
+             "Per-phase hang watchdog; 0 disables.")
+register_env("GRIDLLM_WATCHDOG_INTERVAL", "1000",
+             "Watchdog sweep interval (ms).")
+register_env("GRIDLLM_WATCHDOG_QUEUE_DEADLINE", "120000",
+             "Queue-phase hang deadline (ms).")
+register_env("GRIDLLM_WATCHDOG_DISPATCH_DEADLINE", "60000",
+             "Dispatch-phase hang deadline (ms).")
+register_env("GRIDLLM_WATCHDOG_PREFILL_DEADLINE", "240000",
+             "Prefill-phase hang deadline (ms).")
+register_env("GRIDLLM_WATCHDOG_DECODE_STALL", "60000",
+             "Decode-step stall deadline after the first token (ms).")
+register_env("GRIDLLM_WATCHDOG_REQUEUE", "1",
+             "Cancel + front-requeue jobs the watchdog catches hung; "
+             "0 = diagnose only.")
+register_env("GRIDLLM_WATCHDOG_PROFILE_S", "0",
+             "Auto jax.profiler capture length on decode-step hangs "
+             "(seconds); 0 disables (stop-flush starves heartbeats).")
+register_env("GRIDLLM_FLIGHTREC_CAPACITY", "256",
+             "Flight-recorder ring capacity per subsystem.")
+
+# observability: perf introspection
+register_env("GRIDLLM_RECOMPILE_BUDGET", "4",
+             "Steady-state recompiles tolerated per window before a "
+             "recompile-storm diagnosis.")
+register_env("GRIDLLM_RECOMPILE_WINDOW", "60",
+             "Recompile-storm budget window (seconds).")
+register_env("GRIDLLM_PROFILE_DIR", "",
+             "jax.profiler artifact root; empty = /tmp/gridllm-profiles.")
+register_env("GRIDLLM_PROFILE_KEEP", "4",
+             "Profiler captures kept before the oldest are pruned.")
+
+# static analysis / sanitizers (ISSUE 8)
+register_env("GRIDLLM_ENDPOINT", "http://localhost:4000",
+             "Gateway endpoint the integration differential harness "
+             "drives (tests/integration).")
+register_env("GRIDLLM_SANITIZE", "0",
+             "Runtime lock-discipline sanitizer: instrument Lock/RLock "
+             "acquires, build the lock-order graph, fail tests on cycles "
+             "or unlocked allocator mutation.")
 
 
 def _env(name: str, default: Any) -> Any:
@@ -234,14 +527,14 @@ def _slo_config_from_env() -> SLOConfig:
     is a comma list of burn-rate window seconds."""
     import json
 
-    kw: dict[str, Any] = {"enabled": _env("GRIDLLM_SLO_ENABLED", True)}
-    raw = os.environ.get("GRIDLLM_SLO_CLASSES")
+    kw: dict[str, Any] = {"enabled": env_bool("GRIDLLM_SLO_ENABLED")}
+    raw = env_raw("GRIDLLM_SLO_CLASSES")
     if raw:
         kw["classes"] = {
             name: SLOClassConfig(**spec)
             for name, spec in json.loads(raw).items()
         }
-    windows = os.environ.get("GRIDLLM_SLO_WINDOWS")
+    windows = env_raw("GRIDLLM_SLO_WINDOWS")
     if windows:
         kw["windows_s"] = [int(w) for w in windows.split(",") if w]
     return SLOConfig(**kw)
@@ -252,9 +545,9 @@ def load_config() -> Config:
     reference fails fast at import on Joi errors, server/src/config/index.ts:45-49)."""
     try:
         return Config(
-            env=_env("NODE_ENV", _env("GRIDLLM_ENV", "development")),
+            env=_env("NODE_ENV", env_str("GRIDLLM_ENV")),
             bus=BusConfig(
-                url=_env("GRIDLLM_BUS_URL", ""),
+                url=env_str("GRIDLLM_BUS_URL"),
                 host=_env("REDIS_HOST", "localhost"),
                 port=_env("REDIS_PORT", 6379),
                 password=os.environ.get("REDIS_PASSWORD") or None,
@@ -269,9 +562,9 @@ def load_config() -> Config:
                 retry_delay_ms=_env("JOB_RETRY_DELAY", 5_000),
                 max_concurrent_jobs_per_worker=_env("MAX_CONCURRENT_JOBS_PER_WORKER", 1),
                 sweep_interval_ms=_env("SCHEDULER_SWEEP_INTERVAL", 1_000),
-                prefix_affinity_weight=_env(
-                    "GRIDLLM_PREFIX_AFFINITY_WEIGHT", 0.25),
-                disagg_enabled=_env("GRIDLLM_DISAGG", True),
+                prefix_affinity_weight=env_float(
+                    "GRIDLLM_PREFIX_AFFINITY_WEIGHT"),
+                disagg_enabled=env_bool("GRIDLLM_DISAGG"),
             ),
             gateway=GatewayConfig(
                 host=_env("HOST", "0.0.0.0"),
@@ -279,7 +572,7 @@ def load_config() -> Config:
                 rate_limit_window_ms=_env("RATE_LIMIT_WINDOW_MS", 900_000),
                 rate_limit_max_requests=_env("RATE_LIMIT_MAX_REQUESTS", 100),
                 rate_limit_enabled=_env("RATE_LIMIT_ENABLED", True),
-                enforce_keep_alive=_env("GRIDLLM_ENFORCE_KEEP_ALIVE", False),
+                enforce_keep_alive=env_bool("GRIDLLM_ENFORCE_KEEP_ALIVE"),
             ),
             worker=WorkerConfig(
                 worker_id=_env("WORKER_ID", f"worker-{uuid.uuid4().hex[:12]}"),
@@ -289,37 +582,38 @@ def load_config() -> Config:
                 max_reconnect_attempts=_env("MAX_RECONNECT_ATTEMPTS", 10),
                 max_concurrent_tasks=_env("MAX_CONCURRENT_TASKS", 1),
                 performance_tier=_env("PERFORMANCE_TIER", "medium"),
-                role=_env("GRIDLLM_WORKER_ROLE", "unified"),
-                advertise_addr=_env("GRIDLLM_WORKER_ADVERTISE_ADDR", ""),
+                role=env_str("GRIDLLM_WORKER_ROLE"),
+                advertise_addr=env_str("GRIDLLM_WORKER_ADVERTISE_ADDR"),
             ),
             engine=EngineConfig(
-                models=_env("GRIDLLM_MODELS", ""),
-                checkpoint_dir=_env("GRIDLLM_CHECKPOINT_DIR", ""),
-                dtype=_env("GRIDLLM_DTYPE", "bfloat16"),
-                max_seq_len=_env("GRIDLLM_MAX_SEQ_LEN", 8192),
-                max_batch_slots=_env("GRIDLLM_MAX_BATCH_SLOTS", 8),
-                kv_page_size=_env("GRIDLLM_KV_PAGE_SIZE", 128),
-                stream_flush_ms=_env("GRIDLLM_STREAM_FLUSH_MS", 20),
-                mesh_shape=_env("GRIDLLM_MESH_SHAPE", ""),
+                models=env_str("GRIDLLM_MODELS"),
+                checkpoint_dir=env_str("GRIDLLM_CHECKPOINT_DIR"),
+                dtype=env_str("GRIDLLM_DTYPE"),
+                max_seq_len=env_int("GRIDLLM_MAX_SEQ_LEN"),
+                max_batch_slots=env_int("GRIDLLM_MAX_BATCH_SLOTS"),
+                kv_page_size=env_int("GRIDLLM_KV_PAGE_SIZE"),
+                stream_flush_ms=env_int("GRIDLLM_STREAM_FLUSH_MS"),
+                prefill_buckets=env_str("GRIDLLM_PREFILL_BUCKETS"),
+                mesh_shape=env_str("GRIDLLM_MESH_SHAPE"),
             ),
             obs=ObsConfig(
                 slo=_slo_config_from_env(),
                 watchdog=WatchdogConfig(
-                    enabled=_env("GRIDLLM_WATCHDOG_ENABLED", True),
-                    interval_ms=_env("GRIDLLM_WATCHDOG_INTERVAL", 1_000),
-                    queue_deadline_ms=_env(
-                        "GRIDLLM_WATCHDOG_QUEUE_DEADLINE", 120_000),
-                    dispatch_deadline_ms=_env(
-                        "GRIDLLM_WATCHDOG_DISPATCH_DEADLINE", 60_000),
-                    prefill_deadline_ms=_env(
-                        "GRIDLLM_WATCHDOG_PREFILL_DEADLINE", 240_000),
-                    decode_stall_ms=_env(
-                        "GRIDLLM_WATCHDOG_DECODE_STALL", 60_000),
-                    requeue=_env("GRIDLLM_WATCHDOG_REQUEUE", True),
-                    profile_on_hang_s=_env(
-                        "GRIDLLM_WATCHDOG_PROFILE_S", 0.0),
+                    enabled=env_bool("GRIDLLM_WATCHDOG_ENABLED"),
+                    interval_ms=env_int("GRIDLLM_WATCHDOG_INTERVAL"),
+                    queue_deadline_ms=env_int(
+                        "GRIDLLM_WATCHDOG_QUEUE_DEADLINE"),
+                    dispatch_deadline_ms=env_int(
+                        "GRIDLLM_WATCHDOG_DISPATCH_DEADLINE"),
+                    prefill_deadline_ms=env_int(
+                        "GRIDLLM_WATCHDOG_PREFILL_DEADLINE"),
+                    decode_stall_ms=env_int(
+                        "GRIDLLM_WATCHDOG_DECODE_STALL"),
+                    requeue=env_bool("GRIDLLM_WATCHDOG_REQUEUE"),
+                    profile_on_hang_s=env_float(
+                        "GRIDLLM_WATCHDOG_PROFILE_S"),
                 ),
-                flightrec_capacity=_env("GRIDLLM_FLIGHTREC_CAPACITY", 256),
+                flightrec_capacity=env_int("GRIDLLM_FLIGHTREC_CAPACITY"),
             ),
         )
     except (ValidationError, ValueError) as e:  # pragma: no cover - fail fast

@@ -12,13 +12,14 @@ from __future__ import annotations
 import contextvars
 import json
 import logging
-import os
 import sys
 import time
 from contextlib import contextmanager
 from typing import Any, Iterator
 
-_LEVEL = os.environ.get("GRIDLLM_LOG_LEVEL", "info").upper()
+from gridllm_tpu.utils.config import env_str
+
+_LEVEL = env_str("GRIDLLM_LOG_LEVEL").upper()
 _CONFIGURED = False
 
 # Active request id (set while a trace span is open for the request, see

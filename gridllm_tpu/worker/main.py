@@ -18,7 +18,7 @@ import gridllm_tpu
 from gridllm_tpu.bus import create_bus
 from gridllm_tpu.engine import EngineConfig, InferenceEngine
 from gridllm_tpu.parallel.mesh import MeshConfig
-from gridllm_tpu.utils.config import Config, load_config
+from gridllm_tpu.utils.config import Config, env_bool, load_config
 from gridllm_tpu.utils.logging import get_logger
 from gridllm_tpu.utils.types import iso_now
 from gridllm_tpu.worker.capabilities import system_resources
@@ -65,7 +65,7 @@ def pull_engine_factory(config: Config):
 
     def factory(name: str) -> InferenceEngine:
         ckpt, _ = resolve_checkpoint(config.engine.checkpoint_dir, name)
-        if ckpt is None and not os.environ.get(
+        if ckpt is None and not env_bool(
             "GRIDLLM_ALLOW_SYNTHETIC_WEIGHTS"
         ):
             raise ValueError(

@@ -48,6 +48,29 @@ import asyncio  # noqa: E402
 
 import pytest  # noqa: E402
 
+# Lock-discipline sanitizer (ISSUE 8): GRIDLLM_SANITIZE=1 swaps the
+# threading.Lock/RLock factories for instrumented proxies BEFORE any test
+# module builds an engine/scheduler, so every lock those construct joins
+# the lock-order graph. The session hook below fails the run on cycles.
+from gridllm_tpu.analysis import lockcheck  # noqa: E402
+
+if lockcheck.enabled():
+    lockcheck.install()
+
+
+def pytest_sessionfinish(session, exitstatus):
+    if not (lockcheck.enabled() and lockcheck.installed()):
+        return
+    cycles = lockcheck.cycles()
+    if cycles:
+        lines = "\n  ".join(" -> ".join(c) for c in cycles)
+        print(f"\nGRIDLLM_SANITIZE: lock-order cycle(s) observed:\n  {lines}")
+        pytest.exit("lock-order cycle detected by the sanitizer",
+                    returncode=3)
+    edges = lockcheck.edges()
+    print(f"\nGRIDLLM_SANITIZE: lock-order graph acyclic "
+          f"({len(edges)} distinct edges observed)")
+
 
 @pytest.fixture
 def event_loop_policy():

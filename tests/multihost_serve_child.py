@@ -32,7 +32,7 @@ def main() -> None:
         "GRIDLLM_MESH_SHAPE": "tp:8",   # wq/wo shard over both processes
         "GRIDLLM_DTYPE": "float32",
         "GRIDLLM_PREFILL_BUCKETS": "32,64",
-        "GRIDLLM_HEARTBEAT_INTERVAL_MS": "500",
+        "HEARTBEAT_INTERVAL": "500",  # worker config reads HEARTBEAT_INTERVAL
     })
     import jax
 

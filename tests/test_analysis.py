@@ -1,0 +1,384 @@
+"""Analyzer tests (ISSUE 8): every rule must fire on a seeded violation
+(a checker that cannot fail is waiving the policy silently), and the
+self-run over THIS repo must be clean — that second half is the actual
+invariant gate tier-1 runs.
+
+Fixture repos are tiny synthetic trees in tmp_path; rules are exercised
+through the same ``run()`` entry the CLI uses.
+"""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+from gridllm_tpu.analysis import run
+from gridllm_tpu.analysis.rules.dashboard_drift import (
+    expand_braces,
+    readme_table_metrics,
+)
+
+REPO_ROOT = Path(__file__).resolve().parents[1]
+
+# a README configuration table covering every registered env var, so
+# fixture repos only trip the violations they seed (generated, not typed)
+def _full_env_table() -> str:
+    from gridllm_tpu.utils.config import ENV_VARS
+
+    rows = ["## Configuration", "",
+            "| Variable | Default | Description |", "|---|---|---|"]
+    rows += [f"| `{v.name}` | `{v.default}` | {v.description} |"
+             for v in ENV_VARS.values()]
+    return "\n".join(rows)
+
+
+def make_repo(tmp_path: Path, files: dict[str, str]) -> Path:
+    defaults = {
+        "README.md": _full_env_table() + "\n",
+        "gridllm_tpu/__init__.py": "",
+        "deploy/grafana-dashboard.json": "{}",
+        "deploy/prometheus-alerts.yml": "groups: []",
+    }
+    for rel, text in {**defaults, **files}.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(text)
+    return tmp_path
+
+
+def findings_for(root: Path, rule: str):
+    return [f for f in run(root, [rule]) if f.rule == rule]
+
+
+# -- per-rule seeded violations --------------------------------------------
+
+def test_config_discipline_fires_on_direct_read(tmp_path):
+    root = make_repo(tmp_path, {"gridllm_tpu/mod.py": (
+        "import os\n"
+        "LEVEL = os.environ.get('GRIDLLM_LOG_LEVEL', 'info')\n"
+    )})
+    msgs = [f.message for f in findings_for(root, "config-discipline")]
+    assert any("direct os.environ read of GRIDLLM_LOG_LEVEL" in m
+               for m in msgs), msgs
+
+
+def test_config_discipline_fires_on_unregistered_var(tmp_path):
+    root = make_repo(tmp_path, {"gridllm_tpu/mod.py": (
+        "from gridllm_tpu.utils.config import env_str\n"
+        "X = env_str('GRIDLLM_NO_SUCH_KNOB')\n"
+    )})
+    msgs = [f.message for f in findings_for(root, "config-discipline")]
+    assert any("GRIDLLM_NO_SUCH_KNOB" in m and "ENV_VARS" in m
+               for m in msgs), msgs
+
+
+def test_config_discipline_fires_on_readme_drift(tmp_path):
+    # README documents a var the registry does not know
+    root = make_repo(tmp_path, {"README.md": _full_env_table() + (
+        "\n| `GRIDLLM_GHOST_KNOB` | `1` | not registered anywhere |\n")})
+    msgs = [f.message for f in findings_for(root, "config-discipline")]
+    assert any("GRIDLLM_GHOST_KNOB" in m and "not registered" in m
+               for m in msgs), msgs
+
+
+def test_config_discipline_fires_on_default_drift(tmp_path):
+    # README documents a default that disagrees with the registry
+    table = _full_env_table().replace(
+        "| `GRIDLLM_MAX_BATCH_SLOTS` | `8` |",
+        "| `GRIDLLM_MAX_BATCH_SLOTS` | `16` |")
+    assert "| `16` |" in table, "fixture assumes the registry default is 8"
+    root = make_repo(tmp_path, {"README.md": table + "\n"})
+    msgs = [f.message for f in findings_for(root, "config-discipline")]
+    assert any("GRIDLLM_MAX_BATCH_SLOTS" in m and "default" in m
+               for m in msgs), msgs
+
+
+def test_lock_discipline_fires_on_unguarded_mutation(tmp_path):
+    root = make_repo(tmp_path, {"gridllm_tpu/engine_like.py": (
+        "class E:\n"
+        "    def bad(self, slot):\n"
+        "        self.alloc.free(slot)\n"
+        "    def good(self, slot):\n"
+        "        with self._alloc_lock:\n"
+        "            self.alloc.free(slot)\n"
+    )})
+    fs = findings_for(root, "lock-discipline")
+    assert len(fs) == 1 and fs[0].line == 3, fs
+
+
+def test_lock_discipline_fires_on_order_inversion(tmp_path):
+    root = make_repo(tmp_path, {"gridllm_tpu/engine_like.py": (
+        "class E:\n"
+        "    def inverted(self):\n"
+        "        with self.dispatch_lock:\n"
+        "            with self._alloc_lock:\n"
+        "                pass\n"
+        "    def single_stmt_inverted(self):\n"
+        "        with self.dispatch_lock, self._alloc_lock:\n"
+        "            pass\n"
+        "    def correct(self):\n"
+        "        with self._alloc_lock, self.dispatch_lock:\n"
+        "            pass\n"
+        "    def also_correct(self):\n"
+        "        with self._alloc_lock:\n"
+        "            with self.dispatch_lock:\n"
+        "                pass\n"
+    )})
+    fs = findings_for(root, "lock-discipline")
+    assert sorted(f.line for f in fs) == [4, 7], fs
+
+
+def test_dashboard_drift_fires_on_phantom_panel_metric(tmp_path):
+    root = make_repo(tmp_path, {
+        "gridllm_tpu/m.py": (
+            "from gridllm_tpu.obs import default_registry\n"
+            "C = default_registry().counter(\n"
+            "    'gridllm_real_total', 'Real.', ('model',))\n"
+        ),
+        "deploy/grafana-dashboard.json":
+            '{"expr": "rate(gridllm_phantom_total[5m])"}',
+        "README.md": _full_env_table() +
+            "\n| `gridllm_real_total` (model) | real |\n",
+    })
+    msgs = [f.message for f in findings_for(root, "dashboard-drift")]
+    assert any("gridllm_phantom_total" in m and "no code registers" in m
+               for m in msgs), msgs
+
+
+def test_dashboard_drift_fires_on_undocumented_metric(tmp_path):
+    root = make_repo(tmp_path, {"gridllm_tpu/m.py": (
+        "from gridllm_tpu.obs import default_registry\n"
+        "C = default_registry().counter(\n"
+        "    'gridllm_undocumented_total', 'Help.', ('model',))\n"
+    )})
+    msgs = [f.message for f in findings_for(root, "dashboard-drift")]
+    assert any("gridllm_undocumented_total" in m
+               and "README metrics table" in m for m in msgs), msgs
+
+
+def test_dashboard_drift_fires_on_wrong_suffix(tmp_path):
+    # a counter referenced with a histogram-only series suffix
+    root = make_repo(tmp_path, {
+        "gridllm_tpu/m.py": (
+            "from gridllm_tpu.obs import default_registry\n"
+            "C = default_registry().counter(\n"
+            "    'gridllm_real_total', 'Real.', ('model',))\n"
+        ),
+        "deploy/prometheus-alerts.yml":
+            "expr: gridllm_real_total_bucket > 0",
+        "README.md": _full_env_table() +
+            "\n| `gridllm_real_total` (model) | real |\n",
+    })
+    msgs = [f.message for f in findings_for(root, "dashboard-drift")]
+    assert any("gridllm_real_total_bucket" in m for m in msgs), msgs
+
+
+def test_dashboard_drift_fires_on_bare_histogram_family_in_query(tmp_path):
+    # a Grafana QUERY naming the family references a series that never
+    # exists (only _bucket/_sum/_count are exported) — flat-panel drift.
+    # The same family name in prose (title) stays legal.
+    root = make_repo(tmp_path, {
+        "gridllm_tpu/m.py": (
+            "from gridllm_tpu.obs import default_registry\n"
+            "H = default_registry().histogram(\n"
+            "    'gridllm_lat_seconds', 'Latency.')\n"
+        ),
+        "deploy/grafana-dashboard.json": (
+            '{"title": "gridllm_lat_seconds p95",\n'
+            ' "expr": "histogram_quantile(0.95, rate(gridllm_lat_seconds[5m]))"}'
+        ),
+        "README.md": _full_env_table() +
+            "\n| `gridllm_lat_seconds` | latency |\n",
+    })
+    fs = [f for f in findings_for(root, "dashboard-drift")
+          if "histogram family" in f.message]
+    assert len(fs) == 1 and fs[0].line == 2, fs
+
+
+def test_jit_discipline_fires_on_unwrapped_and_dirty_bodies(tmp_path):
+    root = make_repo(tmp_path, {"gridllm_tpu/engine/engine.py": (
+        "import jax\n"
+        "from functools import partial\n"
+        "class InferenceEngine:\n"
+        "    def _build_fns(self):\n"
+        "        @partial(jax.jit, static_argnames=('k',))\n"
+        "        def unwrapped_fn(params, toks, k):\n"
+        "            if k:\n"                      # static: fine
+        "                n = toks.sum().item()\n"  # .item() inside jit
+        "            if toks > 0:\n"               # traced branch
+        "                pass\n"
+        "            if params is None:\n"         # structure check: fine
+        "                pass\n"
+        "            return toks\n"
+        "        self._fn = jax.jit(lambda p: p)\n"  # inline, unwrapped
+        "        @partial(jax.jit)\n"
+        "        def wrapped_fn(x):\n"
+        "            return x\n"
+        "        self._ok = self.perf.wrap('ok', wrapped_fn)\n"
+    )})
+    msgs = [f.message for f in findings_for(root, "jit-discipline")]
+    assert any("unwrapped_fn" in m and "perf.wrap" in m for m in msgs), msgs
+    assert any(".item()" in m for m in msgs), msgs
+    assert any("traced value" in m and "toks" in m for m in msgs), msgs
+    assert any("inline jax.jit" in m for m in msgs), msgs
+    assert not any(m.startswith("jitted function wrapped_fn(")
+                   for m in msgs), msgs
+    assert not any("params" in m and "traced" in m for m in msgs), msgs
+
+
+def test_span_pairing_fires_on_leaky_span(tmp_path):
+    root = make_repo(tmp_path, {"gridllm_tpu/svc.py": (
+        "class S:\n"
+        "    def leaky(self, rid):\n"
+        "        span = self.tracer.begin(rid, 'x')\n"
+        "        self.work()\n"
+        "        self.tracer.end(span)\n"        # not in a finally
+        "    def dropped(self, rid):\n"
+        "        self.tracer.begin(rid, 'x')\n"  # discarded outright
+        "    def safe(self, rid):\n"
+        "        span = self.tracer.begin(rid, 'x')\n"
+        "        try:\n"
+        "            self.work()\n"
+        "        finally:\n"
+        "            self.tracer.end(span)\n"
+        "    def handoff(self, rid):\n"
+        "        self._spans[rid] = self.tracer.begin(rid, 'x')\n"
+    )})
+    fs = findings_for(root, "span-pairing")
+    assert sorted(f.line for f in fs) == [3, 7], fs
+
+
+def test_span_pairing_fires_when_try_does_not_cover_begin(tmp_path):
+    # an end()-in-finally elsewhere in the function must not count when a
+    # statement between begin() and the try can raise with the span open
+    root = make_repo(tmp_path, {"gridllm_tpu/svc.py": (
+        "class S:\n"
+        "    def gap(self, rid):\n"
+        "        span = self.tracer.begin(rid, 'x')\n"
+        "        self.prep()\n"              # raises -> span leaks
+        "        try:\n"
+        "            self.work()\n"
+        "        finally:\n"
+        "            self.tracer.end(span)\n"
+        "    def begin_inside_try(self, rid):\n"
+        "        try:\n"
+        "            span = self.tracer.begin(rid, 'x')\n"
+        "            self.work()\n"
+        "        finally:\n"
+        "            self.tracer.end(span)\n"
+    )})
+    fs = findings_for(root, "span-pairing")
+    assert sorted(f.line for f in fs) == [3], fs
+
+
+def test_config_discipline_other_tables_do_not_satisfy_doc_check(tmp_path):
+    # drop one var's Configuration-table row but mention it in another
+    # markdown table: the doc check must still fire
+    table = _full_env_table()
+    lines = [l for l in table.splitlines() if "GRIDLLM_PALLAS" not in l]
+    readme = "\n".join(lines) + (
+        "\n\n## Metrics\n"
+        "| `gridllm_kernel_dispatch_total` | per GRIDLLM_PALLAS policy |\n")
+    root = make_repo(tmp_path, {"README.md": readme})
+    msgs = [f.message for f in findings_for(root, "config-discipline")]
+    assert any("GRIDLLM_PALLAS" in m and "missing from the README" in m
+               for m in msgs), msgs
+
+
+def test_metric_hygiene_audits_keyword_labelnames(tmp_path):
+    root = make_repo(tmp_path, {
+        "gridllm_tpu/m.py": (
+            "from gridllm_tpu.obs import default_registry\n"
+            "A = default_registry().counter(\n"
+            "    'gridllm_kw_total', 'Kw.', labelnames=('request_id',))\n"
+            "B = default_registry().counter(\n"
+            "    'gridllm_splat_total', 'Splat.', **extra)\n"
+        ),
+        "README.md": _full_env_table() +
+            "\n| `gridllm_kw_total` `gridllm_splat_total` | seeded |\n",
+    })
+    msgs = [f.message for f in findings_for(root, "metric-hygiene")]
+    assert any("gridllm_kw_total" in m and "request_id" in m
+               for m in msgs), msgs
+    assert any("gridllm_splat_total" in m and "audited" in m
+               for m in msgs), msgs
+
+
+def test_metric_hygiene_fires_on_bad_name_label_help(tmp_path):
+    root = make_repo(tmp_path, {
+        "gridllm_tpu/m.py": (
+            "from gridllm_tpu.obs import default_registry\n"
+            "A = default_registry().counter(\n"
+            "    'BadName_total', 'Bad name.')\n"
+            "B = default_registry().counter(\n"
+            "    'gridllm_leaky_total', 'Bad label.', ('job_id',))\n"
+            "C = default_registry().counter(\n"
+            "    'gridllm_helpless_total', '')\n"
+        ),
+        "README.md": _full_env_table() +
+            "\n| `BadName_total` `gridllm_leaky_total` "
+            "`gridllm_helpless_total` | seeded |\n",
+    })
+    msgs = [f.message for f in findings_for(root, "metric-hygiene")]
+    assert any("BadName_total" in m and "naming" in m for m in msgs), msgs
+    assert any("job_id" in m for m in msgs), msgs
+    assert any("gridllm_helpless_total" in m and "help" in m
+               for m in msgs), msgs
+
+
+# -- helpers ----------------------------------------------------------------
+
+def test_expand_braces():
+    assert expand_braces("gridllm_a_total") == ["gridllm_a_total"]
+    assert expand_braces("gridllm_kv_{used,free}") == [
+        "gridllm_kv_used", "gridllm_kv_free"]
+    assert expand_braces("gridllm_{a,b}_x_{c,d}") == [
+        "gridllm_a_x_c", "gridllm_a_x_d", "gridllm_b_x_c", "gridllm_b_x_d"]
+
+
+def test_readme_table_metrics_parses_rows_only():
+    doc = ("prose gridllm_not_in_table\n"
+           "| `gridllm_engine_kv_pages_{used,free}` (model) | pressure |\n")
+    names = readme_table_metrics(doc)
+    assert set(names) == {"gridllm_engine_kv_pages_used",
+                          "gridllm_engine_kv_pages_free"}
+
+
+# -- the actual gate --------------------------------------------------------
+
+def test_self_run_is_clean():
+    """Zero findings over this repo: the invariant set the analyzer
+    encodes HOLDS, and stays held — any regression fails here (and in
+    the tier-1 static-analysis CI job) with a file:line reason."""
+    findings = run(REPO_ROOT)
+    assert findings == [], "\n" + "\n".join(f.render() for f in findings)
+
+
+def test_cli_exit_codes_and_json(tmp_path):
+    env_table = _full_env_table()
+    bad = make_repo(tmp_path / "bad", {"gridllm_tpu/mod.py": (
+        "import os\nX = os.environ.get('GRIDLLM_PALLAS')\n")})
+    proc = subprocess.run(
+        [sys.executable, "-m", "gridllm_tpu.analysis", "--strict", "--json",
+         "--root", str(bad)],
+        capture_output=True, text=True, cwd=REPO_ROOT, timeout=120)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    payload = json.loads(proc.stdout)
+    assert payload["version"] == "gridllm-analysis/v1"
+    assert any(f["rule"] == "config-discipline"
+               for f in payload["findings"])
+
+    clean = make_repo(tmp_path / "clean", {
+        "README.md": env_table +
+            "\n| `gridllm_ok_total` (model) | fixture metric |\n",
+        "gridllm_tpu/engine/engine.py": (
+            "from gridllm_tpu.obs import default_registry\n"
+            "C = default_registry().counter(\n"
+            "    'gridllm_ok_total', 'Fixture.', ('model',))\n"
+        ),
+    })
+    proc = subprocess.run(
+        [sys.executable, "-m", "gridllm_tpu.analysis", "--strict",
+         "--root", str(clean)],
+        capture_output=True, text=True, cwd=REPO_ROOT, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr

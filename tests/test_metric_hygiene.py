@@ -1,17 +1,19 @@
-"""Metric-hygiene lint (ISSUE 2 satellite): every instrument the framework
-registers must (a) carry a ``gridllm_``-prefixed lowercase snake_case name
-and (b) never use an unbounded-cardinality label (per-request/job/trace
-ids) — one bad label turns a scrape into a memory leak and kills the TSDB.
-
-The check is runtime, not grep: it builds a full gateway stack (which
-registers every scheduler/gateway/SLO/watchdog instrument on the instance
-registry) and imports the engine/worker/bus modules (which register the
-process-global instruments), then lints BOTH registries' actual metrics.
-New instruments are covered automatically; the suite fails on violation.
+"""Metric-hygiene lint, runtime half (ISSUE 2 satellite; folded into the
+analysis rule registry by ISSUE 8): the POLICY — naming regex, forbidden
+labels, help text — lives in ``gridllm_tpu/analysis/rules/metric_hygiene``
+and is shared with the static ``python -m gridllm_tpu.analysis`` rule.
+This suite applies it at runtime: build a full gateway stack (registering
+every scheduler/gateway/SLO/watchdog instrument on the instance registry),
+import the engine/worker/bus modules (process-global instruments), then
+lint BOTH registries' actual metrics — dynamically constructed
+instruments included, which the static rule cannot see.
 """
 
-import re
-
+from gridllm_tpu.analysis.rules.metric_hygiene import (
+    FORBIDDEN_LABELS,
+    NAME_RE,
+    lint_registry,
+)
 from gridllm_tpu.bus.memory import InMemoryBus
 from gridllm_tpu.gateway.app import create_app
 from gridllm_tpu.obs import default_registry
@@ -19,32 +21,6 @@ from gridllm_tpu.scheduler import JobScheduler, WorkerRegistry
 from gridllm_tpu.utils.config import Config
 
 from .helpers import fast_config
-
-NAME_RE = re.compile(r"^gridllm_[a-z][a-z0-9_]*$")
-
-# labels whose value space grows with traffic — forbidden on any instrument
-FORBIDDEN_LABELS = {
-    "request_id", "requestid", "job_id", "jobid", "id", "trace_id",
-    "traceid", "span_id", "prompt", "text", "user", "session",
-}
-
-
-def _lint(registry, origin: str) -> list[str]:
-    problems = []
-    with registry._lock:
-        metrics = list(registry._metrics.values())
-    assert metrics, f"{origin}: no metrics registered — lint is vacuous"
-    for m in metrics:
-        if not NAME_RE.match(m.name):
-            problems.append(f"{origin}: {m.name!r} violates "
-                            "gridllm_[a-z0-9_]+ naming")
-        for label in m.labelnames:
-            if label.lower() in FORBIDDEN_LABELS:
-                problems.append(f"{origin}: {m.name!r} carries unbounded-"
-                                f"cardinality label {label!r}")
-        if not m.help:
-            problems.append(f"{origin}: {m.name!r} has no help text")
-    return problems
 
 
 async def test_all_registered_metrics_are_hygienic():
@@ -62,8 +38,8 @@ async def test_all_registered_metrics_are_hygienic():
     await scheduler.initialize()
     create_app(bus, registry, scheduler, Config(scheduler=cfg))
     try:
-        problems = _lint(scheduler.metrics, "scheduler-registry")
-        problems += _lint(default_registry(), "default-registry")
+        problems = lint_registry(scheduler.metrics, "scheduler-registry")
+        problems += lint_registry(default_registry(), "default-registry")
         assert not problems, "\n".join(problems)
     finally:
         await scheduler.shutdown()
@@ -72,15 +48,26 @@ async def test_all_registered_metrics_are_hygienic():
 
 
 def test_lint_catches_violations():
-    """The lint itself must fail on a bad name and a bad label — otherwise
-    a regression in the checker silently waives the whole policy."""
+    """The shared lint must fail on a bad name and a bad label — otherwise
+    a regression in the checker silently waives the whole policy (static
+    AND runtime, now that both halves import it from the rule module)."""
     from gridllm_tpu.obs import MetricsRegistry
 
     reg = MetricsRegistry()
     reg.counter("gridllm_good_total", "Fine.", ("model",))
     reg.counter("BadName_total", "Bad name.")
     reg.counter("gridllm_leaky_total", "Bad label.", ("job_id",))
-    problems = _lint(reg, "t")
+    problems = lint_registry(reg, "t")
     assert len(problems) == 2
     assert any("BadName_total" in p for p in problems)
     assert any("job_id" in p for p in problems)
+    # the policy constants are importable and sane (used by both halves)
+    assert NAME_RE.match("gridllm_good_total")
+    assert "job_id" in FORBIDDEN_LABELS
+
+
+def test_empty_registry_is_vacuous_not_clean():
+    from gridllm_tpu.obs import MetricsRegistry
+
+    problems = lint_registry(MetricsRegistry(), "empty")
+    assert problems and "vacuous" in problems[0]
