@@ -22,6 +22,7 @@ import asyncio
 import time
 from typing import Awaitable, Callable
 
+from gridllm_tpu import faults
 from gridllm_tpu.obs import metrics as obs
 
 # handler(channel, message) — message is the raw string payload
@@ -72,7 +73,11 @@ def channel_class(channel: str) -> str:
 
 
 def record_publish(channel: str) -> None:
-    """Called by bus implementations on every publish."""
+    """Called by bus implementations on every publish. The bus.publish
+    fault site lives here — BEFORE the accounting and the actual send, so
+    an injected publish failure looks exactly like a dead bus to the
+    caller (the message never leaves the process)."""
+    faults.inject("bus.publish")
     _PUBLISHED.inc(channel=channel_class(channel))
 
 
@@ -90,6 +95,12 @@ class HandlerPump:
     async def _run(self) -> None:
         while True:
             channel, message, t_push = await self.queue.get()
+            if faults.check("bus.deliver"):
+                # injected delivery loss: the handler never sees the
+                # message — exactly what an at-least-once consumer must
+                # survive via sweeps/retries/heartbeat timeouts
+                self.queue.task_done()
+                continue
             cls = channel_class(channel)
             _DELIVERED.inc(channel=cls)
             _DELIVERY_LATENCY.observe(

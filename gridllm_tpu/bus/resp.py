@@ -114,6 +114,17 @@ class _Conn:
                 pass
         self.reader = self.writer = None
 
+    def _abandon(self) -> None:
+        """Synchronous transport drop for the cancellation path: no
+        awaits, so a pending CancelledError cannot re-fire inside the
+        cleanup itself."""
+        if self.writer is not None:
+            try:
+                self.writer.close()
+            except Exception:  # noqa: BLE001
+                pass
+        self.reader = self.writer = None
+
     async def command(self, *args: str | bytes | int | float):
         async with self._lock:
             for attempt in range(2):
@@ -124,6 +135,16 @@ class _Conn:
                     self.writer.write(encode_command(*args))
                     await self.writer.drain()
                     return await read_reply(self.reader)
+                except asyncio.CancelledError:
+                    # Cancelled mid-exchange (caller timeout, task
+                    # teardown, a handler unsubscribing its own pump):
+                    # the command may already be written and its reply in
+                    # flight. Abandon the transport so the NEXT command
+                    # reconnects cleanly instead of reading the orphaned
+                    # reply as its own — a reply-stream desync poisons
+                    # every subsequent command on the connection.
+                    self._abandon()
+                    raise
                 except _CONN_ERRORS:
                     await self._close_locked()
                     if attempt == 1:

@@ -62,6 +62,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from gridllm_tpu import faults
 from gridllm_tpu.engine.tokenizer import DetokState, Tokenizer, get_tokenizer
 from gridllm_tpu.models import llama
 from gridllm_tpu.models.configs import ModelConfig, get_config
@@ -262,6 +263,21 @@ class GenerationRequest:
     # prefix cache (free+register, exactly the normal finish path) ready
     # for export_prefix_pages; no text is detokenized or streamed
     export_only: bool = False
+    # decode resume (ISSUE 9): token ids a previous attempt already
+    # generated. They are appended to the prompt for prefill/alloc (so a
+    # cached/migrated prefix makes resume cheap) but seeded into the
+    # slot's GENERATED state — detok/stop/num_predict/eval_count all
+    # continue exactly where the lost worker left off, and the sampler's
+    # (seed, step) chain restarts at step = len(resume_ids), so a greedy
+    # or seeded stream is byte-identical to the undisturbed run.
+    resume_ids: list[int] | None = None
+    # chars of the resumed text already delivered downstream: emission
+    # restarts past this offset, so clients never see a duplicate char
+    resume_sent: int = 0
+    # write the (generated ids, text) resume watermark every N surviving
+    # tokens (0 = never): each write copies the full generated list, so
+    # an every-token cadence would be O(n^2) on the engine hot loop
+    snapshot_every: int = 0
     # called from the engine loop: (text_delta, done, result|None)
     on_chunk: Callable[[str, bool, "GenerationResult | None"], None] | None = None
 
@@ -299,6 +315,7 @@ class _Slot:
         "req", "ids", "prompt_len", "generated", "detok", "text", "emitted_len",
         "num_predict", "stop_seqs", "eos_ids", "capacity", "joined_gen",
         "cached_tokens", "spec_proposed", "spec_accepted", "export_only",
+        "snapshot",
         "t_start", "t_prefill_ns", "t_first_decode", "t_last_ingest",
     )
 
@@ -319,6 +336,11 @@ class _Slot:
         self.spec_proposed = 0           # drafts sent to verify steps
         self.spec_accepted = 0           # drafts the model accepted
         self.export_only = req.export_only  # disagg prefill: stop at token 1
+        # last consistent (generated ids, text) pair, published as the
+        # crash-resume watermark (ISSUE 9). Written only by the engine
+        # thread as ONE immutable tuple per surviving token, so a reader
+        # on another thread always sees a matched pair.
+        self.snapshot: tuple[list[int], str] | None = None
         # dispatch generation of the FIRST decode block that will see this
         # slot: its row 0 (block-input tokens) carries the prefill-sampled
         # token; blocks with a lower generation predate the slot (or belong
@@ -418,7 +440,8 @@ class InferenceEngine:
         # step-time decomposition state (runner thread only)
         self._t_prev_fetch: float | None = None
         self._t_ingest_done: float | None = None
-        self._ctl: deque[str] = deque()   # cross-thread cancel requests (ids)
+        # cross-thread control requests: ("cancel" | "suspend", req_id)
+        self._ctl: deque[tuple[str, str]] = deque()
         self._work = threading.Condition()
         self._runner: threading.Thread | None = None
         self._runner_stop = threading.Event()
@@ -700,7 +723,11 @@ class InferenceEngine:
                 mc.vocab_size,
             )
             active = active.at[slot].set(True)
-            sp = dataclasses.replace(sp, step=sp.step.at[slot].set(1))
+            # step continues from the admission value (0 normally; the
+            # already-generated count on a decode resume, ISSUE 9) — the
+            # prefill sample consumed that draw, so +1
+            sp = dataclasses.replace(
+                sp, step=sp.step.at[slot].set(sp.step[slot] + 1))
             return cache, counts, window, wlen, tokens, active, sp
 
         @partial(jax.jit, donate_argnums=(2, 3, 4, 5, 6, 7, 8))
@@ -730,7 +757,7 @@ class InferenceEngine:
             active = active.at[slot].set(is_final | active[slot])
             sp = dataclasses.replace(
                 sp, step=sp.step.at[slot].set(
-                    jnp.where(is_final, 1, sp.step[slot])
+                    jnp.where(is_final, sp.step[slot] + 1, sp.step[slot])
                 )
             )
             return cache, counts, window, wlen, tokens, active, sp
@@ -775,7 +802,7 @@ class InferenceEngine:
             active = active.at[slot].set(is_final | active[slot])
             sp = dataclasses.replace(
                 sp, step=sp.step.at[slot].set(
-                    jnp.where(is_final, 1, sp.step[slot])
+                    jnp.where(is_final, sp.step[slot] + 1, sp.step[slot])
                 )
             )
             # decode bookkeeping for the slots that were active at entry
@@ -988,6 +1015,14 @@ class InferenceEngine:
             req = self._pending.popleft()
         ids = self._tokenize(req)
         images = list(req.images or [])
+        # decode resume (ISSUE 9): tokens a previous attempt already
+        # generated join the PROMPT for prefill/alloc (so a cached or
+        # migrated prefix covers them) but seed the slot's generated
+        # state below — vision requests can't resume (their KV encodes
+        # spliced pixels token ids alone don't address)
+        resume = [] if images else [int(t) for t in req.resume_ids or []]
+        if resume:
+            ids = ids + resume
         if images:
             try:
                 ids = self._expand_image_tokens(ids, len(images))
@@ -1029,7 +1064,9 @@ class InferenceEngine:
                     return True
         num_predict = int(opts.get("num_predict", -1))
         want = (
-            len(ids) + num_predict
+            # resumed tokens are already in `ids`; capacity reserves only
+            # the REMAINING budget so resume matches the original reservation
+            len(ids) + max(num_predict - len(resume), 0)
             if num_predict >= 0
             else eff_ctx
         )
@@ -1059,6 +1096,15 @@ class InferenceEngine:
         stop = opts.get("stop") or []
         stop_seqs = [stop] if isinstance(stop, str) else list(stop)
         st = _Slot(req, ids, want, num_predict, stop_seqs, self.tokenizer.eos_ids)
+        if resume:
+            # continue, don't restart: generated/detok/text pick up where
+            # the lost attempt stopped (num_predict, stop scanning, and
+            # eval_count all see the prior tokens), and emission resumes
+            # past the chars the client already received
+            st.prompt_len = max(len(ids) - len(resume), 0)
+            st.generated = list(resume)
+            st.text = st.detok.delta(self.tokenizer, st.generated)
+            st.emitted_len = max(int(req.resume_sent or 0), 0)
 
         # per-slot sampler params (Ollama option names)
         seed = opts.get("seed")
@@ -1078,9 +1124,16 @@ class InferenceEngine:
             "repeat_penalty": float(opts.get("repeat_penalty", 1.1)),
             "repeat_last_n": rl,
             "seed": int(seed) & 0x7FFFFFFF,
-            "step": 0,
+            # the (seed, step) rng chain restarts at the number of draws
+            # the lost attempt consumed, so seeded resume samples the
+            # same continuation the undisturbed run would have
+            "step": len(resume),
         }
-        st.cached_tokens = cached
+        # capped at prompt_len: a warm RESUME's cache match can cover the
+        # resumed tokens too, but cached_tokens reports prompt tokens
+        # served from cache and must stay <= prompt_eval_count (no-op for
+        # ordinary admissions, where prompt_len == len(ids) >= cached)
+        st.cached_tokens = min(cached, st.prompt_len)
         row_list = self.alloc.table_row(slot)
         t0 = time.perf_counter_ns()
         with self.dispatch_lock:
@@ -1341,6 +1394,15 @@ class InferenceEngine:
         if done_reason is not None:
             self._finish(slot, st, done_reason)
             return
+        # the token SURVIVED (no finish) — publish it on the resume
+        # watermark at the request's cadence (every write copies the full
+        # generated list, so per-token would be O(n^2)). Finishing tokens
+        # are deliberately excluded: a resume must always have at least
+        # one token left to generate, or the replacement worker could
+        # overshoot num_predict/EOS.
+        cadence = st.req.snapshot_every
+        if cadence > 0 and len(st.generated) % cadence == 0:
+            st.snapshot = (list(st.generated), st.text)
         # emit finalized text only: hold back anything that may yet turn
         # into a stop sequence (emitted chunks cannot be retracted)
         safe = len(st.text) - st.holdback()
@@ -1611,10 +1673,10 @@ class InferenceEngine:
 
     def _drain_ctl(self) -> None:
         while self._ctl:
-            req_id = self._ctl.popleft()
+            op, req_id = self._ctl.popleft()
             for slot, st in list(self._slots.items()):
                 if st.req.id == req_id:
-                    self._finish(slot, st, "cancel")
+                    self._finish(slot, st, op)
                     break
 
     def step(self) -> bool:
@@ -1730,6 +1792,10 @@ class InferenceEngine:
     def _pump_once(self) -> None:
         """One runner iteration: bounded admission, top up the dispatch
         pipeline, fetch + ingest the oldest in-flight block."""
+        # engine.step fault site (faults.py): an injected raise takes the
+        # runner's step-failure recovery path — abort in-flight requests,
+        # rebuild device state, keep serving
+        faults.inject("engine.step")
         self._drain_ctl()
         # idle engine admits everything (first tokens as early as possible);
         # a busy engine bounds admission so running streams never stall for
@@ -1897,25 +1963,31 @@ class InferenceEngine:
             n += 1
         return n
 
-    def cancel(self, req_id: str) -> bool:
-        """Cancel a pending or running request (reference analogue: job
-        cancellation publish, JobScheduler.ts:530-536 → worker). The
-        request's on_chunk gets a final done with done_reason='cancel'.
+    def resolve_seed(self) -> int:
+        """Draw a sampler seed from the ENGINE-seeded RNG — the same
+        stream admission uses for unseeded requests, so pre-resolving a
+        seed worker-side (the crash-resume watermark must carry it,
+        ISSUE 9) preserves EngineConfig.seed's reproducibility knob."""
+        return int(self._rng.getrandbits(31))
+
+    def _request_finish(self, req_id: str, op: str) -> bool:
+        """Shared body of cancel()/suspend(): finish a pending or running
+        request with done_reason=`op`.
 
         Thread-safe: pending removal happens here under the lock; a RUNNING
-        slot is cancelled via the control queue at the runner's next block
+        slot is finished via the control queue at the runner's next block
         boundary (device state must only be touched by the driving thread)."""
         with self._lock:
             for i, r in enumerate(self._pending):
                 if r.id == req_id:
                     del self._pending[i]
-                    res = GenerationResult(id=req_id, done_reason="cancel")
+                    res = GenerationResult(id=req_id, done_reason=op)
                     if r.on_chunk:
                         r.on_chunk("", True, res)
                     return True
         for _slot, st in list(self._slots.items()):
             if st.req.id == req_id:
-                self._ctl.append(req_id)
+                self._ctl.append((op, req_id))
                 if not self.running:
                     self._drain_ctl()
                 else:
@@ -1923,6 +1995,36 @@ class InferenceEngine:
                         self._work.notify_all()
                 return True
         return False
+
+    def cancel(self, req_id: str) -> bool:
+        """Cancel a pending or running request (reference analogue: job
+        cancellation publish, JobScheduler.ts:530-536 → worker). The
+        request's on_chunk gets a final done with done_reason='cancel'."""
+        return self._request_finish(req_id, "cancel")
+
+    def suspend(self, req_id: str) -> bool:
+        """Suspend a pending or running request for graceful drain
+        (ISSUE 9). A running slot finishes at the next block boundary
+        with done_reason='suspend' and a GenerationResult carrying
+        everything a resume needs (context, generated ids, text); its
+        pages register in the prefix cache exactly like a normal finish —
+        the export source for the drain migration. A still-pending
+        request suspends empty (nothing generated yet)."""
+        return self._request_finish(req_id, "suspend")
+
+    def decode_snapshot(self, req_id: str) -> dict[str, Any] | None:
+        """Last consistent resume watermark for a running request:
+        ``{"tokens": [...generated ids...], "text": "..."}``. Lock-free
+        read of the engine thread's atomic snapshot tuple (same contract
+        as batch_state); None until the first surviving token lands."""
+        for st in list(self._slots.values()):
+            if st.req.id == req_id:
+                snap = st.snapshot
+                if snap is None:
+                    return None
+                toks, text = snap
+                return {"tokens": list(toks), "text": text}
+        return None
 
     # ------------------------------------------- KV-page migration (ISSUE 7)
 

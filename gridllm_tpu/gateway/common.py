@@ -61,6 +61,11 @@ async def submit(req: InferenceRequest, scheduler: JobScheduler,
     except JobTimeoutError as e:
         raise error_cls(str(e), 504, timeout_code) from None
     if not result.success:
+        if result.error and result.error.startswith("deadline_exceeded"):
+            # queued past its class deadline and shed (ISSUE 9): the
+            # structured 504 tells the client to back off, not retry hot
+            raise error_cls("Request deadline exceeded while queued", 504,
+                            "DEADLINE_EXCEEDED")
         raise error_cls(result.error or "Inference failed", 500, failure_code)
     return result
 

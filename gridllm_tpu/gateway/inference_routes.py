@@ -15,9 +15,9 @@ import uuid
 from aiohttp import web
 
 from gridllm_tpu.gateway.common import prefix_key
+from gridllm_tpu.gateway.common import submit as submit_job
 from gridllm_tpu.gateway.errors import ApiError
 from gridllm_tpu.scheduler import JobScheduler, WorkerRegistry
-from gridllm_tpu.scheduler.scheduler import JobTimeoutError
 from gridllm_tpu.utils.types import InferenceRequest, Priority, iso_now
 
 
@@ -48,12 +48,7 @@ def build_routes(registry: WorkerRegistry, scheduler: JobScheduler) -> list[web.
                       "prefixKey": prefix_key(model, str(prompt)[:512]),
                       "submittedAt": iso_now()},
         )
-        try:
-            result = await scheduler.submit_and_wait(req)
-        except JobTimeoutError as e:
-            raise ApiError(str(e), 504, "JOB_TIMEOUT") from None
-        if not result.success:
-            raise ApiError(result.error or "Inference failed", 500, "INFERENCE_FAILED")
+        result = await submit_job(req, scheduler)
         d = result.response.model_dump(exclude_none=True) if result.response else {}
         return web.json_response({
             "id": req.id,

@@ -750,6 +750,12 @@ class PageAllocator:
         no worker has capacity, reference JobScheduler.ts:176-204). Pages
         pinned by match_prefix count toward the total; fresh pages come
         from the free list first, then evict the reuse LRU."""
+        from gridllm_tpu import faults
+
+        if faults.check("alloc.alloc"):
+            # injected pool exhaustion: exercises the caller's requeue/
+            # backpressure path without actually draining the pool
+            return None
         owned = self._owned.setdefault(slot, [])
         need = self.pages_for(num_tokens) - len(owned)
         if need > self.reclaimable_pages:

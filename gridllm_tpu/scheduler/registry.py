@@ -310,10 +310,16 @@ class WorkerRegistry(EventEmitter):
         # capacity governs, so TPU workers with continuous batching can take
         # maxBatchSlots concurrent jobs.
         cap = max(info.capabilities.maxConcurrentTasks, 1)
-        if info.currentJobs >= cap:
-            info.status = "busy"
-        elif info.currentJobs < cap and info.status == "busy":
-            info.status = "online"
+        # busy/online transitions only apply to workers that are actually
+        # serving: a "draining" worker (ISSUE 9) must never be flipped
+        # back into placement by job-count bookkeeping racing its drain —
+        # the worker itself is the only authority that clears draining
+        # (by restarting)
+        if info.status in ("online", "busy"):
+            if info.currentJobs >= cap:
+                info.status = "busy"
+            elif info.currentJobs < cap and info.status == "busy":
+                info.status = "online"
         await self.bus.hset(WORKERS_KEY, worker_id, info.model_dump_json())
         if old != info.status:
             self.emit("worker_status_changed", worker_id, old, info.status)
@@ -380,4 +386,7 @@ class WorkerRegistry(EventEmitter):
             "online": sum(1 for w in all_w if w.status == "online"),
             "busy": sum(1 for w in all_w if w.status == "busy"),
             "offline": sum(1 for w in all_w if w.status == "offline"),
+            # draining (ISSUE 9): alive but refusing new work — excluded
+            # from placement yet never force-removed while heartbeating
+            "draining": sum(1 for w in all_w if w.status == "draining"),
         }

@@ -42,6 +42,7 @@ import os
 import time
 from typing import Any, Callable
 
+from gridllm_tpu import faults
 from gridllm_tpu.obs import default_flight_recorder, default_registry
 from gridllm_tpu.transfer.wire import Assembler, WireError, iter_chunks
 from gridllm_tpu.utils.config import env_int_lenient
@@ -164,6 +165,10 @@ async def send_kv(
                              "chunks": int(header["numChunks"])}
     _MIG_INFLIGHT.inc()
     try:
+        # kvx.send fault site (faults.py): an injected failure takes the
+        # same except-path a dead transport would — the sender serves the
+        # request locally and the migration is counted failed
+        faults.inject("kvx.send")
         # receiver prepare: the decode worker's KVImportManager subscribes
         # the chunk channel and sets the ready key (header travels here,
         # out of band of the chunk stream)
@@ -386,6 +391,9 @@ class KVImportManager:
         assert state is not None
         t0 = time.time()  # tracer spans use wall-clock epoch seconds
         try:
+            # kvx.import fault site: the receiver NACKs exactly as it
+            # would on a digest/geometry mismatch; the sender falls back
+            faults.inject("kvx.import")
             tokens_list, k, v = state.assembler.arrays()
             header = state.assembler.header
             engine = self.resolve_engine(header.get("model", ""))
@@ -416,20 +424,37 @@ class KVImportManager:
                       error: str = "") -> dict[str, Any]:
         state = self._pending.pop(rid, None)
         xfer = state.xfer if state is not None else rid
+        ack: dict[str, Any] = {"ok": ok, "tokens": tokens}
+        if error:
+            ack["error"] = error
+        # Synchronous cleanup first (gauge + expire timer survive any
+        # cancellation below), then the ack, then the unsubscribe —
+        # strictly in that order. _finish usually runs inside the chunk
+        # channel's OWN handler pump, and unsubscribing that subscription
+        # cancels the very task executing this coroutine; before this
+        # ordering the CancelledError landed mid-ack (the sender saw a
+        # timeout) while desyncing the bus connection's reply stream.
+        # The unsubscribe is detached (and exception-guarded — the bus
+        # may be dead by now) from a finally so it runs even when the
+        # ack itself is cancelled.
         if state is not None:
             _MIG_INFLIGHT.dec()
             if (state.expire_task is not None
                     and state.expire_task is not asyncio.current_task()):
                 state.expire_task.cancel()
-            if state.sub is not None:
-                try:
-                    await state.sub.unsubscribe()
-                except Exception:  # noqa: BLE001
-                    pass
-        ack: dict[str, Any] = {"ok": ok, "tokens": tokens}
-        if error:
-            ack["error"] = error
-        await self._ack(xfer, **ack)
+        try:
+            await self._ack(xfer, **ack)
+        finally:
+            if state is not None and state.sub is not None:
+                sub = state.sub
+
+                async def _unsub() -> None:
+                    try:
+                        await sub.unsubscribe()
+                    except Exception:  # noqa: BLE001 — bus may be gone
+                        pass
+
+                asyncio.ensure_future(_unsub())
         return ack
 
     async def _ack(self, xfer_id: str, **ack: Any) -> None:

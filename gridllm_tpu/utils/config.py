@@ -307,6 +307,37 @@ register_env("GRIDLLM_PROFILE_DIR", "",
 register_env("GRIDLLM_PROFILE_KEEP", "4",
              "Profiler captures kept before the oldest are pruned.")
 
+# fault tolerance (ISSUE 9): drain / resume / retry shaping / deadlines
+register_env("GRIDLLM_DRAIN_BUDGET_MS", "5000",
+             "Graceful-drain budget: how long a draining worker lets "
+             "in-flight jobs finish before live-migrating the rest (ms).")
+register_env("GRIDLLM_RESUME_SNAPSHOT_TOKENS", "8",
+             "Publish a decode-state resume snapshot every N generated "
+             "tokens (crash-resume watermark); 0 disables snapshots.")
+register_env("GRIDLLM_RETRY_BACKOFF_MAX_MS", "60000",
+             "Cap for the retry ladder's exponential backoff (full "
+             "jitter; base is the retry delay).")
+register_env("GRIDLLM_RETRY_BUDGET_PER_MIN", "120",
+             "Fleet-wide retry budget (token bucket, retries/min): when "
+             "burning, further retries shed to immediate failure with "
+             "retry_budget_exhausted; 0 = unlimited.")
+register_env("GRIDLLM_REQUEST_DEADLINE_MS", "0",
+             "Queued-job deadline from submission (ms): jobs still "
+             "queued past it are shed with deadline_exceeded (HTTP 504);"
+             " 0 disables.")
+register_env("GRIDLLM_REQUEST_DEADLINE_CLASSES", "",
+             "JSON object of per-SLO-class deadline overrides (ms), e.g."
+             " {\"interactive\": 30000, \"batch\": 600000}.")
+
+# deterministic fault injection (ISSUE 9, faults.py)
+register_env("GRIDLLM_FAULT_SPEC", "",
+             "Deterministic fault-injection spec: comma list of "
+             "site=probability, site=@N (Nth call), or site=@N+ (from "
+             "the Nth call); empty disables.")
+register_env("GRIDLLM_FAULT_SEED", "0",
+             "Seed for the per-site fault-injection RNGs; the decision "
+             "sequence is a pure function of (seed, site, call #).")
+
 # static analysis / sanitizers (ISSUE 8)
 register_env("GRIDLLM_ENDPOINT", "http://localhost:4000",
              "Gateway endpoint the integration differential harness "
@@ -352,6 +383,21 @@ class SchedulerConfig(BaseModel):
     job_timeout_ms: int = Field(600_000, gt=0)
     retry_attempts: int = Field(3, ge=0)
     retry_delay_ms: int = Field(5_000, ge=0)
+    # Retry shaping (ISSUE 9): retry_delay_ms is the BASE of a capped
+    # exponential backoff with full jitter (delay ~ U[0, min(cap,
+    # base·2^attempt)]), and the fleet-wide retry budget is a token
+    # bucket — when a degraded fleet is burning retries faster than the
+    # budget refills, further retries shed to immediate failure with
+    # ``retry_budget_exhausted`` instead of melting the fleet.
+    retry_backoff_max_ms: int = Field(60_000, ge=0)
+    retry_budget_per_min: float = Field(120, ge=0)
+    # Per-class request deadlines (ISSUE 9): a job still QUEUED past its
+    # deadline (measured from first submission) is shed with
+    # ``deadline_exceeded`` (the gateway maps it to HTTP 504) instead of
+    # occupying the queue. 0 disables; the class dict overrides per
+    # SLO class (obs classify_request).
+    request_deadline_ms: int = Field(0, ge=0)
+    request_deadline_classes: dict[str, int] = Field(default_factory=dict)
     # capacity NACKs requeue without consuming the retry ladder, but only
     # this many times — a nack storm then falls through to the real ladder
     max_nacks: int = Field(25, ge=0)
@@ -432,6 +478,10 @@ class WorkerConfig(BaseModel):
     # KV-transfer fallback path. "" → 127.0.0.1:{port} (single-host
     # deployments and tests).
     advertise_addr: str = ""
+    # Graceful drain (ISSUE 9): on SIGTERM / POST /admin/drain, how long
+    # in-flight jobs get to finish before the worker live-migrates the
+    # remaining decodes (suspend + KV export + job:drain handoff).
+    drain_budget_ms: int = Field(5_000, ge=0)
 
 
 class SLOClassConfig(BaseModel):
@@ -540,6 +590,16 @@ def _slo_config_from_env() -> SLOConfig:
     return SLOConfig(**kw)
 
 
+def _deadline_classes_from_env() -> dict[str, int]:
+    """GRIDLLM_REQUEST_DEADLINE_CLASSES: JSON {class: deadline_ms}."""
+    import json
+
+    raw = env_raw("GRIDLLM_REQUEST_DEADLINE_CLASSES")
+    if not raw:
+        return {}
+    return {str(k): int(v) for k, v in json.loads(raw).items()}
+
+
 def load_config() -> Config:
     """Build Config from the environment; raise on invalid values (the
     reference fails fast at import on Joi errors, server/src/config/index.ts:45-49)."""
@@ -565,6 +625,11 @@ def load_config() -> Config:
                 prefix_affinity_weight=env_float(
                     "GRIDLLM_PREFIX_AFFINITY_WEIGHT"),
                 disagg_enabled=env_bool("GRIDLLM_DISAGG"),
+                retry_backoff_max_ms=env_int("GRIDLLM_RETRY_BACKOFF_MAX_MS"),
+                retry_budget_per_min=env_float(
+                    "GRIDLLM_RETRY_BUDGET_PER_MIN"),
+                request_deadline_ms=env_int("GRIDLLM_REQUEST_DEADLINE_MS"),
+                request_deadline_classes=_deadline_classes_from_env(),
             ),
             gateway=GatewayConfig(
                 host=_env("HOST", "0.0.0.0"),
@@ -584,6 +649,7 @@ def load_config() -> Config:
                 performance_tier=_env("PERFORMANCE_TIER", "medium"),
                 role=env_str("GRIDLLM_WORKER_ROLE"),
                 advertise_addr=env_str("GRIDLLM_WORKER_ADVERTISE_ADDR"),
+                drain_budget_ms=env_int("GRIDLLM_DRAIN_BUDGET_MS"),
             ),
             engine=EngineConfig(
                 models=env_str("GRIDLLM_MODELS"),

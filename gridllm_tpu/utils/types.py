@@ -119,7 +119,10 @@ class WorkerInfo(_Model):
 
     workerId: str
     capabilities: NodeCapabilities
-    status: Literal["online", "offline", "busy", "error"] = "online"
+    # "draining" (ISSUE 9): the worker is finishing/migrating its jobs
+    # and must receive no new assignments; it keeps heartbeating, so the
+    # liveness tiers leave it alone while the scheduler routes around it
+    status: Literal["online", "offline", "busy", "error", "draining"] = "online"
     currentJobs: int = 0
     lastHeartbeat: float = Field(default_factory=time.time)
     registeredAt: float = Field(default_factory=time.time)
@@ -244,6 +247,12 @@ class StreamChunk(_Model):
     done: bool = False
     done_reason: str | None = None
     eval_count: int | None = None
+    # absolute char index of this frame's first char in the FULL response
+    # text (ISSUE 9): lets the gateway trim any overlap between a dying
+    # attempt's in-flight frames and the resumed attempt's re-emission,
+    # so the client-observed stream is exactly-once. None on frames from
+    # workers that don't track offsets (pre-ISSUE 9 compatibility).
+    offset: int | None = None
 
 
 class JobResult(_Model):

@@ -188,6 +188,22 @@ def build_health_app(service: WorkerService) -> web.Application:
             handle_profile_request, request.query.get("seconds"))
         return web.json_response(payload, status=status)
 
+    async def drain(request):
+        # graceful drain (ISSUE 9): stop accepting work, finish short
+        # decodes within the budget, live-migrate the rest. The worker
+        # keeps running afterward (status "draining") — process exit is
+        # the SIGTERM path's job; this route is for rolling restarts
+        # orchestrated from outside.
+        budget = request.query.get("budget_ms")
+        try:
+            budget_ms = int(budget) if budget else None
+        except ValueError:
+            return web.json_response(
+                {"error": f"budget_ms must be an integer, got {budget!r}"},
+                status=400)
+        report = await service.drain(budget_ms)
+        return web.json_response(report)
+
     async def kvx(request):
         # direct worker-to-worker KV migration (ISSUE 7): the whole wire
         # payload in one POST — the large-transfer fast path that skips
@@ -206,6 +222,7 @@ def build_health_app(service: WorkerService) -> web.Application:
         web.get("/worker/status", status), web.get("/metrics", metrics),
         web.get("/admin/dump", dump), web.get("/admin/memory", memory),
         web.post("/admin/profile", profile),
+        web.post("/admin/drain", drain),
         web.post("/kvx/{request_id}", kvx),
     ])
     return app
@@ -315,6 +332,36 @@ async def run(config: Config | None = None) -> None:
         site = web.TCPSite(runner, config.worker.host, config.worker.port)
         await site.start()
         log.info("worker http listening", port=config.worker.port)
+
+        # Graceful drain on SIGTERM (ISSUE 9): rolling deploys and TPU
+        # preemption notices deliver SIGTERM first — finish short decodes
+        # within the drain budget, live-migrate the rest, then exit. The
+        # service.stop() in the finally publishes the unregister, so any
+        # job the drain could not hand off orphan-requeues WITH its
+        # resume snapshot preserved scheduler-side.
+        import signal as _signal
+
+        # the drain task must be held somewhere that outlives the signal
+        # handler: the loop keeps only a weak reference, and a collected
+        # task would silently skip stop.set() — the worker would ignore
+        # SIGTERM until the orchestrator escalates to SIGKILL
+        drain_tasks: list[asyncio.Task] = []
+
+        def _on_sigterm() -> None:
+            async def _graceful() -> None:
+                try:
+                    await service.drain()
+                finally:
+                    stop.set()
+
+            log.info("SIGTERM received; draining before exit")
+            drain_tasks.append(asyncio.ensure_future(_graceful()))
+
+        try:
+            asyncio.get_running_loop().add_signal_handler(
+                _signal.SIGTERM, _on_sigterm)
+        except (NotImplementedError, RuntimeError):  # non-unix platforms
+            pass
         try:
             await stop.wait()
         finally:

@@ -1,0 +1,763 @@
+"""Mid-stream fault tolerance (ISSUE 9): graceful drain, decode-resume
+after worker loss, retry shaping, deadlines, and the deterministic
+fault-injection layer.
+
+The headline invariant: however a worker dies mid-stream — abrupt kill,
+graceful drain with live migration, drain whose migration itself fails,
+engine step crash — the client-observed token stream is EXACTLY-ONCE
+(no gap, no duplicate) and byte-identical to the undisturbed run, greedy
+and seeded-sampled, prefix cache on and off.
+
+Chaos here is in-process and deterministic: a `PartitionableBus` facade
+silences one worker the way SIGKILL does (publishes vanish, the
+heartbeat key stops refreshing), drains are invoked directly, and every
+injected failure goes through gridllm_tpu/faults.py so the scenario is a
+pure function of its seed. The RESP-broker rolling-restart smoke (slow)
+adds a real broker between the parties.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+import uuid
+
+import pytest
+
+from gridllm_tpu import faults
+from gridllm_tpu.bus import InMemoryBus
+from gridllm_tpu.engine import EngineConfig, InferenceEngine
+from gridllm_tpu.scheduler import JobScheduler, WorkerRegistry
+from gridllm_tpu.utils.config import SchedulerConfig, WorkerConfig
+from gridllm_tpu.utils.types import InferenceRequest, JobAssignment
+from gridllm_tpu.worker.service import WorkerService
+
+from .helpers import FakeWorker, fast_config
+
+MODEL = "tiny-llama"
+PROMPT = "the quick brown fox jumps over the lazy dog " * 2
+N_PREDICT = 48   # long enough that mid-stream chaos lands mid-decode
+CHAOS_TOKENS = 4  # decode progress (snapshot watermark) before chaos fires
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def make_engine(**kw) -> InferenceEngine:
+    cfg = dict(
+        model=MODEL, max_slots=2, page_size=8, num_pages=96,
+        max_pages_per_slot=16, prefill_buckets=(16, 64, 128), seed=42,
+        prefill_chunk=16,
+    )
+    cfg.update(kw)
+    return InferenceEngine(EngineConfig(**cfg))
+
+
+def ft_config(**kw) -> SchedulerConfig:
+    """Sub-second liveness (a killed worker must be detected fast) but a
+    generous job timeout (children pay first-compile costs)."""
+    base = dict(
+        worker_heartbeat_timeout_ms=600,
+        worker_cleanup_interval_ms=100,
+        connection_monitor_interval_ms=100,
+        quick_disconnect_window_ms=400,
+        orphan_assign_threshold_ms=200,
+        job_timeout_ms=180_000,
+        retry_attempts=3,
+        retry_delay_ms=50,
+        sweep_interval_ms=100,
+    )
+    base.update(kw)
+    return SchedulerConfig(**base)
+
+
+class PartitionableBus:
+    """Per-worker facade over the shared in-memory bus. Flipping ``dead``
+    is SIGKILL as the cluster sees it: every outbound publish/hset/
+    heartbeat-key refresh vanishes, so the registry's liveness tiers see
+    an abruptly dead worker — while the victim process (here: its tasks
+    and engine thread) keeps running, exactly like a real partition."""
+
+    def __init__(self, inner):
+        self._inner = inner
+        self.dead = False
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    async def publish(self, channel: str, message: str):
+        if self.dead:
+            return 0
+        return await self._inner.publish(channel, message)
+
+    async def hset(self, key: str, field: str, value: str):
+        if self.dead:
+            return
+        return await self._inner.hset(key, field, value)
+
+    async def set_with_expiry(self, key: str, value: str, ttl_s: float):
+        if self.dead:
+            return
+        return await self._inner.set_with_expiry(key, value, ttl_s)
+
+
+class Fleet:
+    """In-process fleet: scheduler + N real-engine unified workers, each
+    behind its own PartitionableBus so one can be killed mid-stream."""
+
+    def __init__(self, n: int = 1, snap_every: int = 2,
+                 cfg: SchedulerConfig | None = None):
+        self.n = n
+        self.snap_every = snap_every
+        self.cfg = cfg or ft_config()
+        self.workers: list[WorkerService] = []
+
+    async def __aenter__(self) -> "Fleet":
+        self.bus = InMemoryBus()
+        await self.bus.connect()
+        self.registry = WorkerRegistry(self.bus, self.cfg)
+        self.scheduler = JobScheduler(self.bus, self.registry, self.cfg)
+        await self.registry.initialize()
+        await self.scheduler.initialize()
+        for i in range(self.n):
+            svc = WorkerService(
+                PartitionableBus(self.bus), {MODEL: make_engine()},
+                WorkerConfig(worker_id=f"ft-w{i}",
+                             heartbeat_interval_ms=150),
+                stream_flush_ms=5)
+            svc._snap_every = self.snap_every
+            await svc.start()
+            self.workers.append(svc)
+        await asyncio.sleep(0.4)  # first heartbeats land
+        return self
+
+    async def __aexit__(self, *exc) -> None:
+        for svc in self.workers:
+            await svc.stop(announce=False)
+        await self.scheduler.shutdown()
+        await self.registry.shutdown()
+        await self.bus.disconnect()
+
+    def resume_count(self, event: str) -> int:
+        return int(self.scheduler._resume_total.value(event=event))
+
+    def job_count(self, event: str) -> int:
+        return int(self.scheduler._jobs_total.value(event=event))
+
+    def worker_for(self, job_id: str) -> WorkerService:
+        wid = self.scheduler.active_jobs[job_id].workerId
+        return next(w for w in self.workers if w.worker_id == wid)
+
+    async def wait_decode_progress(self, job_id: str,
+                                   min_tokens: int = CHAOS_TOKENS) -> None:
+        """Block until the job's snapshot watermark covers min_tokens —
+        a DETERMINISTIC mid-decode point (client-observed chars lag the
+        engine arbitrarily under load, so they cannot time chaos)."""
+        for _ in range(9000):
+            snap = self.scheduler._resume_snap.get(job_id)
+            if snap is not None and len(snap["tokens"]) >= min_tokens:
+                return
+            await asyncio.sleep(0.01)
+        raise AssertionError("decode never reached the chaos point")
+
+    async def run(self, n: int = N_PREDICT, chaos=None,
+                  chaos_wait: bool = True, **opts):
+        """One streaming request. ``chaos(job_id)`` fires once, as soon
+        as the decode's snapshot watermark shows mid-stream progress
+        (``chaos_wait=False`` hands the timing to the callback)."""
+        chunks: list[str] = []
+
+        async def on_chunk(c) -> None:
+            chunks.append(c.response)
+
+        req = InferenceRequest(
+            id=f"ft-{uuid.uuid4().hex[:8]}", model=MODEL, prompt=PROMPT,
+            stream=True,
+            options={"temperature": 0, "num_predict": n, **opts},
+            metadata={"requestType": "inference"})
+        task = asyncio.create_task(self.scheduler.submit_streaming_job(
+            req, on_chunk, timeout_ms=120_000))
+        if chaos is not None:
+            if chaos_wait:
+                await self.wait_decode_progress(req.id)
+            await chaos(req.id)
+        result = await task
+        text = "".join(chunks)
+        if result.success and result.response is not None:
+            # self-consistency: the delivered stream IS the final text —
+            # no splice, no gap, no duplicate, whatever chaos happened
+            assert text == (result.response.response or ""), \
+                "client stream diverged from the final response text"
+        return text, result
+
+
+async def reference_run(n: int = N_PREDICT, **opts) -> tuple[str, int]:
+    """The undisturbed run every chaos stream must byte-match."""
+    async with Fleet(1) as ref:
+        text, res = await ref.run(n=n, **opts)
+        assert res.success, res.error
+        return text, int(res.response.eval_count or 0)
+
+
+# ------------------------------------------------------------ faults.py
+
+
+def test_fault_spec_parse_rejects_garbage():
+    with pytest.raises(ValueError, match="unknown fault site"):
+        faults.parse_spec("bus.pubish=1", 0)
+    with pytest.raises(ValueError, match="expected site=value"):
+        faults.parse_spec("bus.publish", 0)
+    with pytest.raises(ValueError, match="probability"):
+        faults.parse_spec("bus.publish=1.5", 0)
+    with pytest.raises(ValueError, match="1-based"):
+        faults.parse_spec("bus.publish=@0", 0)
+    assert faults.parse_spec("", 0) == {}
+
+
+def test_fault_decisions_are_a_pure_function_of_seed():
+    """Same (seed, site) -> same decision sequence; different seed -> a
+    different one. This is what makes chaos runs replayable."""
+
+    def seq(seed: int, k: int = 64) -> list[bool]:
+        faults.configure("engine.step=0.3", seed=seed)
+        return [faults.check("engine.step") for _ in range(k)]
+
+    a, b, c = seq(7), seq(7), seq(8)
+    assert a == b
+    assert a != c
+    assert any(a) and not all(a)
+    # sites draw from INDEPENDENT streams: one site's calls never
+    # perturb another's decisions
+    faults.configure("engine.step=0.3,bus.deliver=0.3", seed=7)
+    mixed = []
+    for _ in range(64):
+        faults.check("bus.deliver")
+        mixed.append(faults.check("engine.step"))
+    assert mixed == a
+
+
+def test_fault_call_index_modes():
+    faults.configure("kvx.send=@3", seed=0)
+    fired = [faults.check("kvx.send") for _ in range(6)]
+    assert fired == [False, False, True, False, False, False]
+    faults.configure("kvx.send=@3+", seed=0)
+    fired = [faults.check("kvx.send") for _ in range(6)]
+    assert fired == [False, False, True, True, True, True]
+    # unconfigured sites never fire; inject() raises only when armed
+    assert not faults.check("alloc.alloc")
+    faults.configure("alloc.alloc=@1", seed=0)
+    with pytest.raises(faults.InjectedFault):
+        faults.inject("alloc.alloc")
+
+
+def test_fault_env_spec_loads_lazily(monkeypatch):
+    monkeypatch.setenv("GRIDLLM_FAULT_SPEC", "worker.heartbeat=@1")
+    monkeypatch.setenv("GRIDLLM_FAULT_SEED", "3")
+    faults.reset()  # forget, then lazily re-read the env on first check
+    assert faults.check("worker.heartbeat")
+    assert not faults.check("worker.heartbeat")
+
+
+async def test_bus_sites_drop_and_raise():
+    bus = InMemoryBus()
+    await bus.connect()
+    got: list[str] = []
+
+    async def handler(_ch: str, raw: str) -> None:
+        got.append(raw)
+
+    await bus.subscribe("ft:chan", handler)
+    try:
+        faults.configure("bus.deliver=@1", seed=0)
+        await bus.publish("ft:chan", "lost")
+        await bus.publish("ft:chan", "kept")
+        await bus.flush()
+        assert got == ["kept"]  # first delivery dropped before the handler
+        faults.configure("bus.publish=@1", seed=0)
+        with pytest.raises(faults.InjectedFault):
+            await bus.publish("ft:chan", "never-sent")
+        await bus.publish("ft:chan", "after")
+        await bus.flush()
+        assert got == ["kept", "after"]
+    finally:
+        await bus.disconnect()
+
+
+# ------------------------------------- retry shaping + request deadlines
+
+
+def _bare_scheduler(cfg: SchedulerConfig) -> JobScheduler:
+    """Uninitialized scheduler — enough for its pure helpers."""
+    bus = InMemoryBus()
+    return JobScheduler(bus, WorkerRegistry(bus, cfg), cfg)
+
+
+def test_retry_backoff_doubles_and_caps():
+    s = _bare_scheduler(SchedulerConfig(
+        retry_delay_ms=100, retry_backoff_max_ms=500))
+    assert [s._retry_backoff_ms(a) for a in range(5)] == [
+        100.0, 200.0, 400.0, 500.0, 500.0]
+    # cap never undershoots the base, and attempt never goes negative
+    s2 = _bare_scheduler(SchedulerConfig(
+        retry_delay_ms=100, retry_backoff_max_ms=10))
+    assert s2._retry_backoff_ms(0) == 100.0
+    assert s2._retry_backoff_ms(-1) == 100.0
+
+
+def test_retry_budget_token_bucket():
+    s = _bare_scheduler(SchedulerConfig(retry_budget_per_min=2))
+    assert s._take_retry_token()
+    assert s._take_retry_token()
+    assert not s._take_retry_token()  # burnt — shed
+    # refill is continuous: half a minute buys one token back
+    s._retry_refill_t -= 30
+    assert s._take_retry_token()
+    assert not s._take_retry_token()
+    # 0 = unlimited
+    s0 = _bare_scheduler(SchedulerConfig(retry_budget_per_min=0))
+    assert all(s0._take_retry_token() for _ in range(100))
+
+
+async def test_retry_budget_exhaustion_sheds_to_immediate_failure():
+    """A worker failing every attempt burns the one-token budget on its
+    first retry; the second shed-checks, fails immediately with
+    ``retry_budget_exhausted``, and never melts through the full ladder."""
+    bus = InMemoryBus()
+    await bus.connect()
+    cfg = fast_config()
+    cfg.retry_attempts = 5
+    cfg.retry_delay_ms = 20
+    cfg.retry_budget_per_min = 1
+    registry = WorkerRegistry(bus, cfg)
+    scheduler = JobScheduler(bus, registry, cfg)
+    await registry.initialize()
+    await scheduler.initialize()
+    worker = FakeWorker(bus, "always-fails", ["m1"], fail_times=99)
+    await worker.start()
+    try:
+        req = InferenceRequest(id="budget-1", model="m1", prompt="x")
+        result = await scheduler.submit_and_wait(req, timeout_ms=10_000)
+        assert not result.success
+        assert result.error.startswith("retry_budget_exhausted")
+        assert not result.retryable
+        assert int(scheduler._jobs_total.value(event="retried")) == 1
+        assert int(scheduler._jobs_total.value(
+            event="retry_budget_exhausted")) == 1
+    finally:
+        await worker.stop(announce=False)
+        await scheduler.shutdown()
+        await registry.shutdown()
+        await bus.disconnect()
+
+
+def test_deadline_for_class_overrides():
+    s = _bare_scheduler(SchedulerConfig(
+        request_deadline_ms=60_000,
+        request_deadline_classes={"batch": 1_000}))
+    batch = InferenceRequest(id="d1", model="m", prompt="x")
+    interactive = InferenceRequest(id="d2", model="m", prompt="x",
+                                   stream=True)
+    assert s._deadline_for(batch) == 1_000
+    assert s._deadline_for(interactive) == 60_000
+
+
+async def test_queued_job_past_deadline_is_shed_with_504():
+    """The only model owner is saturated, so the job queues; it crosses
+    its deadline, the sweep's dispatch pass sheds it with
+    ``deadline_exceeded``, and the gateway maps the failure to a
+    structured HTTP 504."""
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from gridllm_tpu.gateway.app import create_app
+    from gridllm_tpu.utils.config import Config
+
+    bus = InMemoryBus()
+    await bus.connect()
+    cfg = fast_config()
+    cfg.request_deadline_ms = 300
+    registry = WorkerRegistry(bus, cfg)
+    scheduler = JobScheduler(bus, registry, cfg)
+    await registry.initialize()
+    await scheduler.initialize()
+    # owns the model (the gateway's availability check passes) but
+    # reports over-capacity — the job stays queued until the deadline
+    # sheds it
+    worker = FakeWorker(bus, "saturated", ["m1"], max_concurrent=1)
+    worker.current_jobs = 5
+    await worker.start()
+    config = Config()
+    config.scheduler = cfg
+    app = create_app(bus, registry, scheduler, config)
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    try:
+        t0 = time.monotonic()
+        resp = await client.post("/ollama/api/generate", json={
+            "model": "m1", "prompt": "x", "stream": False})
+        elapsed = time.monotonic() - t0
+        assert resp.status == 504
+        body = await resp.json()
+        assert body["error"]["code"] == "DEADLINE_EXCEEDED"
+        # shed at the deadline, NOT at the 5 s job timeout
+        assert elapsed < 3.0
+        assert int(scheduler._jobs_total.value(
+            event="deadline_exceeded")) == 1
+        assert scheduler.get_job_queue() == []
+        assert scheduler.tracer.active_count() == 0
+    finally:
+        await client.close()
+        await worker.stop(announce=False)
+        await scheduler.shutdown()
+        await registry.shutdown()
+        await bus.disconnect()
+
+
+# ------------------------------------- requeue hygiene (satellite 6)
+
+
+def test_snapshot_merge_is_monotonic():
+    """Late/short/garbage snapshot deliveries never roll the watermark
+    back — the stored snapshot only grows."""
+    s = _bare_scheduler(SchedulerConfig())
+    s._merge_snapshot("j1", {"tokens": [1, 2, 3], "seed": 7})
+    s._merge_snapshot("j1", {"tokens": [9], "seed": 8})          # shorter
+    s._merge_snapshot("j1", {"tokens": []})                       # empty
+    s._merge_snapshot("j1", {"tokens": ["x"]})                    # garbage
+    s._merge_snapshot("j1", {})                                   # missing
+    assert s._resume_snap["j1"] == {"tokens": [1, 2, 3], "seed": 7}
+    s._merge_snapshot("j1", {"tokens": [1, 2, 3, 4], "seed": 7})  # longer
+    assert s._resume_snap["j1"]["tokens"] == [1, 2, 3, 4]
+
+
+async def test_orphan_requeue_preserves_resume_and_strips_disagg():
+    """Satellite 6: orphan-requeue strips the stale disagg plan (fresh
+    dispatch replans) but must NOT drop the resume watermark — the
+    replacement continues the decode instead of restarting it."""
+    bus = InMemoryBus()
+    await bus.connect()
+    cfg = ft_config()
+    registry = WorkerRegistry(bus, cfg)
+    scheduler = JobScheduler(bus, registry, cfg)
+    await registry.initialize()
+    await scheduler.initialize()
+    try:
+        req = InferenceRequest(
+            id="orph-1", model=MODEL, prompt="x",
+            metadata={"disagg": {"decodeWorkerId": "d1"},
+                      "disaggPhase": "prefill"})
+        assignment = JobAssignment(jobId="orph-1", workerId="w-dead",
+                                   request=req, timeout=60_000)
+        scheduler.active_jobs["orph-1"] = assignment
+        scheduler._merge_snapshot("orph-1", {"tokens": [1, 2, 3],
+                                             "seed": 7})
+        scheduler._stream_chars["orph-1"] = 11
+        await scheduler._orphan_job(assignment, reason="orphan_sweep")
+        queued = scheduler.get_job_queue()
+        assert [r.id for r in queued] == ["orph-1"]
+        md = queued[0].metadata
+        assert "disagg" not in md and "disaggPhase" not in md
+        assert md["resume"] == {"tokens": [1, 2, 3], "seed": 7,
+                                "sentChars": 11}
+        assert int(scheduler._resume_total.value(event="stamped")) == 1
+    finally:
+        await scheduler.shutdown()
+        await registry.shutdown()
+        await bus.disconnect()
+
+
+# ------------------------------------------ exactly-once chaos streams
+
+
+async def test_kill_worker_mid_stream_greedy_exactly_once():
+    """THE acceptance criterion: SIGKILL-equivalent loss of the serving
+    worker mid-decode; the replacement resumes from the snapshot
+    watermark and the client stream is byte-identical to the undisturbed
+    greedy run — no gap, no duplicate, same eval_count."""
+    text_ref, evals_ref = await reference_run()
+    async with Fleet(2) as f:
+
+        async def kill(job_id: str) -> None:
+            victim = f.worker_for(job_id)
+            victim.bus.dead = True  # type: ignore[attr-defined]
+
+        text, res = await f.run(chaos=kill)
+        assert res.success, res.error
+        assert text == text_ref
+        assert int(res.response.eval_count or 0) == evals_ref
+        assert f.job_count("orphaned") >= 1
+        assert f.resume_count("stamped") >= 1
+        # the job finished on the surviving worker
+        survivor = next(w for w in f.workers
+                        if not w.bus.dead)  # type: ignore[attr-defined]
+        assert res.workerId == survivor.worker_id
+        assert f.scheduler.tracer.active_count() == 0
+
+
+async def test_kill_worker_mid_stream_seeded_sampled_cache_off(monkeypatch):
+    """Seeded-sampled resume with the prefix cache OFF: the snapshot's
+    (seed, step) chain — not cached pages — is what makes the resumed
+    stream byte-match. Spec decoding is disabled here because its
+    rejection-sampling draws are not replayable by a direct draw: a
+    spec-on sampled resume is distribution-correct (the tentpole's
+    guarantee), byte-identical only without speculation."""
+    monkeypatch.setenv("GRIDLLM_PREFIX_CACHE", "0")
+    monkeypatch.setenv("GRIDLLM_SPEC_DECODE", "0")
+    opts = dict(temperature=0.9, seed=1234)
+    text_ref, evals_ref = await reference_run(**opts)
+    async with Fleet(2) as f:
+
+        async def kill(job_id: str) -> None:
+            f.worker_for(job_id).bus.dead = True  # type: ignore[attr-defined]
+
+        text, res = await f.run(chaos=kill, **opts)
+        assert res.success, res.error
+        assert text == text_ref
+        assert int(res.response.eval_count or 0) == evals_ref
+        assert f.resume_count("stamped") >= 1
+
+
+async def test_kill_before_first_snapshot_unseeded_sampled_no_splice(
+        monkeypatch):
+    """A sampled request with NO client seed dies before its first
+    token snapshot. The worker's seed-only watermark (published at
+    generation start) makes the retry replay the SAME resolved seed, so
+    the restarted-from-zero regeneration is byte-identical and the
+    gateway's overlap trim cannot splice two divergent samples — the
+    delivered stream must equal the final response text exactly."""
+    monkeypatch.setenv("GRIDLLM_SPEC_DECODE", "0")
+    # snap_every so large that NO token snapshot ever publishes: the
+    # seed-only watermark is all the scheduler has when the kill lands
+    async with Fleet(2, snap_every=10_000) as f:
+
+        async def kill_at_seed_watermark(job_id: str) -> None:
+            # the seed-only entry exists as soon as generation starts —
+            # kill in the pre-first-token-snapshot window
+            await f.wait_decode_progress(job_id, min_tokens=0)
+            f.worker_for(job_id).bus.dead = True  # type: ignore[attr-defined]
+
+        text, res = await f.run(chaos=kill_at_seed_watermark,
+                                chaos_wait=False, temperature=0.9)
+        assert res.success, res.error
+        # the load-bearing check already ran inside run(): the delivered
+        # stream equals the final text — no splice of divergent samples
+        # (a sampled run may stop at EOS before num_predict, so the
+        # token count itself is not asserted)
+        assert text
+        assert int(res.response.eval_count or 0) > 0
+        assert f.job_count("orphaned") >= 1
+        assert f.resume_count("stamped") >= 1  # seed-only stamp counts
+
+
+async def test_graceful_drain_live_migrates_mid_decode():
+    """Graceful drain mid-decode: the draining worker suspends the
+    decode, migrates its KV to the peer, and the scheduler moves the
+    assignment on ``job:drain`` — the stream continues seamlessly with
+    zero lost and zero duplicated tokens, and the drained worker takes
+    no new work while it winds down."""
+    # a longer decode + the earliest possible trigger: drain() has a few
+    # event-loop hops of latency, and a warm engine can burst through a
+    # short tail before the suspend lands
+    n_drain = 96
+    text_ref, evals_ref = await reference_run(n=n_drain)
+    async with Fleet(2) as f:
+        drained: list[WorkerService] = []
+
+        async def drain(job_id: str) -> None:
+            await f.wait_decode_progress(job_id, min_tokens=2)
+            victim = f.worker_for(job_id)
+            drained.append(victim)
+            report = await victim.drain(budget_ms=0)
+            assert report["suspended"] == 1
+
+        text, res = await f.run(n=n_drain, chaos=drain, chaos_wait=False)
+        assert res.success, res.error
+        assert text == text_ref
+        assert int(res.response.eval_count or 0) == evals_ref
+        assert f.resume_count("drain_handoff") == 1
+        victim = drained[0]
+        survivor = next(w for w in f.workers if w is not victim)
+        assert res.workerId == survivor.worker_id
+        # zero token loss: nothing was orphaned, nothing retried
+        assert f.job_count("orphaned") == 0
+        assert f.job_count("retried") == 0
+        # the drained worker advertises "draining" and receives no new
+        # work — the next request lands on the survivor
+        for _ in range(40):
+            w = f.registry.get_worker(victim.worker_id)
+            if w is not None and w.status == "draining":
+                break
+            await asyncio.sleep(0.05)
+        assert f.registry.get_worker(victim.worker_id).status == "draining"
+        text2, res2 = await f.run(n=n_drain)
+        assert res2.success and text2 == text_ref
+        assert res2.workerId == survivor.worker_id
+
+
+async def test_drain_migration_fault_falls_back_to_resume_requeue():
+    """Satellite 3's mid-migration death, deterministically: the drain's
+    KV send fails (injected ``kvx.send``), so the handoff degrades to a
+    front-requeue WITH the resume snapshot — the stream still completes
+    exactly-once on the peer."""
+    n_drain = 96
+    text_ref, evals_ref = await reference_run(n=n_drain)
+    async with Fleet(2) as f:
+
+        async def drain_with_send_fault(job_id: str) -> None:
+            await f.wait_decode_progress(job_id, min_tokens=2)
+            faults.configure("kvx.send=@1", seed=11)
+            victim = f.worker_for(job_id)
+            await victim.drain(budget_ms=0)
+
+        text, res = await f.run(n=n_drain, chaos=drain_with_send_fault,
+                                chaos_wait=False)
+        assert res.success, res.error
+        assert text == text_ref
+        assert int(res.response.eval_count or 0) == evals_ref
+        assert f.resume_count("drain_handoff") == 0
+        assert f.resume_count("drain_requeued") == 1
+        assert f.resume_count("stamped") >= 1
+        from gridllm_tpu.faults import _INJECTED
+
+        assert int(_INJECTED.value(site="kvx.send")) >= 1
+
+
+@pytest.mark.slow
+async def test_rolling_restart_over_resp_broker_zero_token_loss():
+    """fault-smoke (satellite 5): a rolling restart over a REAL RESP
+    broker. Worker w0 serves a stream, drains mid-decode (live-migrating
+    the decode to w1) and stops; a replacement w2 comes up; then w1
+    drains mid-stream too and the decode lands on w2. Every client
+    stream is byte-identical to the undisturbed run — zero tokens lost
+    or duplicated across two generations of workers."""
+    from gridllm_tpu.bus import create_bus
+    from gridllm_tpu.bus.broker import GridBusBroker
+
+    # a longer decode than the in-process tests: broker latency delays
+    # the client-side chaos trigger, and the drain must land while the
+    # engine still holds the slot
+    n_roll = 96
+    text_ref, _ = await reference_run(n=n_roll)
+
+    broker = GridBusBroker()
+    await broker.start(port=0)
+    url = f"resp://127.0.0.1:{broker.port}"
+    bus = create_bus(url)
+    await bus.connect()
+    # generous liveness: drains are EXPLICIT here, and first-compile GIL
+    # pressure over a real broker starves heartbeats long enough to trip
+    # sub-second probes into false positives (worker removed -> no peer)
+    cfg = ft_config(worker_heartbeat_timeout_ms=60_000,
+                    worker_cleanup_interval_ms=1_000,
+                    connection_monitor_interval_ms=1_000,
+                    quick_disconnect_window_ms=30_000,
+                    orphan_assign_threshold_ms=30_000)
+    registry = WorkerRegistry(bus, cfg)
+    scheduler = JobScheduler(bus, registry, cfg)
+    await registry.initialize()
+    await scheduler.initialize()
+    workers: dict[str, WorkerService] = {}
+    worker_buses = []
+
+    async def spawn(wid: str) -> WorkerService:
+        wbus = create_bus(url)
+        await wbus.connect()
+        worker_buses.append(wbus)
+        svc = WorkerService(
+            wbus, {MODEL: make_engine()},
+            WorkerConfig(worker_id=wid, heartbeat_interval_ms=150),
+            stream_flush_ms=5)
+        svc._snap_every = 2
+        await svc.start()
+        workers[wid] = svc
+        return svc
+
+    async def run_stream(drain_wid_holder: list) -> tuple[str, str]:
+        chunks: list[str] = []
+
+        async def on_chunk(c) -> None:
+            chunks.append(c.response)
+
+        req = InferenceRequest(
+            id=f"roll-{uuid.uuid4().hex[:8]}", model=MODEL, prompt=PROMPT,
+            stream=True,
+            options={"temperature": 0, "num_predict": n_roll},
+            metadata={"requestType": "inference"})
+        task = asyncio.create_task(scheduler.submit_streaming_job(
+            req, on_chunk, timeout_ms=150_000))
+        # deterministic mid-decode point: the snapshot watermark, not
+        # client-observed chars (those lag the engine under load)
+        for _ in range(12000):
+            snap = scheduler._resume_snap.get(req.id)
+            if snap is not None and len(snap["tokens"]) >= CHAOS_TOKENS:
+                break
+            await asyncio.sleep(0.01)
+        victim_id = scheduler.active_jobs[req.id].workerId
+        drain_wid_holder.append(victim_id)
+        report = await workers[victim_id].drain(budget_ms=0)
+        assert report["suspended"] == 1, report
+        res = await task
+        assert res.success, res.error
+        return "".join(chunks), res.workerId
+
+    try:
+        await spawn("roll-w0")
+        await spawn("roll-w1")
+        for _ in range(600):
+            if len(registry.get_online_workers()) == 2:
+                break
+            await asyncio.sleep(0.1)
+        assert len(registry.get_online_workers()) == 2
+
+        # round 1: the serving worker drains mid-stream, peer finishes
+        drained1: list[str] = []
+        text1, served1 = await run_stream(drained1)
+        assert text1 == text_ref
+        assert served1 != drained1[0]
+        # the drained worker restarts as a fresh instance
+        await workers[drained1[0]].stop(announce=False)
+        await spawn("roll-w2")
+        for _ in range(600):
+            live = {w.workerId for w in registry.get_online_workers()}
+            if "roll-w2" in live:
+                break
+            await asyncio.sleep(0.1)
+
+        # round 2: the survivor of round 1 drains mid-stream too
+        drained2: list[str] = []
+        text2, served2 = await run_stream(drained2)
+        assert text2 == text_ref
+        assert served2 != drained2[0]
+        assert int(scheduler._resume_total.value(
+            event="drain_handoff")) == 2
+        # zero token loss across the whole rolling restart
+        assert int(scheduler._jobs_total.value(event="orphaned")) == 0
+    finally:
+        for svc in workers.values():
+            await svc.stop(announce=False)
+        await scheduler.shutdown()
+        await registry.shutdown()
+        await bus.disconnect()
+        for wbus in worker_buses:
+            await wbus.disconnect()
+        await broker.stop()
+
+
+async def test_engine_step_fault_recovers_exactly_once():
+    """An injected engine-step crash mid-decode takes the runner's
+    abort-and-rebuild path; the failed attempt retries WITH its resume
+    watermark and the client stream is still byte-identical."""
+    text_ref, evals_ref = await reference_run()
+    async with Fleet(1) as f:
+
+        async def crash_next_step(_job_id: str) -> None:
+            faults.configure("engine.step=@1", seed=5)
+
+        text, res = await f.run(chaos=crash_next_step)
+        assert res.success, res.error
+        assert text == text_ref
+        assert int(res.response.eval_count or 0) == evals_ref
+        assert f.job_count("retried") >= 1
