@@ -20,7 +20,7 @@ from __future__ import annotations
 import abc
 import asyncio
 import time
-from typing import Awaitable, Callable
+from typing import Any, Awaitable, Callable
 
 from gridllm_tpu import faults
 from gridllm_tpu.obs import metrics as obs
@@ -70,6 +70,73 @@ def channel_class(channel: str) -> str:
     if channel.startswith("worker:") and channel.endswith(":job"):
         return "worker:job"
     return channel
+
+
+# -- durable channel classes (ISSUE 10) -------------------------------------
+#
+# Channels whose loss mid-outage is NOT recoverable by the at-least-once
+# sweeps alone: result/stream frames feed live client streams, snapshots
+# are the crash-resume watermarks, handoff/drain move live assignments,
+# kvx:* carries KV-page migration chunks, and worker:{id}:job carries
+# assignments/cancellations (an assignment published while the worker's
+# subscriber is mid-reconnect would otherwise vanish until the job
+# timeout). The broker assigns these a per-channel monotonic sequence
+# number and keeps a bounded replay ring; a reconnecting RespBus
+# subscriber issues RESUME to replay the gap and dedupes by seq, so
+# consumer-observed delivery is exactly-once across a broker bounce.
+# Everything else (heartbeats, registration, traces) is periodic or
+# best-effort and stays plain fire-and-forget pub/sub.
+_DURABLE_PREFIXES = ("job:result:", "job:stream:", "admin:result:", "kvx:")
+_DURABLE_CHANNELS = frozenset((
+    "job:completed", "job:failed", "job:timeout",
+    "job:snapshot", "job:handoff", "job:drain",
+))
+
+
+def durable_channel(channel: str) -> bool:
+    """True when the broker sequences + ring-buffers this channel."""
+    if channel in _DURABLE_CHANNELS or channel.startswith(_DURABLE_PREFIXES):
+        return True
+    return channel.startswith("worker:") and channel.endswith(":job")
+
+
+# Sequence framing on durable channels: the broker prefixes the payload
+# with an out-of-band marker + seq so subscribers can dedupe replays.
+# Payloads are JSON in this protocol, so the NUL-framed marker can never
+# collide with organic content; a broker that doesn't sequence (real
+# Redis) simply yields seq=None and the client skips dedupe/resume.
+_SEQ_MARK = "\x00q\x00"
+
+
+def encode_seq(seq: int, payload: str) -> str:
+    return f"{_SEQ_MARK}{seq}\x00{payload}"
+
+
+def split_seq(payload: str) -> tuple[int | None, str]:
+    """(seq, body) for a seq-framed payload; (None, payload) otherwise."""
+    if not payload.startswith(_SEQ_MARK):
+        return None, payload
+    rest = payload[len(_SEQ_MARK):]
+    num, sep, body = rest.partition("\x00")
+    if not sep or not num.isdigit():
+        return None, payload
+    return int(num), body
+
+
+def liveness_suspended(bus: "MessageBus", grace_ms: float) -> bool:
+    """Partition-aware liveness (ISSUE 10): True while the bus session is
+    degraded OR within the rejoin grace window after it recovered. The
+    registry suspends worker-death verdicts and the scheduler defers
+    orphan sweeps while this holds — a broker bounce must not be read as
+    a fleet-wide worker die-off (every heartbeat went missing because WE
+    were deaf, not because the workers died)."""
+    st = bus.partition_state()
+    if st.get("degraded"):
+        return True
+    rejoined = st.get("lastRejoin")
+    if rejoined is None:
+        return False
+    return (time.monotonic() - float(rejoined)) * 1000.0 < grace_ms
 
 
 def record_publish(channel: str) -> None:
@@ -160,6 +227,15 @@ class MessageBus(abc.ABC):
     @abc.abstractmethod
     async def is_healthy(self) -> bool:
         """reference: RedisService.isHealthy (ping), RedisService.ts:270-277."""
+
+    def partition_state(self) -> dict[str, Any]:
+        """Point-in-time session health for partition-aware liveness
+        (ISSUE 10): ``degraded`` while this process's subscriber session
+        is down (its view of heartbeats/events is stale, not the fleet),
+        ``since`` the monotonic start of the current partition, and
+        ``lastRejoin`` the monotonic time the session last recovered.
+        In-process buses are never partitioned — only RespBus overrides."""
+        return {"degraded": False, "since": None, "lastRejoin": None}
 
     # -- KV -----------------------------------------------------------------
     @abc.abstractmethod

@@ -7,19 +7,41 @@ main KV / subscriber / publisher — because a RESP connection in subscribe
 mode cannot issue normal commands (server/src/services/RedisService.ts:19-53,
 client/src/services/RedisConnectionManager.ts:36-92).
 
-Failure handling:
+Failure handling (ISSUE 10 — the bus-HA client half):
+- ``endpoints`` is an ORDERED broker list (primary first, warm standbys
+  after — ``GRIDLLM_BUS_ENDPOINTS``). Every (re)connect walks the list
+  from the top: the first usable broker wins, a reachable REPLICA is
+  promoted (``FAILOVER``) only after every earlier endpoint failed, and
+  a resurrected stale primary is fenced off (``FENCE`` with the newer
+  epoch demotes it) instead of split-braining the KV state. Endpoint
+  switches count in ``gridllm_bus_failovers_total``.
 - main/publisher connections reconnect lazily inside ``command`` (one retry
-  per call) — a broker restart does not permanently poison KV/publish.
-- the subscriber connection reconnects with exponential backoff in its push
-  pump and re-issues all subscriptions; on loss it fires ``on_disconnect`` so
-  the worker can publish `worker:disconnected` best-effort, mirroring
+  per call) — a broker restart or failover does not permanently poison
+  KV/publish.
+- the subscriber connection reconnects with NEVER-GIVE-UP capped
+  exponential backoff with full jitter (a transient outage must never
+  permanently kill the push loop), re-issues all subscriptions, and
+  RESUMEs every durable channel from its last-seen seq — the broker
+  replays the gap and the per-channel dedupe below drops overlap, so
+  consumer-observed delivery is exactly-once across a broker bounce.
+  While down, ``gridllm_bus_subscriber_down``/
+  ``gridllm_bus_partition_seconds`` expose the partition and
+  ``partition_state()`` feeds the registry/scheduler liveness holds.
+  On loss it fires ``on_disconnect`` so the worker can publish
+  `worker:disconnected` best-effort, mirroring
   RedisConnectionManager.ts:158-179.
 - deliveries are strictly ordered per handler (HandlerPump).
+- against real Redis (no EPOCH/RESUME commands) the HA layer disables
+  itself after the first handshake and everything behaves as before.
 """
 
 from __future__ import annotations
 
 import asyncio
+import random
+import time
+import weakref
+from collections import OrderedDict
 from typing import Awaitable, Callable
 
 from gridllm_tpu.bus.base import (
@@ -27,11 +49,58 @@ from gridllm_tpu.bus.base import (
     HandlerPump,
     MessageBus,
     Subscription,
+    channel_class,
+    durable_channel,
     record_publish,
+    split_seq,
 )
+from gridllm_tpu.obs import metrics as obs
+from gridllm_tpu.obs.flightrec import default_flight_recorder
 from gridllm_tpu.utils.logging import get_logger
 
 log = get_logger("bus.resp")
+
+# -- bus-HA instruments (process-global registry) ---------------------------
+_FAILOVERS = obs.default_registry().counter(
+    "gridllm_bus_failovers_total",
+    "Client-observed broker failovers: a bus connection re-established "
+    "to a DIFFERENT endpoint in the ordered GRIDLLM_BUS_ENDPOINTS list.",
+)
+_REPLAYED = obs.default_registry().counter(
+    "gridllm_bus_replayed_messages_total",
+    "Messages replayed from the broker's durable-channel ring after a "
+    "subscriber reconnect (RESUME), by channel class.",
+    ("channel",),
+)
+_SUB_DOWN = obs.default_registry().gauge(
+    "gridllm_bus_subscriber_down",
+    "1 while this process's bus subscriber connection is down (push "
+    "deliveries suspended; liveness verdicts are held).",
+)
+_PARTITION_SECONDS = obs.default_registry().gauge(
+    "gridllm_bus_partition_seconds",
+    "Seconds the current bus-session partition has lasted in this "
+    "process; 0 while the subscriber session is healthy.",
+)
+
+_BUSES: "weakref.WeakSet[RespBus]" = weakref.WeakSet()
+
+
+def _collect_bus_health() -> None:
+    """Scrape-time collector: partition gauges from every live RespBus."""
+    now = time.monotonic()
+    down = 0
+    longest = 0.0
+    for bus in list(_BUSES):
+        st = bus.partition_state()
+        if st.get("degraded") and st.get("since") is not None:
+            down = 1
+            longest = max(longest, now - float(st["since"]))
+    _SUB_DOWN.set(down)
+    _PARTITION_SECONDS.set(longest)
+
+
+obs.default_registry().add_collector("bus_partition", _collect_bus_health)
 
 
 def encode_command(*args: str | bytes | int | float) -> bytes:
@@ -77,12 +146,15 @@ _CONN_ERRORS = (ConnectionError, asyncio.IncompleteReadError, OSError, EOFError)
 
 
 class _Conn:
-    """One RESP connection with serialized request/reply and lazy reconnect."""
+    """One RESP connection with serialized request/reply and lazy reconnect.
+    The actual socket + handshake comes from ``connector`` (RespBus owns
+    endpoint selection, failover, and fencing)."""
 
-    def __init__(self, host: str, port: int, name: str,
-                 password: str | None = None, db: int = 0):
-        self.host, self.port, self.name = host, port, name
-        self.password, self.db = password, db
+    def __init__(self, name: str,
+                 connector: Callable[[], Awaitable[
+                     tuple[asyncio.StreamReader, asyncio.StreamWriter]]]):
+        self.name = name
+        self._connector = connector
         self.reader: asyncio.StreamReader | None = None
         self.writer: asyncio.StreamWriter | None = None
         self._lock = asyncio.Lock()
@@ -93,13 +165,7 @@ class _Conn:
 
     async def _connect_locked(self) -> None:
         await self._close_locked()
-        self.reader, self.writer = await asyncio.open_connection(self.host, self.port)
-        # AUTH/SELECT inline (can't recurse into command(); lock already held)
-        for cmd in ([("AUTH", self.password)] if self.password else []) + \
-                   ([("SELECT", self.db)] if self.db else []):
-            self.writer.write(encode_command(*cmd))
-            await self.writer.drain()
-            await read_reply(self.reader)
+        self.reader, self.writer = await self._connector()
 
     async def close(self) -> None:
         async with self._lock:
@@ -164,22 +230,150 @@ class _Conn:
 
 
 class RespBus(MessageBus):
+    # cap on the per-channel last-seen-seq map (exactly-once dedupe
+    # state); oldest channels age out LRU-style
+    MAX_SEQ_TRACKED = 8192
+    CONNECT_TIMEOUT_S = 2.0
+
     def __init__(self, host: str = "localhost", port: int = 6379,
                  key_prefix: str = "GridLLM:", password: str | None = None,
-                 db: int = 0, reconnect_max_attempts: int = 10):
+                 db: int = 0, reconnect_max_attempts: int = 10,
+                 endpoints: list[tuple[str, int]] | None = None):
         super().__init__(key_prefix)
         self.host, self.port = host, port
         self.password, self.db = password, db
+        # HISTORICAL name: the subscriber loop no longer gives up (ISSUE
+        # 10 — a transient outage permanently killed the push loop); past
+        # this many consecutive failures it logs loudly and keeps trying.
         self.reconnect_max_attempts = reconnect_max_attempts
-        self._main = _Conn(host, port, "main", password, db)
-        self._pub = _Conn(host, port, "publisher", password, db)
-        self._sub = _Conn(host, port, "subscriber", password, db)
+        # ordered endpoint list, primary first (GRIDLLM_BUS_ENDPOINTS);
+        # the single (host, port) is the degenerate one-entry list
+        self.endpoints: list[tuple[str, int]] = (
+            list(endpoints) if endpoints else [(host, port)])
+        self._active_ep: int | None = None   # index serving this process
+        self._epoch = 0                      # highest fencing epoch seen
+        self._ha: bool | None = None         # broker speaks EPOCH/RESUME?
+        self._main = _Conn("main", lambda: self._open_connection("main"))
+        self._pub = _Conn("publisher",
+                          lambda: self._open_connection("publisher"))
+        self._sub = _Conn("subscriber",
+                          lambda: self._open_connection("subscriber"))
         self._subs: dict[str, list[HandlerPump]] = {}
         self._psubs: dict[str, list[HandlerPump]] = {}
+        # per-channel last-seen seq on durable channels: the dedupe half
+        # of exactly-once (the broker's RESUME replay is the other half)
+        self._last_seq: OrderedDict[str, int] = OrderedDict()
         self._reader_task: asyncio.Task | None = None
         self._closed = False
+        # partition-aware liveness (ISSUE 10): monotonic marks of the
+        # current subscriber-session outage and the last recovery
+        self._down_since: float | None = None
+        self._last_rejoin: float | None = None
         # Set by the worker runtime to publish `worker:disconnected` fast-path
         self.on_disconnect: Callable[[], Awaitable[None]] | None = None
+        _BUSES.add(self)
+
+    # -- endpoint selection / fencing handshake -----------------------------
+    async def _open_connection(
+        self, conn_name: str
+    ) -> tuple[asyncio.StreamReader, asyncio.StreamWriter]:
+        """Walk the endpoint list from the top and return the first USABLE
+        broker connection, fully handshaken (AUTH/SELECT, then the HA
+        epoch/fence exchange). List order is the election authority:
+        reaching a replica means every preferred endpoint already failed
+        this pass, so promoting it is safe-by-construction (no quorum —
+        the operator's ordering is the quorum)."""
+        last_err: Exception | None = None
+        for idx, (host, port) in enumerate(self.endpoints):
+            try:
+                reader, writer = await asyncio.wait_for(
+                    asyncio.open_connection(host, port),
+                    self.CONNECT_TIMEOUT_S)
+            except (OSError, asyncio.TimeoutError) as e:
+                last_err = e if isinstance(e, OSError) else \
+                    ConnectionError(f"connect timeout to {host}:{port}")
+                continue
+            try:
+                for cmd in ([("AUTH", self.password)] if self.password
+                            else []) + \
+                           ([("SELECT", self.db)] if self.db else []):
+                    writer.write(encode_command(*cmd))
+                    await writer.drain()
+                    await read_reply(reader)
+                if await self._ha_handshake(reader, writer):
+                    if self._active_ep is not None and idx != self._active_ep:
+                        _FAILOVERS.inc()
+                        default_flight_recorder().record(
+                            "bus", "failover", conn=conn_name,
+                            endpoint=f"{host}:{port}", epoch=self._epoch)
+                        log.warning("bus failover", conn=conn_name,
+                                    endpoint=f"{host}:{port}",
+                                    epoch=self._epoch)
+                    self._active_ep = idx
+                    return reader, writer
+                last_err = ConnectionError(
+                    f"{host}:{port} not usable (stale or unfenceable)")
+            except _CONN_ERRORS as e:
+                last_err = e
+            try:
+                writer.close()
+            except Exception:  # noqa: BLE001
+                pass
+        raise last_err or ConnectionError("no usable bus endpoint")
+
+    async def _ha_handshake(self, reader: asyncio.StreamReader,
+                            writer: asyncio.StreamWriter) -> bool:
+        """EPOCH/FENCE/FAILOVER exchange on a fresh connection. True when
+        the broker is usable as the current primary. Against a broker
+        without the HA commands (real Redis) the layer memoizes itself
+        off and every endpoint is usable as-is."""
+        if self._ha is False:
+            return True
+
+        async def ask(*args):
+            writer.write(encode_command(*args))
+            await writer.drain()
+            return await read_reply(reader)
+
+        try:
+            got = await ask("EPOCH")
+        except RespProtocolError:
+            # plain Redis: no EPOCH — no fencing, no resume, no promote
+            self._ha = False
+            return True
+        self._ha = True
+        if not isinstance(got, list) or len(got) != 2:
+            return False
+        role, broker_epoch = str(got[0]), int(got[1])
+        if role == "stale":
+            return False
+        if role == "replica":
+            # every earlier endpoint failed this pass — promote. A
+            # standby that never synced refuses (-NOTSYNCED): promoting
+            # an empty broker during a bring-up race (this client booted
+            # before the primary) would split-brain, so keep walking /
+            # retrying until the real primary arrives.
+            try:
+                new_epoch = max(self._epoch, broker_epoch) + 1
+                promoted = await ask("FAILOVER", new_epoch)
+                self._epoch = max(self._epoch, int(promoted))
+                await ask("FENCE", self._epoch)
+            except RespProtocolError as e:
+                log.warning("standby refused promotion", error=str(e))
+                return False
+            return True
+        # primary: fence at the max of both epochs — a FENCE carrying a
+        # NEWER epoch than the broker's demotes a resurrected stale
+        # primary (raises -STALE) and we move on down the list
+        fence_at = max(self._epoch, broker_epoch)
+        try:
+            await ask("FENCE", fence_at)
+        except RespProtocolError as e:
+            log.warning("stale primary fenced off", error=str(e),
+                        epoch=fence_at)
+            return False
+        self._epoch = fence_at
+        return True
 
     # -- lifecycle ----------------------------------------------------------
     async def connect(self) -> None:
@@ -192,7 +386,11 @@ class RespBus(MessageBus):
                 try:
                     await conn.connect()
                     break
-                except OSError:
+                # the full connection-error family, not just OSError: a
+                # broker that accepts the TCP handshake and then hangs up
+                # mid-handshake (dying broker, broker.accept fault site)
+                # surfaces as IncompleteReadError/EOFError
+                except _CONN_ERRORS:
                     if attempt == 4:
                         raise
                     await asyncio.sleep(delay)
@@ -200,13 +398,26 @@ class RespBus(MessageBus):
         self._reader_task = asyncio.create_task(self._sub_reader_loop())
         # Re-establish any subscriptions that predate a reconnect
         # (pump owns the read side now → write-only)
-        for channel in self._subs:
-            await self._sub.send_only("SUBSCRIBE", channel)
-        for pattern in self._psubs:
+        await self._reissue_subscriptions()
+
+    async def _reissue_subscriptions(self) -> None:
+        for channel in list(self._subs):
+            if self._ha and channel in self._last_seq:
+                # RESUME subscribes AND replays the outage gap atomically
+                # broker-side, so replayed frames always precede the
+                # first live one — the seq dedupe drops any overlap
+                await self._sub.send_only("RESUME", channel,
+                                          self._last_seq[channel])
+            else:
+                await self._sub.send_only("SUBSCRIBE", channel)
+        for pattern in list(self._psubs):
             await self._sub.send_only("PSUBSCRIBE", pattern)
 
     async def disconnect(self) -> None:
         self._closed = True
+        # a deliberate close is not a partition: don't leave the gauges
+        # (and any liveness holds) pinned on a bus that no longer exists
+        self._down_since = None
         if self._reader_task is not None:
             self._reader_task.cancel()
             self._reader_task = None
@@ -215,6 +426,7 @@ class RespBus(MessageBus):
                 for p in pumps:
                     p.stop()
             registry.clear()
+        self._last_seq.clear()
         for conn in (self._main, self._pub, self._sub):
             await conn.close()
 
@@ -224,57 +436,181 @@ class RespBus(MessageBus):
         except Exception:
             return False
 
+    def partition_state(self) -> dict:
+        """Partition-aware liveness feed (bus/base.py liveness_suspended):
+        degraded while the subscriber session is down — this process is
+        DEAF, so missing heartbeats say nothing about the fleet."""
+        return {"degraded": self._down_since is not None,
+                "since": self._down_since,
+                "lastRejoin": self._last_rejoin}
+
+    def _mark_partition(self) -> None:
+        if self._down_since is None:
+            self._down_since = time.monotonic()
+            _SUB_DOWN.set(1)
+            default_flight_recorder().record(
+                "bus", "subscriber_down", endpoint=self._active_ep)
+
+    def _mark_rejoin(self) -> None:
+        if self._down_since is not None:
+            outage_s = time.monotonic() - self._down_since
+            self._down_since = None
+            self._last_rejoin = time.monotonic()
+            _SUB_DOWN.set(0)
+            _PARTITION_SECONDS.set(0)
+            default_flight_recorder().record(
+                "bus", "subscriber_reconnected",
+                outageS=round(outage_s, 3), endpoint=self._active_ep)
+
     async def _sub_reader_loop(self) -> None:
         """Push-message pump for the subscriber connection."""
         backoff = 0.5
+        proto_errors = 0
         while not self._closed:
             try:
                 assert self._sub.reader is not None
                 msg = await read_reply(self._sub.reader)
                 backoff = 0.5
+                proto_errors = 0
             except asyncio.CancelledError:
                 return
+            except RespProtocolError as e:
+                # a pushed error frame (e.g. RESUME against a broker that
+                # lost the ring channel) is not a dead connection — but a
+                # run of them means the reply stream is desynced, and
+                # that IS one
+                proto_errors += 1
+                if proto_errors < 10:
+                    log.warning("subscriber push error frame",
+                                error=str(e))
+                    continue
+                msg = None
+                if not await self._handle_sub_loss(
+                        f"protocol desync: {e}", backoff):
+                    return
+                backoff = min(backoff * 2, 30.0)
+                proto_errors = 0
+                continue
             except Exception as e:
                 if self._closed:
                     return
-                log.warning("subscriber connection lost, reconnecting", error=str(e))
-                if self.on_disconnect is not None:
-                    try:
-                        await self.on_disconnect()
-                    except Exception:
-                        pass
-                ok = await self._reconnect_sub(backoff)
-                backoff = min(backoff * 2, 30.0)
-                if not ok:
+                if not await self._handle_sub_loss(str(e), backoff):
                     return
+                backoff = min(backoff * 2, 30.0)
                 continue
             if not isinstance(msg, list) or not msg:
                 continue
             kind = msg[0]
             if kind == "message" and len(msg) == 3:
                 _, channel, payload = msg
+                payload = self._dedupe(channel, payload)
+                if payload is None:
+                    continue
                 for pump in list(self._subs.get(channel, [])):
                     pump.push(channel, payload)
             elif kind == "pmessage" and len(msg) == 4:
                 _, pattern, channel, payload = msg
+                payload = self._dedupe(channel, payload)
+                if payload is None:
+                    continue
                 for pump in list(self._psubs.get(pattern, [])):
                     pump.push(channel, payload)
+            elif (kind == "subscribe" and len(msg) == 3
+                    and self._ha and isinstance(msg[2], int)):
+                # gridbus acks durable-channel subscribes with the
+                # channel's current seq — the resume BASELINE. Without
+                # it, a channel that never delivered before an outage
+                # (a job's result channel) could not RESUME and anything
+                # published during the gap would be silently lost.
+                channel = str(msg[1])
+                if durable_channel(channel) \
+                        and channel not in self._last_seq:
+                    self._note_seq(channel, int(msg[2]))
+            elif kind == "resume" and len(msg) == 4:
+                # broker's replay ack: [resume, channel, replayed, lost]
+                _, channel, replayed, lost = msg
+                if int(replayed):
+                    _REPLAYED.inc(int(replayed),
+                                  channel=channel_class(str(channel)))
+                if int(lost) < 0:
+                    # the broker lost its seq history (restart with no
+                    # standby, counter eviction) and we are AHEAD of it:
+                    # void the watermark — keeping it would drop every
+                    # new message as a "duplicate" until the broker's
+                    # fresh counter overtook it, silently muting the
+                    # channel. The gap itself is unknowable; the
+                    # at-least-once sweeps own it.
+                    self._last_seq.pop(str(channel), None)
+                    log.warning("bus seq history lost; watermark voided",
+                                channel=str(channel))
+                    default_flight_recorder().record(
+                        "bus", "seq_reset", channel=str(channel))
+                elif int(lost):
+                    # the outage outran the replay ring: at-least-once
+                    # degrades to the sweep/retry machinery for the hole
+                    log.warning("bus resume gap (ring outrun)",
+                                channel=str(channel), lost=int(lost))
+                    default_flight_recorder().record(
+                        "bus", "resume_gap", channel=str(channel),
+                        lost=int(lost))
             # subscribe/unsubscribe acks: ignore
 
+    def _note_seq(self, channel: str, seq: int) -> None:
+        if channel in self._last_seq:
+            self._last_seq.move_to_end(channel)
+        self._last_seq[channel] = seq
+        while len(self._last_seq) > self.MAX_SEQ_TRACKED:
+            self._last_seq.popitem(last=False)
+
+    def _dedupe(self, channel: str, payload: str) -> str | None:
+        """Strip the broker's seq framing and drop already-seen messages
+        (replay overlap, duplicated deliveries across a failover). None
+        means drop; a payload without framing passes through untouched."""
+        seq, body = split_seq(payload)
+        if seq is None:
+            return payload
+        last = self._last_seq.get(channel)
+        if last is not None and seq <= last:
+            return None  # duplicate of something already delivered
+        self._note_seq(channel, seq)
+        return body
+
+    async def _handle_sub_loss(self, error: str, delay: float) -> bool:
+        """One subscriber-session outage: mark the partition, fire the
+        disconnect hook, reconnect forever (capped backoff, full jitter).
+        Returns False only when the bus is being closed."""
+        log.warning("subscriber connection lost, reconnecting", error=error)
+        self._mark_partition()
+        if self.on_disconnect is not None:
+            try:
+                await self.on_disconnect()
+            except Exception:
+                pass
+        ok = await self._reconnect_sub(delay)
+        if ok:
+            self._mark_rejoin()
+        return ok
+
     async def _reconnect_sub(self, delay: float) -> bool:
-        for attempt in range(self.reconnect_max_attempts):
-            await asyncio.sleep(delay)
+        """Never-give-up reconnect (ISSUE 10 satellite): full-jitter capped
+        exponential backoff, looping until the bus closes. The old
+        10-attempts-then-dead behavior turned a 30-second broker outage
+        into a permanently deaf process with only a log line to show."""
+        attempt = 0
+        while not self._closed:
+            attempt += 1
+            await asyncio.sleep(delay * random.random())  # full jitter
             try:
                 await self._sub.connect()  # closes the stale transport first
-                for channel in self._subs:
-                    await self._sub.send_only("SUBSCRIBE", channel)
-                for pattern in self._psubs:
-                    await self._sub.send_only("PSUBSCRIBE", pattern)
-                log.info("subscriber reconnected", attempt=attempt + 1)
+                await self._reissue_subscriptions()
+                log.info("subscriber reconnected", attempt=attempt)
                 return True
-            except Exception:
-                delay = min(delay * 2, 30.0)
-        log.error("subscriber reconnect gave up", attempts=self.reconnect_max_attempts)
+            except Exception as e:  # noqa: BLE001 — keep trying
+                if attempt == self.reconnect_max_attempts:
+                    log.error(
+                        "subscriber still down; continuing to retry",
+                        attempts=attempt, error=str(e))
+                delay = min(max(delay, 0.25) * 2, 30.0)
         return False
 
     # -- KV -----------------------------------------------------------------
@@ -327,6 +663,7 @@ class RespBus(MessageBus):
             pump.stop()
             if not lst:
                 self._subs.pop(channel, None)
+                self._last_seq.pop(channel, None)
                 try:
                     await self._sub.send_only("UNSUBSCRIBE", channel)
                 except Exception:

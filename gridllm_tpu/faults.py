@@ -25,6 +25,13 @@ parse instead of silently injecting nothing:
     worker.heartbeat  skip one worker heartbeat (key not refreshed)
     engine.step       raise from the engine runner's pump (step-failure
                       recovery: abort + device-state rebuild)
+    broker.accept     gridbus drops an accepted connection before reading
+                      a byte (dying / conn-table-exhausted broker)
+    broker.reply      gridbus writes half a reply then resets the
+                      connection (crash mid-reply; clients must abandon
+                      the torn reply stream, never resync into it)
+    broker.fsync      gridbus AOF fsync stalls, freezing the broker event
+                      loop the way a saturated disk does
 
 The hot-path cost with no spec configured is one module-global boolean
 check. Tests drive the layer through :func:`configure` directly; the env
@@ -47,6 +54,9 @@ SITES = (
     "alloc.alloc",
     "worker.heartbeat",
     "engine.step",
+    "broker.accept",
+    "broker.reply",
+    "broker.fsync",
 )
 
 _INJECTED = default_registry().counter(

@@ -110,7 +110,10 @@ class GatewayServer:
         default_flight_recorder().set_capacity(
             self.config.obs.flightrec_capacity)
         self.bus = bus or create_bus(self.config.bus.url,
-                                     key_prefix=self.config.bus.key_prefix)
+                                     key_prefix=self.config.bus.key_prefix,
+                                     password=self.config.bus.password,
+                                     db=self.config.bus.db,
+                                     endpoints=self.config.bus.endpoints)
         self.registry = WorkerRegistry(self.bus, self.config.scheduler)
         self.scheduler = JobScheduler(self.bus, self.registry, self.config.scheduler,
                                       slo_config=self.config.obs.slo,

@@ -29,7 +29,7 @@ import asyncio
 import json
 import time
 
-from gridllm_tpu.bus.base import MessageBus, Subscription
+from gridllm_tpu.bus.base import MessageBus, Subscription, liveness_suspended
 from gridllm_tpu.obs import Counter, Gauge, MetricsRegistry, default_flight_recorder
 from gridllm_tpu.utils.config import SchedulerConfig
 from gridllm_tpu.utils.events import EventEmitter
@@ -54,6 +54,9 @@ class WorkerRegistry(EventEmitter):
         self._workers_gauge: Gauge | None = None
         self._live_gauge: Gauge | None = None
         self._removed_total: Counter | None = None
+        # partition-aware liveness (ISSUE 10): logs the hold transitions
+        # exactly once per partition episode
+        self._liveness_held = False
 
     def attach_metrics(self, metrics: MetricsRegistry) -> None:
         """Wire worker-liveness instruments onto a registry (called by
@@ -243,6 +246,28 @@ class WorkerRegistry(EventEmitter):
         await self.remove_worker(worker_id, reason="disconnected")
 
     # -- liveness loops -----------------------------------------------------
+    def _liveness_suspended(self) -> bool:
+        """Partition-aware liveness (ISSUE 10): while this process's OWN
+        bus session is degraded — or within the rejoin grace after it
+        recovers — every "worker died" verdict is suspended. Missing
+        heartbeats during a partition mean WE were deaf, not that the
+        fleet died; pronouncing workers dead then triggers a mass
+        orphan-requeue storm of perfectly healthy jobs. Workers silent
+        for organic reasons are caught on the first sweep after the
+        grace expires — their lastHeartbeat keeps aging through the hold."""
+        held = liveness_suspended(self.bus, self.config.bus_rejoin_grace_ms)
+        if held and not self._liveness_held:
+            log.warning("bus session degraded; suspending worker-death "
+                        "verdicts")
+            default_flight_recorder().record(
+                "registry", "liveness_suspended", workers=len(self.workers))
+        elif not held and self._liveness_held:
+            log.info("bus session healthy; liveness verdicts resume")
+            default_flight_recorder().record(
+                "registry", "liveness_resumed", workers=len(self.workers))
+        self._liveness_held = held
+        return held
+
     async def _cleanup_loop(self) -> None:
         """Sweep workers whose lastHeartbeat exceeds the timeout
         (reference: WorkerRegistry.ts:112-123, 182-219)."""
@@ -250,6 +275,8 @@ class WorkerRegistry(EventEmitter):
         timeout_s = self.config.worker_heartbeat_timeout_ms / 1000
         while self._running:
             await asyncio.sleep(interval)
+            if self._liveness_suspended():
+                continue
             now = time.time()
             for worker_id, info in list(self.workers.items()):
                 if now - info.lastHeartbeat > timeout_s:
@@ -265,6 +292,12 @@ class WorkerRegistry(EventEmitter):
         window_s = self.config.quick_disconnect_window_ms / 1000
         while self._running:
             await asyncio.sleep(interval)
+            if liveness_suspended(self.bus, self.config.bus_rejoin_grace_ms):
+                # same hold as the cleanup sweep (which owns the state
+                # transition logging): during a partition the TTL probe
+                # would ALSO misfire — the key expired because nobody
+                # could refresh it through us, not because workers died
+                continue
             now = time.time()
             for worker_id, info in list(self.workers.items()):
                 if now - info.lastHeartbeat <= window_s:

@@ -40,7 +40,7 @@ import random
 import time
 from typing import Any, Awaitable, Callable
 
-from gridllm_tpu.bus.base import MessageBus, Subscription
+from gridllm_tpu.bus.base import MessageBus, Subscription, liveness_suspended
 from gridllm_tpu.obs import (
     HangWatchdog,
     MetricsRegistry,
@@ -1454,6 +1454,16 @@ class JobScheduler(EventEmitter):
     async def _check_for_orphaned_jobs(self) -> None:
         """reference: JobScheduler.ts:219-257 — assignment older than the
         threshold AND worker gone or silent beyond the window."""
+        if liveness_suspended(self.bus,
+                              self.config.bus_rejoin_grace_ms):
+            # partition-aware liveness (ISSUE 10): while our own bus
+            # session is degraded (or within the rejoin grace) every
+            # worker looks silent — orphaning their jobs would duplicate
+            # work that is still streaming fine on the other side of the
+            # partition. The registry holds its death verdicts on the
+            # same signal; organic orphans are caught on the first sweep
+            # after the grace expires.
+            return
         now = time.time()
         threshold_s = self.config.orphan_assign_threshold_ms / 1000
         window_s = self.config.quick_disconnect_window_ms / 1000

@@ -166,6 +166,18 @@ register_env("GRIDLLM_LOG_LEVEL", "info",
 register_env("GRIDLLM_BUS_URL", "",
              "Message-bus endpoint; empty = in-memory bus, "
              "resp://host:port = wire broker/Redis.")
+register_env("GRIDLLM_BUS_ENDPOINTS", "",
+             "Ordered comma list of resp://host:port broker endpoints "
+             "(primary FIRST, warm standbys after) for client-driven "
+             "failover with epoch fencing; empty = GRIDLLM_BUS_URL only.")
+register_env("GRIDLLM_BUS_REJOIN_GRACE_MS", "10000",
+             "After this process's bus session reconnects, hold worker-"
+             "death verdicts and orphan sweeps this long (ms) so a "
+             "broker bounce is not misread as a fleet-wide worker loss.")
+register_env("GRIDLLM_BUS_RING_CAP", "512",
+             "Broker replay-ring capacity per durable channel (messages)"
+             " — the RESUME window a reconnecting subscriber can recover"
+             " after an outage.")
 
 # engine
 register_env("GRIDLLM_MODELS", "",
@@ -370,6 +382,11 @@ class BusConfig(BaseModel):
     password: str | None = None
     db: int = 0
     key_prefix: str = "GridLLM:"
+    # Bus HA (ISSUE 10): ordered broker endpoint list, primary first —
+    # clients walk it on every (re)connect, promote the first reachable
+    # standby only after every earlier endpoint failed, and fence off
+    # resurrected stale primaries by epoch. Empty = url only.
+    endpoints: list[str] = Field(default_factory=list)
 
 
 class SchedulerConfig(BaseModel):
@@ -398,6 +415,14 @@ class SchedulerConfig(BaseModel):
     # SLO class (obs classify_request).
     request_deadline_ms: int = Field(0, ge=0)
     request_deadline_classes: dict[str, int] = Field(default_factory=dict)
+    # Partition-aware liveness (ISSUE 10): while this process's own bus
+    # session is degraded, the registry suspends worker-death verdicts
+    # and the scheduler defers orphan sweeps; both stay held this long
+    # after the session rejoins so heartbeats published during the
+    # outage can land (the RESUME replay) before anyone is pronounced
+    # dead. Without this, a 10-second broker restart triggers a mass
+    # orphan-requeue storm of perfectly healthy jobs.
+    bus_rejoin_grace_ms: int = Field(10_000, ge=0)
     # capacity NACKs requeue without consuming the retry ladder, but only
     # this many times — a nack storm then falls through to the real ladder
     max_nacks: int = Field(25, ge=0)
@@ -613,6 +638,9 @@ def load_config() -> Config:
                 password=os.environ.get("REDIS_PASSWORD") or None,
                 db=_env("REDIS_DB", 0),
                 key_prefix=_env("REDIS_KEY_PREFIX", "GridLLM:"),
+                endpoints=[e.strip() for e in
+                           env_str("GRIDLLM_BUS_ENDPOINTS").split(",")
+                           if e.strip()],
             ),
             scheduler=SchedulerConfig(
                 worker_heartbeat_timeout_ms=_env("WORKER_HEARTBEAT_TIMEOUT", 15_000),
@@ -630,6 +658,7 @@ def load_config() -> Config:
                     "GRIDLLM_RETRY_BUDGET_PER_MIN"),
                 request_deadline_ms=env_int("GRIDLLM_REQUEST_DEADLINE_MS"),
                 request_deadline_classes=_deadline_classes_from_env(),
+                bus_rejoin_grace_ms=env_int("GRIDLLM_BUS_REJOIN_GRACE_MS"),
             ),
             gateway=GatewayConfig(
                 host=_env("HOST", "0.0.0.0"),

@@ -261,7 +261,8 @@ async def run(config: Config | None = None) -> None:
         ).hexdigest()[:12]
         config.worker = config.worker.model_copy(update={"worker_id": wid})
     bus = create_bus(config.bus.url, key_prefix=config.bus.key_prefix,
-                     password=config.bus.password, db=config.bus.db)
+                     password=config.bus.password, db=config.bus.db,
+                     endpoints=config.bus.endpoints)
     await bus.connect()
 
     stop = asyncio.Event()
