@@ -325,6 +325,202 @@ async def _run_bench_inner(client_ctx, engine, model, n_requests, n_tokens,
     }
 
 
+async def run_long_context_bench(model: str, n_requests: int,
+                                 n_tokens: int, max_slots: int,
+                                 prefix_len: int,
+                                 long_prompt_len: int) -> dict:
+    """Long-context / tiered-KV scenario (ISSUE 11), extending
+    --shared-prefix with LRU-overflow pressure: N streams share one long
+    system prompt (cold round populates the prefix cache, warm round
+    measures the warm TTFT), then a burst of max-capacity long prompts
+    overflows the HBM reuse LRU — evicting the shared prefix — and a
+    final post-eviction round re-issues the shared prompts. Run twice:
+    tier OFF (the long burst destroys the warm TTFT — the regression)
+    and tier ON (evicted pages spilled to host RAM page back in on
+    match, recovering it). Spill dtype is raw for the A/B so both arms'
+    streams are byte-comparable; per-tier hit rates and restore counts
+    ride the record."""
+
+    import aiohttp
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from gridllm_tpu.engine import EngineConfig, InferenceEngine
+    from gridllm_tpu.worker.main import resolve_checkpoint
+
+    ckpt, tok = resolve_checkpoint(env_raw("GRIDLLM_CHECKPOINT_DIR"), model)
+    tiny = model.startswith("tiny")
+    ps = 32 if tiny else 64
+    n_requests = max(n_requests, 2)
+    max_slots = max(max_slots, n_requests)
+    # respect the MODEL context: tiny models cap at 256 tokens, and a
+    # prompt past the effective context left-truncates (which would
+    # silently shrink the long burst below eviction pressure)
+    try:
+        from gridllm_tpu.models.configs import get_config as _get_config
+
+        model_ctx = _get_config(model).max_seq_len
+    except KeyError:
+        model_ctx = 8192
+    slot_pages = 8 if tiny else 48
+    ctx_cap = min(model_ctx, slot_pages * ps)
+    prefix_len = min(prefix_len, ctx_cap - 2 * ps)
+    long_cap = min(long_prompt_len, ctx_cap - n_tokens - 2)
+    # pool sized so the N COLD streams fit but the long burst must evict
+    # the reuse LRU: free-after-warm ≈ pool − shared prefix pages, while
+    # the burst wants ≈ N × ctx_cap/ps pages. Page math is char≈token
+    # exact for the byte tokenizer (tiny CI models); real tokenizers
+    # over-estimate, so the record's eviction count is the honesty marker.
+    prefix_pages = prefix_len // ps
+    num_pages = n_requests * (prefix_pages + 1)
+
+    async def one_arm(host_bytes: int) -> dict:
+        engine = InferenceEngine(EngineConfig(
+            model=model,
+            checkpoint_path=ckpt,
+            tokenizer=tok,
+            max_slots=max_slots,
+            page_size=ps,
+            num_pages=num_pages,
+            max_pages_per_slot=slot_pages,
+            prefill_buckets=(256, 1024),
+            prefill_chunk=64 if tiny else 256,
+            kv_host_bytes=host_bytes,
+            kv_spill_int8=False,  # raw spill: arms stay byte-comparable
+        ))
+        bus, registry, scheduler, app, worker = await _build_stack(
+            engine, model, trace_capacity=n_requests * 8 + 16)
+        client = None
+        try:
+            await worker.start()
+            await asyncio.sleep(0.1)
+            client = TestClient(TestServer(app))
+            await client.start_server()
+
+            shared = ("You are a meticulous assistant. Policy clause %d: "
+                      "the quick brown fox jumps over the lazy dog. ")
+            system = "".join(shared % i for i in range(100))[:prefix_len]
+
+            # compile warmup: disjoint prefix, issued twice so the warm
+            # path's programs (window seed + mid-prompt chunk) compile
+            # outside every measured window; then a burst of long-shape
+            # prompts that EVICTS the warmup prefix, and one final
+            # re-issue so the tier-on arm's restore path (the kv_install
+            # program) also compiles before any measured round
+            warm_prompts = ["[warmup] " + system, "[warmup] " + system]
+            warm_prompts += [("W%d " % j) + "X" * long_cap
+                             for j in range(n_requests)]
+            warm_prompts += ["[warmup] " + system]
+            for ptxt in warm_prompts:
+                warm_up = await client.post("/ollama/api/generate", json={
+                    "model": model, "prompt": ptxt, "stream": False,
+                    "options": {"temperature": 0, "num_predict": 2},
+                }, timeout=aiohttp.ClientTimeout(total=240))
+                assert warm_up.status == 200, await warm_up.text()
+
+            async def one(i: int, prompt: str, ttfts: list,
+                          tokens_out: list, n_pred: int) -> None:
+                t0 = time.perf_counter()
+                async with client.post("/ollama/api/generate", json={
+                    "model": model, "prompt": prompt,
+                    "options": {"temperature": 0, "seed": i,
+                                "num_predict": n_pred},
+                }) as resp:
+                    assert resp.status == 200, await resp.text()
+                    first = True
+                    async for line in resp.content:
+                        if not line.strip():
+                            continue
+                        if first:
+                            first = False
+                            ttfts.append(time.perf_counter() - t0)
+                        frame = json.loads(line)
+                        if frame.get("done"):
+                            tokens_out[0] += frame.get("eval_count") or 0
+
+            async def round_(prompts: list[str], n_pred: int) -> dict:
+                await asyncio.sleep(0.5)  # drain trailing pipeline blocks
+                ttfts: list[float] = []
+                tokens_out = [0]
+                t0 = time.perf_counter()
+                await asyncio.gather(*(one(i, p, ttfts, tokens_out, n_pred)
+                                       for i, p in enumerate(prompts)))
+                wall = time.perf_counter() - t0
+                return {"wall_s": wall, "tokens": tokens_out[0],
+                        "tok_s": tokens_out[0] / wall,
+                        "p50_ttft_ms": statistics.median(ttfts) * 1000}
+
+            shared_prompts = [f"{system}\nUser {i} asks:"
+                              for i in range(n_requests)]
+            long_prompts = [("L%d " % i) + "X" * long_cap
+                            for i in range(n_requests)]
+
+            cold = await round_(shared_prompts, n_tokens)
+            warm = await round_(shared_prompts, n_tokens)
+            long_r = await round_(long_prompts, n_tokens)
+            evict_mark = engine.alloc.evictions
+            h0, m0 = engine.alloc.hits, engine.alloc.misses
+            tier0 = (engine.host_tier.stats() if engine.host_tier
+                     else {"restores": 0, "spills": 0, "misses": 0})
+            post = await round_(shared_prompts, n_tokens)
+            dh = engine.alloc.hits - h0
+            dm = engine.alloc.misses - m0
+            tier1 = (engine.host_tier.stats() if engine.host_tier
+                     else {"restores": 0, "spills": 0, "misses": 0,
+                           "evictions": 0, "pages": 0, "bytes": 0})
+            return {
+                "cold": cold, "warm": warm, "long": long_r, "post": post,
+                "evictions": evict_mark,
+                "post_hbm_hit_rate": round(dh / (dh + dm), 4)
+                if (dh + dm) else 0.0,
+                "post_restores": tier1["restores"] - tier0["restores"],
+                "tier": tier1,
+                "perf": _perf_sidecar(),
+                "weights": ("real-checkpoint" if ckpt
+                            else "random-weights synthetic"),
+            }
+        finally:
+            await _teardown_stack(bus, registry, scheduler, worker,
+                                  client=client)
+
+    off = await one_arm(0)
+    on = await one_arm(256 * 1024 * 1024)
+    post_on = on["post"]["p50_ttft_ms"]
+    post_off = off["post"]["p50_ttft_ms"]
+    return {
+        # headline: the tier-on arm's post-eviction round — warm TTFT
+        # recovered under LRU-overflow pressure
+        "tok_s": on["post"]["tok_s"],
+        "tokens": sum(a[r]["tokens"] for a in (off, on)
+                      for r in ("cold", "warm", "long", "post")),
+        "wall_s": sum(a[r]["wall_s"] for a in (off, on)
+                      for r in ("cold", "warm", "long", "post")),
+        "p50_ttft_ms_cold": on["cold"]["p50_ttft_ms"],
+        "p50_ttft_ms_warm": on["warm"]["p50_ttft_ms"],
+        "p50_ttft_ms_post_on": post_on,
+        "p50_ttft_ms_post_off": post_off,
+        # ≥ 1 when the tier recovers TTFT the eviction storm destroyed
+        "ttft_recovery": (post_off / post_on) if post_on else None,
+        # the EFFECTIVE prefix actually measured (the model-context clamp
+        # above can shrink the requested one) — the metric string must
+        # state this, not the requested value
+        "prefix_len": prefix_len,
+        "restores": on["post_restores"],
+        "kv_tier": {
+            "on": {"evictions": on["evictions"],
+                   "postHbmHitRate": on["post_hbm_hit_rate"],
+                   "postRestores": on["post_restores"],
+                   "spills": on["tier"]["spills"],
+                   "hostPages": on["tier"]["pages"],
+                   "hostBytes": on["tier"]["bytes"],
+                   "tierMisses": on["tier"]["misses"]},
+            "off": {"evictions": off["evictions"],
+                    "postHbmHitRate": off["post_hbm_hit_rate"]},
+        },
+        "perf": on["perf"],
+        "weights": on["weights"],
+    }
+
+
 async def run_shared_prefix_bench(model: str, n_requests: int,
                                   n_tokens: int, max_slots: int,
                                   prefix_len: int) -> dict:
@@ -995,7 +1191,7 @@ BENCH_SCHEMA = "gridllm-bench/v1"
 HIGHER_BETTER = ("tok_s", "qps", "goodput_tok_s", "slo_attainment",
                  "ttft_speedup", "prefix_cache_hit_rate",
                  "spec_acceptance_rate", "spec_tokens_per_step",
-                 "itl_speedup")
+                 "itl_speedup", "ttft_recovery")
 LOWER_BETTER = ("p50_ttft_ms", "p95_ttft_ms", "p50_itl_ms",
                 "peak_hbm_bytes")
 
@@ -1133,6 +1329,11 @@ def main() -> int:
     ap.add_argument("--prefix-len", type=int, default=1200,
                     help="shared system-prompt length in characters "
                          "(--shared-prefix only)")
+    ap.add_argument("--long-context", action="store_true",
+                    help="tiered-KV scenario: shared-prefix streams, then "
+                         "long prompts overflow the HBM reuse LRU; A/B "
+                         "host tier off vs on (post-eviction warm TTFT "
+                         "recovery, per-tier hit rates, restores)")
     ap.add_argument("--spec", action="store_true",
                     help="speculative-decoding A/B: the same repetitive-"
                          "completion workload spec-off then spec-on; "
@@ -1185,6 +1386,10 @@ def main() -> int:
     if args.mixed and (args.embed or args.shared_prefix or args.spec):
         ap.error("--mixed is its own generate scenario; drop "
                  "--embed/--shared-prefix/--spec")
+    if args.long_context and (args.embed or args.shared_prefix or args.spec
+                              or args.mixed or args.disagg):
+        ap.error("--long-context is its own generate scenario; drop "
+                 "--embed/--shared-prefix/--spec/--mixed/--disagg")
     if args.disagg and (args.embed or args.shared_prefix or args.spec
                         or args.mixed):
         ap.error("--disagg is its own generate scenario; drop "
@@ -1239,6 +1444,14 @@ def main() -> int:
         # long arm must still span several 64-token chunks
         args.long_prompt_len = min(args.long_prompt_len, 320)
         args.requests = min(args.requests, 4)
+        if args.long_context:
+            # tiny slot cap is 8×64 = 512 tokens: the shared prefix must
+            # leave room for the query + generation, and the long burst
+            # must still exceed the post-warm free pool
+            args.prefix_len = min(args.prefix_len, 320)
+            args.long_prompt_len = min(args.long_prompt_len, 448)
+            args.tokens = min(args.tokens, 16)
+            args.requests = max(min(args.requests, 3), 2)
         if not args.tiny:
             # flag the substitution even when the CPU probe itself was
             # healthy — a tiny-model number must never read as `requested`
@@ -1271,6 +1484,19 @@ def main() -> int:
                 f"({args.model}, shared-prefix scenario, {args.requests} "
                 f"streams × {args.prefix_len}-char system prompt, "
                 f"{r['weights']})"
+            )
+        elif args.long_context:
+            r = asyncio.run(run_long_context_bench(
+                args.model, args.requests, args.tokens, args.slots,
+                args.prefix_len, args.long_prompt_len,
+            ))
+            baseline = A100_OLLAMA_TOK_S.get(args.model, 0.0)
+            value, unit = r["tok_s"], "tok/s"
+            metric_name = (
+                f"post-eviction warm output tokens/sec via /ollama/api/"
+                f"generate ({args.model}, tiered-KV long-context A/B, "
+                f"{args.requests} streams × {r['prefix_len']}-char shared "
+                f"prefix under LRU-overflow pressure, {r['weights']})"
             )
         elif args.spec:
             r = asyncio.run(run_spec_bench(
@@ -1426,6 +1652,20 @@ def main() -> int:
         payload["spec_proposed"] = r["spec_proposed"]
         payload["spec_accepted"] = r["spec_accepted"]
         payload["tokens"] = r["tokens"]
+    elif args.long_context:
+        # the tiered-KV headline: the post-eviction round's warm TTFT
+        # with the host tier on vs off (the recovery ratio), plus the
+        # per-tier hit rates and restore counts that prove the tier —
+        # not luck — did the work
+        payload["p50_ttft_ms_cold"] = round(r["p50_ttft_ms_cold"], 1)
+        payload["p50_ttft_ms_warm"] = round(r["p50_ttft_ms_warm"], 1)
+        payload["p50_ttft_ms_post_on"] = round(r["p50_ttft_ms_post_on"], 1)
+        payload["p50_ttft_ms_post_off"] = round(r["p50_ttft_ms_post_off"], 1)
+        if r.get("ttft_recovery") is not None:
+            payload["ttft_recovery"] = round(r["ttft_recovery"], 3)
+        payload["restores"] = r["restores"]
+        payload["kv_tier"] = r["kv_tier"]
+        payload["tokens"] = r["tokens"]
     elif args.shared_prefix:
         # the prefix-cache headline: warm TTFT must beat cold, and the
         # warm round's prompt-page hit rate proves the cache did the work
@@ -1486,6 +1726,7 @@ def main() -> int:
             payload["peak_hbm_bytes"] = perf_side["peak_hbm_bytes"]
     scenario = ("embed" if args.embed
                 else "shared-prefix" if args.shared_prefix
+                else "long-context" if args.long_context
                 else "spec" if args.spec
                 else "mixed" if args.mixed
                 else "disagg" if args.disagg else "generate")

@@ -28,7 +28,7 @@ RULE = "lock-discipline"
 
 # PageAllocator methods that mutate free lists / refcounts / the reuse LRU
 MUTATORS = {"alloc", "free", "match_prefix", "pin_prefix", "unpin_pages",
-            "claim_page", "register_claimed"}
+            "claim_page", "register_claimed", "evict_cached"}
 ALLOC_LOCK = "_alloc_lock"
 DISPATCH_LOCK = "dispatch_lock"
 

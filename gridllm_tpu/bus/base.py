@@ -89,7 +89,7 @@ def channel_class(channel: str) -> str:
 _DURABLE_PREFIXES = ("job:result:", "job:stream:", "admin:result:", "kvx:")
 _DURABLE_CHANNELS = frozenset((
     "job:completed", "job:failed", "job:timeout",
-    "job:snapshot", "job:handoff", "job:drain",
+    "job:snapshot", "job:handoff", "job:drain", "job:preempted",
 ))
 
 

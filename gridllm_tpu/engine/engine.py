@@ -77,8 +77,10 @@ from gridllm_tpu.ops.attention import ragged_attention_enabled
 from gridllm_tpu.ops.kvcache import (
     PagedKVCache,
     PageAllocator,
+    QuantPages,
     rollback_to_length,
 )
+from gridllm_tpu.ops.kvtier import set_tier_gauges
 from gridllm_tpu.ops.sampling import (
     SamplingParams,
     sample_tokens,
@@ -248,6 +250,20 @@ class EngineConfig:
     # (ops/sampling.py spec_accept).
     spec_decode: bool | None = None
     spec_k: int | None = None
+    # tiered KV cache (ISSUE 11). kv_host_bytes: host-RAM tier capacity —
+    # prefix-cache pages evicted from HBM spill there (wire-codec encoded)
+    # and page back in on match_prefix hits; the capacity IS the enable
+    # (0 = off). None → GRIDLLM_KV_HOST_BYTES. kv_spill_int8: int8-
+    # quantize fp pages on spill (scale-per-page; halves host bytes) —
+    # 0 spills raw bytes so tier-on streams stay byte-identical to
+    # tier-off. None → GRIDLLM_KV_SPILL_INT8 (default on). kv_int8:
+    # resident int8 KV pool (QuantPages — per-row scales, dequant
+    # epilogue in the attention read path), halving KV HBM. None →
+    # GRIDLLM_KV_INT8 (default off). Single-device pools only: meshes
+    # keep the fp layout.
+    kv_host_bytes: int | None = None
+    kv_spill_int8: bool | None = None
+    kv_int8: bool | None = None
 
 
 @dataclasses.dataclass
@@ -409,6 +425,11 @@ class InferenceEngine:
         self._prefix_cache_cap = (
             0 if sp_prefill else self._resolve_prefix_cache_cap()
         )
+        # tiered KV cache (ISSUE 11), both knobs resolved ONCE at startup:
+        # the pool layout depends on kv_int8, and the host tier outlives
+        # device-state resets (content-addressed pages stay valid)
+        self._kv_int8 = self._resolve_kv_int8()
+        self.host_tier = self._build_host_tier()
         self._lock = threading.Lock()
         # allocator guard (ISSUE 7): page allocation/free runs on the
         # driving thread (admission/finish), while KV export/import runs
@@ -544,6 +565,48 @@ class InferenceEngine:
             pages = env_int("GRIDLLM_PREFIX_CACHE_PAGES")
         return max(pages, -1)
 
+    def _resolve_kv_int8(self) -> bool:
+        """Resident int8 KV pool (ISSUE 11). EngineConfig overrides env.
+        Single-device pools only: a mesh shards the pool arrays and the
+        QuantPages scale operands have no shard_map plumbing — meshes
+        keep the fp layout (logged, not silent)."""
+        on = self.config.kv_int8
+        if on is None:
+            on = env_bool("GRIDLLM_KV_INT8")
+        if not on or self.embedding_only:
+            return False
+        if self.mesh is not None:
+            log.info("int8 KV pool disabled: meshed pools keep the fp "
+                     "layout", model=self.cfg.name)
+            return False
+        return True
+
+    def _build_host_tier(self):
+        """Host-RAM KV tier (ISSUE 11): the spill target behind the HBM
+        reuse LRU. Needs the prefix cache (the spill unit IS a
+        content-addressed cached page) and a process-local unsharded
+        pool — the same constraints as KV migration."""
+        cap = self.config.kv_host_bytes
+        if cap is None:
+            cap = env_int("GRIDLLM_KV_HOST_BYTES")
+        if cap <= 0 or self.embedding_only:
+            return None
+        if self._prefix_cache_cap == 0 or self.mesh is not None:
+            log.info("host KV tier disabled: needs the prefix cache and "
+                     "an unsharded pool", model=self.cfg.name,
+                     prefixCache=self._prefix_cache_cap != 0,
+                     meshed=self.mesh is not None)
+            return None
+        spill_int8 = self.config.kv_spill_int8
+        if spill_int8 is None:
+            spill_int8 = env_bool("GRIDLLM_KV_SPILL_INT8")
+        from gridllm_tpu.ops.kvtier import HostKVTier
+
+        log.info("host KV tier enabled", model=self.cfg.name,
+                 capacityBytes=cap,
+                 spillDtype="int8-page" if spill_int8 else "raw")
+        return HostKVTier(cap, model=self.cfg.name, spill_int8=spill_int8)
+
     def _resolve_spec_k(self) -> int:
         """Speculation depth K (0 = off). EngineConfig overrides env;
         GRIDLLM_SPEC_DECODE=0 disables, GRIDLLM_SPEC_K sets the depth
@@ -612,16 +675,43 @@ class InferenceEngine:
                 hint=f"to keep KV HBM at the unpadded budget, set "
                      f"num_pages={int(c.num_pages * mc.head_dim_ / dpool)}",
             )
-        cache = PagedKVCache.create(
-            mc.num_layers, c.num_pages, c.page_size, mc.num_kv_heads,
-            dpool, c.max_slots, c.max_pages_per_slot,
-            dtype=dtype,
-        )
+        if self._kv_int8:
+            # resident int8 pool (ISSUE 11): QuantPages where the fp pool
+            # arrays would sit — int8 values + one f32 scale per (layer,
+            # page, row). Scales init to 1.0 so unwritten rows dequant to
+            # exact zeros. Halves KV HBM; the write dispatchers quantize
+            # per row at the boundary, the ragged kernel / jnp fallbacks
+            # dequantize on read.
+            shape = (mc.num_layers, c.num_pages, c.page_size,
+                     mc.num_kv_heads, dpool)
+            sshape = (mc.num_layers, c.num_pages, c.page_size)
+            cache = PagedKVCache(
+                k=QuantPages(jnp.zeros(shape, jnp.int8),
+                             jnp.ones(sshape, jnp.float32)),
+                v=QuantPages(jnp.zeros(shape, jnp.int8),
+                             jnp.ones(sshape, jnp.float32)),
+                page_table=jnp.full((c.max_slots, c.max_pages_per_slot),
+                                    -1, jnp.int32),
+                lengths=jnp.zeros((c.max_slots,), jnp.int32),
+                page_size=c.page_size,
+            )
+        else:
+            cache = PagedKVCache.create(
+                mc.num_layers, c.num_pages, c.page_size, mc.num_kv_heads,
+                dpool, c.max_slots, c.max_pages_per_slot,
+                dtype=dtype,
+            )
         self.cache = shard_cache(cache, self.mesh) if self.mesh else cache
         self.alloc = PageAllocator(
             c.num_pages, c.page_size, c.max_pages_per_slot,
             cache_pages=self._prefix_cache_cap, model=mc.name,
         )
+        if self.host_tier is not None:
+            # tiered KV cache (ISSUE 11): eviction spills to host RAM,
+            # match_prefix misses consult it — both fire under
+            # _alloc_lock from inside the allocator
+            self.alloc.spill_sink = self._spill_page_to_host
+            self.alloc.restore_source = self._restore_page_from_host
         # lock-discipline sanitizer (ISSUE 8): under GRIDLLM_SANITIZE=1
         # every mutating allocator call asserts _alloc_lock ownership at
         # the call site instead of corrupting refcounts three requests
@@ -1182,6 +1272,16 @@ class InferenceEngine:
         cached = self.alloc.cached_pages
         _KV_PAGES_FREE.set(free, model=self.cfg.name)
         _KV_PAGES_CACHED.set(cached, model=self.cfg.name)
+        # per-tier residency (ISSUE 11): hbm = reuse-LRU pages at pool
+        # bytes/page, host = encoded bytes actually held by the tier
+        kv_bytes = self.cache.k.nbytes + self.cache.v.nbytes
+        bpp = kv_bytes / max(self.config.num_pages, 1)
+        tier = self.host_tier
+        set_tier_gauges(
+            self.cfg.name, cached, int(cached * bpp),
+            tier.pages if tier is not None else 0,
+            tier.bytes_used if tier is not None else 0,
+        )
         # "used" = pages referenced by live requests; cached-but-evictable
         # pages are their own series so dashboards don't read a warm cache
         # as pool pressure
@@ -2068,8 +2168,24 @@ class InferenceEngine:
                 # concurrent decode dispatch never stalls on an export
                 idx = jnp.asarray(pages, jnp.int32)
                 d = self.cfg.head_dim_
-                k_dev = self.cache.k[:, idx][..., :d]
-                v_dev = self.cache.v[:, idx][..., :d]
+                if self._kv_int8:
+                    # int8 pool (ISSUE 11): the wire carries the engine
+                    # compute dtype so fp and int8 workers interoperate —
+                    # dequantize on export, requantize on install
+                    dt = jnp.dtype(self.config.dtype)
+                    k_dev = (
+                        self.cache.k.data[:, idx][..., :d]
+                        .astype(jnp.float32)
+                        * self.cache.k.scale[:, idx][..., None, None]
+                    ).astype(dt)
+                    v_dev = (
+                        self.cache.v.data[:, idx][..., :d]
+                        .astype(jnp.float32)
+                        * self.cache.v.scale[:, idx][..., None, None]
+                    ).astype(dt)
+                else:
+                    k_dev = self.cache.k[:, idx][..., :d]
+                    v_dev = self.cache.v[:, idx][..., :d]
             k = np.asarray(k_dev)
             v = np.asarray(v_dev)
         finally:
@@ -2113,10 +2229,15 @@ class InferenceEngine:
                 f"pool geometry mismatch: wire L{meta['numLayers']}/"
                 f"H{meta['kvHeads']}/D{meta['headDim']} vs "
                 f"L{mc.num_layers}/H{kvh}/D{mc.head_dim_}")
-        if jnp.dtype(str(meta["dtype"])) != self.cache.k.dtype:
+        # int8 pools (ISSUE 11) exchange fp pages on the wire (export
+        # dequantizes, install requantizes) — the contract dtype is the
+        # engine compute dtype, not the pool storage dtype
+        wire_dtype = (jnp.dtype(c.dtype) if self._kv_int8
+                      else self.cache.k.dtype)
+        if jnp.dtype(str(meta["dtype"])) != wire_dtype:
             raise ValueError(
                 f"dtype mismatch: wire {meta['dtype']} vs pool "
-                f"{self.cache.k.dtype}")
+                f"{wire_dtype}")
         n = min(int(k.shape[1]), len(token_ids) // ps)
         keys = self.alloc.chain_keys(token_ids, n_pages=n)
         # claim pool pages under the allocator lock; claimed pages come
@@ -2158,18 +2279,39 @@ class InferenceEngine:
 
     def _write_imported_pages(self, writes: list[tuple[int, int]],
                               k: np.ndarray, v: np.ndarray,
-                              dpool: int) -> None:
+                              dpool: int,
+                              k_rowscale: np.ndarray | None = None,
+                              v_rowscale: np.ndarray | None = None) -> None:
         """Scatter imported page data into the pool in fixed-size blocks
         (sentinel-padded so ONE compiled program serves any count), with
-        buffer donation so the pool is updated in place."""
+        buffer donation so the pool is updated in place.
+
+        int8 pools (ISSUE 11): ``k``/``v`` either arrive as int8 with
+        per-row scales (``k_rowscale``/``v_rowscale`` [L, n, ps] — a
+        host-tier restore of an int8 spill) or as fp pages (a KV
+        migration), which requantize per row host-side here."""
+        if self._kv_int8 and k_rowscale is None:
+            from gridllm_tpu.ops.kvtier import quantize_rows_np
+
+            k, k_rowscale = quantize_rows_np(k)
+            v, v_rowscale = quantize_rows_np(v)
         if dpool != k.shape[-1]:  # lane-padded pool: zero-pad the lanes
             pad = [(0, 0)] * (k.ndim - 1) + [(0, dpool - k.shape[-1])]
             k, v = np.pad(k, pad), np.pad(v, pad)
         if self._kv_install_fn is None:
-            @partial(jax.jit, donate_argnums=(0, 1))
-            def install_fn(k_pages, v_pages, idx, k_new, v_new):
-                return (k_pages.at[:, idx].set(k_new, mode="drop"),
-                        v_pages.at[:, idx].set(v_new, mode="drop"))
+            if self._kv_int8:
+                @partial(jax.jit, donate_argnums=(0, 1, 2, 3))
+                def install_fn(kd, ksc, vd, vsc, idx, k_new, ks_new,
+                               v_new, vs_new):
+                    return (kd.at[:, idx].set(k_new, mode="drop"),
+                            ksc.at[:, idx].set(ks_new, mode="drop"),
+                            vd.at[:, idx].set(v_new, mode="drop"),
+                            vsc.at[:, idx].set(vs_new, mode="drop"))
+            else:
+                @partial(jax.jit, donate_argnums=(0, 1))
+                def install_fn(k_pages, v_pages, idx, k_new, v_new):
+                    return (k_pages.at[:, idx].set(k_new, mode="drop"),
+                            v_pages.at[:, idx].set(v_new, mode="drop"))
 
             # armable=False: imports legitimately first compile long after
             # the engine arms (the first migration can land any time)
@@ -2183,18 +2325,187 @@ class InferenceEngine:
             idx = np.full((block,), sentinel, np.int32)
             kb = np.zeros((k.shape[0], block) + k.shape[2:], dtype=k.dtype)
             vb = np.zeros_like(kb)
+            if self._kv_int8:
+                ksb = np.ones((k.shape[0], block, self.config.page_size),
+                              np.float32)
+                vsb = np.ones_like(ksb)
             for j, (page, src) in enumerate(grp):
                 idx[j] = page
                 kb[:, j] = k[:, src]
                 vb[:, j] = v[:, src]
+                if self._kv_int8:
+                    ksb[:, j] = k_rowscale[:, src]
+                    vsb[:, j] = v_rowscale[:, src]
             with self.dispatch_lock:
-                new_k, new_v = self._kv_install_fn(
-                    self.cache.k, self.cache.v, jnp.asarray(idx),
-                    jnp.asarray(kb, dt), jnp.asarray(vb, dt))
+                if self._kv_int8:
+                    kd, ksc, vd, vsc = self._kv_install_fn(
+                        self.cache.k.data, self.cache.k.scale,
+                        self.cache.v.data, self.cache.v.scale,
+                        jnp.asarray(idx), jnp.asarray(kb, dt),
+                        jnp.asarray(ksb), jnp.asarray(vb, dt),
+                        jnp.asarray(vsb))
+                    new_k = QuantPages(kd, ksc)
+                    new_v = QuantPages(vd, vsc)
+                else:
+                    new_k, new_v = self._kv_install_fn(
+                        self.cache.k, self.cache.v, jnp.asarray(idx),
+                        jnp.asarray(kb, dt), jnp.asarray(vb, dt))
                 self.cache = PagedKVCache(
                     k=new_k, v=new_v, page_table=self.cache.page_table,
                     lengths=self.cache.lengths,
                     page_size=self.cache.page_size)
+
+    # ----------------------------------------- tiered KV cache (ISSUE 11)
+
+    def _spill_page_to_host(self, page: int, key: bytes) -> None:
+        """Allocator spill hook: copy one about-to-be-evicted prefix-cache
+        page into the host tier (fires under _alloc_lock, from inside the
+        allocator's eviction paths). Best-effort — a failure (or the
+        ``kvtier.spill`` fault site) just loses the page from the tier
+        and the later match degrades to a cold prefill."""
+        tier = self.host_tier
+        if tier is None or self.plan_sink is not None:
+            return
+        if key in tier:
+            return  # content-addressed: the existing host copy is valid
+        if faults.check("kvtier.spill"):
+            return
+        # one synchronous device→host round trip per NEW page, under the
+        # caller's _alloc_lock; re-evictions short-circuit above, so only
+        # first-time spills pay it. Batching an alloc()'s whole eviction
+        # set into one indexed gather (the export_prefix_pages shape)
+        # needs an allocator-side evict-N hook — deliberate future work.
+        d = self.cfg.head_dim_
+        # TRACED index gather (same pattern as export_prefix_pages): a
+        # static python-int slice would compile one XLA program per
+        # distinct page id — an eviction storm over a big pool would
+        # serialize fresh compiles on the admission path
+        idx = jnp.asarray([page], jnp.int32)
+        with self.dispatch_lock:
+            # dispatch the gather only; the device→host copy below runs
+            # without the lock (same discipline as export_prefix_pages)
+            if self._kv_int8:
+                k_dev = self.cache.k.data[:, idx][..., :d]
+                v_dev = self.cache.v.data[:, idx][..., :d]
+                ks_dev = self.cache.k.scale[:, idx]
+                vs_dev = self.cache.v.scale[:, idx]
+            else:
+                k_dev = self.cache.k[:, idx][..., :d]
+                v_dev = self.cache.v[:, idx][..., :d]
+        k = np.asarray(k_dev)                    # [L, 1, ps, KVH, D]
+        v = np.asarray(v_dev)
+        if self._kv_int8:
+            tier.put(key, k, v,
+                     k_scale=np.asarray(ks_dev),
+                     v_scale=np.asarray(vs_dev),
+                     quant="int8-rows")
+        else:
+            tier.put(key, k, v)
+
+    def _restore_page_from_host(self, key: bytes) -> int | None:
+        """Allocator restore hook (consulted by match_prefix under
+        _alloc_lock on a chain miss): page one spilled page back into a
+        fresh pool page, register it under its chain key at refcount 0,
+        and return the page id so the match keeps walking. None = tier
+        miss / injected fault / pool pressure / integrity failure — the
+        admission degrades to a cold prefill, never a wedged request."""
+        tier = self.host_tier
+        if tier is None or self.plan_sink is not None:
+            return None
+        rec = tier.get(key)
+        if rec is None:
+            return None
+        if faults.check("kvtier.restore"):
+            tier.note_restore_failure()
+            return None
+        with self._alloc_lock:
+            page = self.alloc.claim_page()
+        if page is None:
+            tier.note_restore_failure()  # pool pressure: nowhere to land
+            return None
+        k, v, ks, vs, quant = rec
+        try:
+            self._install_restored_page(page, k, v, ks, vs, quant)
+        except Exception as e:  # noqa: BLE001 — degrade to cold prefill
+            log.warning("host-tier restore install failed",
+                        model=self.cfg.name, error=str(e))
+            tier.note_restore_failure()
+            with self._alloc_lock:
+                self.alloc.unpin_pages([page])
+            return None
+        with self._alloc_lock:
+            self.alloc.register_claimed(page, key)
+            self.alloc.unpin_pages([page])
+            out = self.alloc.peek_key(key)
+        tier.mark_restored(key)
+        return out
+
+    def _install_restored_page(self, page: int, k: np.ndarray,
+                               v: np.ndarray, ks: np.ndarray | None,
+                               vs: np.ndarray | None,
+                               quant: str | None) -> None:
+        """Decode one spill record to the pool's dtype/layout and write it
+        into ``page`` (the import install program, reused)."""
+        from gridllm_tpu.ops.kvtier import dequantize_page
+
+        dpool = self.cache.k.shape[-1]
+        if self._kv_int8:
+            if quant == "int8-rows":
+                # int8 spill of an int8 pool: rows + scales land verbatim
+                # (ks/vs [L, 1, ps])
+                self._write_imported_pages(
+                    [(page, 0)], k, v, dpool,
+                    k_rowscale=np.asarray(ks, np.float32),
+                    v_rowscale=np.asarray(vs, np.float32))
+                return
+            if quant == "int8-page":
+                k, v = dequantize_page(k, ks), dequantize_page(v, vs)
+            self._write_imported_pages(
+                [(page, 0)], np.asarray(k, np.float32),
+                np.asarray(v, np.float32), dpool)
+            return
+        if quant == "int8-page":
+            k, v = dequantize_page(k, ks), dequantize_page(v, vs)
+        elif quant == "int8-rows":
+            k = np.asarray(k, np.float32) * ks[..., None, None]
+            v = np.asarray(v, np.float32) * vs[..., None, None]
+        self._write_imported_pages([(page, 0)], k, v, dpool)
+
+    def park_to_host(self, token_ids: list[int]) -> int:
+        """Suspend-to-host (ISSUE 11): move the cached full-page prefix
+        of ``token_ids`` into the host tier and FREE its HBM pages, so a
+        suspended decode stops occupying device memory entirely. The
+        later resume admission restores the pages through the normal
+        match_prefix warm path. Pages still shared with a live request
+        are copied but NOT freed — a pinned shared page never leaves HBM
+        mid-decode. Returns the number of tokens whose pages now live in
+        the host tier (contiguous from position 0)."""
+        tier = self.host_tier
+        if tier is None or self.plan_sink is not None or len(token_ids) < 2:
+            return 0
+        with self._alloc_lock:
+            pages, _covered = self.alloc.pin_prefix(token_ids)
+        if not pages:
+            return 0
+        keys = self.alloc.chain_keys(token_ids, n_pages=len(pages))
+        parked = 0
+        try:
+            for pg, key in zip(pages, keys):
+                self._spill_page_to_host(pg, key)
+                if key in tier:
+                    parked += 1
+                else:
+                    break  # keep the parked prefix contiguous
+        finally:
+            with self._alloc_lock:
+                self.alloc.unpin_pages(pages)
+                self.alloc.evict_cached(
+                    [pg for pg, key in zip(pages, keys) if key in tier])
+        self._update_kv_gauges()
+        _FLIGHTREC.record("engine", "kv_park", model=self.cfg.name,
+                          pages=parked,
+                          tokens=parked * self.config.page_size)
+        return parked * self.config.page_size
 
     @property
     def active_requests(self) -> int:
@@ -2244,6 +2555,9 @@ class InferenceEngine:
                 "evictions": self.alloc.evictions,
                 "cowCopies": self.alloc.cow_copies,
             } if not self.embedding_only else None,
+            "hostTier": (self.host_tier.stats()
+                         if not self.embedding_only
+                         and self.host_tier is not None else None),
             "specDecode": {
                 "k": self._spec_k, **self.spec_stats,
             } if self._spec_k else None,
@@ -2262,7 +2576,11 @@ class InferenceEngine:
         if self.embedding_only:
             return out
         cache = self.cache
-        out["kv"] = [cache.k, cache.v, cache.page_table, cache.lengths]
+        if isinstance(cache.k, QuantPages):
+            out["kv"] = [cache.k.data, cache.k.scale, cache.v.data,
+                         cache.v.scale, cache.page_table, cache.lengths]
+        else:
+            out["kv"] = [cache.k, cache.v, cache.page_table, cache.lengths]
         c, mc = self.config, self.cfg
         kv_bytes = cache.k.nbytes + cache.v.nbytes
         bpp = kv_bytes / max(c.num_pages, 1)
@@ -2304,5 +2622,10 @@ class InferenceEngine:
             "fragmentation": (
                 max(0.0, round(1 - live_tokens / capacity_tokens, 4))
                 if capacity_tokens else 0.0),
+            # tiered KV cache (ISSUE 11): int8 residency + host-tier
+            # occupancy/flow, itemized per tier in /admin/memory
+            "kvInt8": self._kv_int8,
+            "hostTier": (self.host_tier.stats()
+                         if self.host_tier is not None else None),
         }
         return out

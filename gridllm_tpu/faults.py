@@ -22,6 +22,11 @@ parse instead of silently injecting nothing:
     kvx.send          fail a KV-migration send (sender falls back locally)
     kvx.import        fail a KV-migration import (receiver NACKs)
     alloc.alloc       simulate KV page-pool exhaustion (alloc returns None)
+    kvtier.spill      skip a host-tier page spill (the evicted page is
+                      simply lost from the tier — a later match is a miss)
+    kvtier.restore    fail a host-tier page restore (the admission
+                      degrades to a cold prefill — counted miss, never a
+                      wedged request)
     worker.heartbeat  skip one worker heartbeat (key not refreshed)
     engine.step       raise from the engine runner's pump (step-failure
                       recovery: abort + device-state rebuild)
@@ -52,6 +57,8 @@ SITES = (
     "kvx.send",
     "kvx.import",
     "alloc.alloc",
+    "kvtier.spill",
+    "kvtier.restore",
     "worker.heartbeat",
     "engine.step",
     "broker.accept",

@@ -25,6 +25,7 @@ import jax.numpy as jnp
 
 from gridllm_tpu.utils.config import env_bool
 from gridllm_tpu.ops.kvcache import (
+    QuantPages,
     _env_mode,
     _pallas_mode,
     _shard_map_kernel,
@@ -213,7 +214,11 @@ def paged_attention_decode(
         return out[..., :d]
     use, interpret = _pallas_mode(use_pallas)
     mode, ax = kernel_mesh_axis(mesh, k_pages.shape[-2], q.shape[1])
-    if use and mode != "ref" and (interpret or q.shape[-1] % 128 == 0):
+    # int8 pools (ISSUE 11) read through the ragged kernel's dequant
+    # epilogue or the jnp fallback; the legacy decode kernel has no
+    # scale plumbing, so a quantized pool takes the reference path here
+    if use and mode != "ref" and not isinstance(k_pages, QuantPages) \
+            and (interpret or q.shape[-1] % 128 == 0):
         from gridllm_tpu.ops import pallas_kernels
 
         record_kernel_path("attention_decode", True)
@@ -253,8 +258,13 @@ def paged_attention_decode(
     record_kernel_path("attention_decode", False)
     if k_pages.ndim == 5:  # fallback: materialize the layer slice
         li = jnp.int32(0) if layer is None else layer
-        k_pages = jax.lax.dynamic_index_in_dim(k_pages, li, keepdims=False)
-        v_pages = jax.lax.dynamic_index_in_dim(v_pages, li, keepdims=False)
+        if isinstance(k_pages, QuantPages):
+            k_pages, v_pages = k_pages.layer(li), v_pages.layer(li)
+        else:
+            k_pages = jax.lax.dynamic_index_in_dim(k_pages, li,
+                                                   keepdims=False)
+            v_pages = jax.lax.dynamic_index_in_dim(v_pages, li,
+                                                   keepdims=False)
     return paged_attention_decode_ref(
         q, k_pages, v_pages, page_table, lengths, page_size,
         k_cur=k_cur, v_cur=v_cur, logit_softcap=logit_softcap,
@@ -319,6 +329,7 @@ def attention_prefix_chunk(
     kvh_local = kvh // mesh.shape["tp"] if ax == "tp" else kvh
     if (
         use and mode != "ref" and k_cur is not None
+        and not isinstance(k_pages, QuantPages)
         and (interpret or d % 128 == 0)
         and t % min(128, t) == 0
         and 2 * t * kvh_local * d * q.dtype.itemsize <= _FLASH_KV_VMEM_CAP
@@ -398,8 +409,12 @@ def _prefix_chunk_ref(
         li = jnp.int32(0) if layer is None else layer
         rows = jnp.maximum(table_row, 0)
         n = table_row.shape[0] * page_size
-        ks = k_pages[li, rows].reshape(n, kvh, d)
-        vs = v_pages[li, rows].reshape(n, kvh, d)
+        if isinstance(k_pages, QuantPages):
+            ks = k_pages.layer(li).take(rows).reshape(n, kvh, d)
+            vs = v_pages.layer(li).take(rows).reshape(n, kvh, d)
+        else:
+            ks = k_pages[li, rows].reshape(n, kvh, d)
+            vs = v_pages[li, rows].reshape(n, kvh, d)
     else:
         ks, vs = gather_kv(k_pages, v_pages, table_row, page_size)  # [N, KVH, D]
     if k_cur is not None:
@@ -482,7 +497,7 @@ def paged_attention_verify(
     t = q.shape[1]
     use, interpret = _pallas_mode(use_pallas)
     mode, _ax = kernel_mesh_axis(mesh, k_cur.shape[2], q.shape[2])
-    if use and mode != "ref":
+    if use and mode != "ref" and not isinstance(k_pages, QuantPages):
         outs = [
             attention_prefix_chunk(
                 q[i][None], k_pages, v_pages, page_table[i], lengths[i],
@@ -526,8 +541,13 @@ def paged_attention_verify_ref(
     w = jnp.asarray(window, jnp.int32)
     if k_pages.ndim == 5:
         li = jnp.int32(0) if layer is None else layer
-        k_pages = jax.lax.dynamic_index_in_dim(k_pages, li, keepdims=False)
-        v_pages = jax.lax.dynamic_index_in_dim(v_pages, li, keepdims=False)
+        if isinstance(k_pages, QuantPages):
+            k_pages, v_pages = k_pages.layer(li), v_pages.layer(li)
+        else:
+            k_pages = jax.lax.dynamic_index_in_dim(k_pages, li,
+                                                   keepdims=False)
+            v_pages = jax.lax.dynamic_index_in_dim(v_pages, li,
+                                                   keepdims=False)
 
     def one_slot(qi, row, start, kc, vc):
         ks, vs = gather_kv(k_pages, v_pages, row, page_size)  # [N, KVH, D]
@@ -674,10 +694,39 @@ def ragged_paged_attention(
             and 2 * c * kvh_local * d * q_chunk.dtype.itemsize
             <= _FLASH_KV_VMEM_CAP
         )
+    quant = isinstance(k_pages, QuantPages)
+    if quant and mode == "wrap":
+        # int8 pools are single-device by engine policy (no shard_map
+        # plumbing for the scale operands) — a meshed call is a wiring
+        # bug upstream; serve the exact jnp path instead of guessing
+        mode = "ref"
     if use and mode != "ref" and lanes_ok and chunk_ok:
         from gridllm_tpu.ops import pallas_kernels
 
         record_kernel_path("attention_ragged", True)
+        if quant:
+            # dequant epilogue (ISSUE 11): the kernel DMAs the int8 page
+            # AND its [ps] scale row, multiplying after the load in the
+            # flat-row read path — half the page HBM bytes per step
+            kd, ksc = k_pages.data, k_pages.scale
+            vd, vsc = v_pages.data, v_pages.scale
+            if kd.ndim == 4:
+                kd, vd = kd[None], vd[None]
+                ksc, vsc = ksc[None], vsc[None]
+            kernel = partial(
+                pallas_kernels.ragged_attention, page_size=page_size,
+                interpret=interpret, softcap=float(logit_softcap),
+            )
+            return kernel(
+                kd, vd,
+                q_chunk=q_chunk, chunk_row=chunk_row,
+                chunk_start=chunk_start, chunk_total=chunk_total,
+                k_chunk=k_chunk, v_chunk=v_chunk,
+                q_group=q_group, page_table=page_table,
+                group_lengths=group_lengths, k_group=k_group,
+                v_group=v_group, layer=layer, window=window,
+                k_scale=ksc, v_scale=vsc,
+            )
         kp = k_pages if k_pages.ndim == 5 else k_pages[None]
         vp = v_pages if v_pages.ndim == 5 else v_pages[None]
         kernel = partial(
@@ -755,8 +804,13 @@ def ragged_paged_attention(
             kp, vp = k_pages, v_pages
             if kp.ndim == 5:
                 li = jnp.int32(0) if layer is None else layer
-                kp = jax.lax.dynamic_index_in_dim(kp, li, keepdims=False)
-                vp = jax.lax.dynamic_index_in_dim(vp, li, keepdims=False)
+                if isinstance(kp, QuantPages):
+                    kp, vp = kp.layer(li), vp.layer(li)
+                else:
+                    kp = jax.lax.dynamic_index_in_dim(kp, li,
+                                                      keepdims=False)
+                    vp = jax.lax.dynamic_index_in_dim(vp, li,
+                                                      keepdims=False)
             out_group = paged_attention_decode_ref(
                 q_group[:, 0], kp, vp, page_table, group_lengths, page_size,
                 k_cur=k_group[:, 0], v_cur=v_group[:, 0],

@@ -25,6 +25,7 @@ import functools
 import hashlib
 from collections import OrderedDict
 from functools import partial
+from typing import Any
 
 import jax
 import jax.numpy as jnp
@@ -275,6 +276,72 @@ class PagedKVCache:
         return self.page_table.shape[1] * self.page_size
 
 
+@partial(
+    jax.tree_util.register_dataclass,
+    data_fields=["data", "scale"],
+    meta_fields=[],
+)
+@dataclasses.dataclass
+class QuantPages:
+    """int8 KV page pool + per-row dequant scales (ISSUE 11,
+    ``GRIDLLM_KV_INT8``): ``data`` holds the quantized values, ``scale``
+    one float32 symmetric scale per (layer, page, row) — a token row
+    [KVH, D] is the quantization granule, so incremental decode/verify
+    writes quantize independently without ever re-scaling a page. The
+    engine stores a QuantPages where ``PagedKVCache.k``/``.v`` would hold
+    a raw array; model code passes it through opaquely (same pytree
+    flow/donation), and the ops dispatchers here and in ops/attention.py
+    unwrap it: writes quantize at the boundary, reads dequantize — the
+    ragged Pallas kernel in its flat-row page load (dequant epilogue),
+    every jnp fallback via :func:`gather_kv`/``take``. Halves resident
+    KV HBM at a bounded accuracy cost (per-row worst case scale/2 ≈
+    amax/254 absolute error per element)."""
+
+    data: jnp.ndarray   # int8 [L, P, ps, KVH, D] (or one layer: 4-dim)
+    scale: jnp.ndarray  # f32  [L, P, ps]         (or one layer: [P, ps])
+
+    @property
+    def shape(self):
+        return self.data.shape
+
+    @property
+    def ndim(self) -> int:
+        return self.data.ndim
+
+    @property
+    def dtype(self):
+        return self.data.dtype
+
+    @property
+    def nbytes(self) -> int:
+        return self.data.nbytes + self.scale.nbytes
+
+    def layer(self, li) -> "QuantPages":
+        """One layer's pool slice (dynamic index — from inside a scan)."""
+        return QuantPages(
+            jax.lax.dynamic_index_in_dim(self.data, li, keepdims=False),
+            jax.lax.dynamic_index_in_dim(self.scale, li, keepdims=False),
+        )
+
+    def take(self, rows: jnp.ndarray) -> jnp.ndarray:
+        """Dequantized float32 pages gathered along the page axis of a
+        single-layer (4-dim) pool: data[rows] * scale[rows] broadcast
+        over each row's [KVH, D]."""
+        return (self.data[rows].astype(jnp.float32)
+                * self.scale[rows][..., None, None])
+
+
+def quantize_kv_rows(x: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Per-row symmetric int8 quantization of fresh K/V:
+    x [..., KVH, D] float → (int8 values, float32 scales [...]). A row's
+    scale is amax/127 (all-zero rows keep 1.0 so dequant is exact)."""
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=(-2, -1))
+    scale = jnp.where(amax > 0, amax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(xf / scale[..., None, None]), -127, 127)
+    return q.astype(jnp.int8), scale.astype(jnp.float32)
+
+
 def _safe_page_idx(
     lookup,
     positions: jnp.ndarray,
@@ -323,6 +390,11 @@ def write_prefill(
     per-layer writes inside a scan defeat XLA's in-place buffer aliasing.
     """
     del use_pallas  # single-layer form is always scatter; see _all variant
+    if isinstance(k_pages, QuantPages):
+        # only pp routes through the single-layer forms, and the engine
+        # pins int8 off under any mesh — reaching here is a wiring bug
+        raise TypeError("int8 KV pools are not supported on the "
+                        "single-layer write path")
     t = jnp.arange(k_new.shape[0], dtype=jnp.int32)
     pos = start + t
     page_idx = _safe_page_idx(
@@ -355,6 +427,9 @@ def write_decode(
     is write_decode_all (all layers, once per step, after the layer scan).
     """
     del use_pallas
+    if isinstance(k_pages, QuantPages):
+        raise TypeError("int8 KV pools are not supported on the "
+                        "single-layer write path")
     s = jnp.arange(page_table.shape[0], dtype=jnp.int32)
     page_idx = _safe_page_idx(
         lambda p: page_table[s, p], positions, active, page_size,
@@ -386,7 +461,34 @@ def write_decode_all(
     one batched scatter). Under `mesh` the kernel runs inside a
     full-manual shard_map with kv-heads split over tp (writes are
     shard-local — no collectives; see kernel_mesh_axis).
+
+    int8 pools (QuantPages, ISSUE 11) quantize the fresh rows per row at
+    this boundary and scatter values + scales; the write KERNEL path is
+    deliberately skipped there (the int8 scatter is O(S) rows — tiny
+    next to attention — and Mosaic's int8 sublane tiling on sub-lane-row
+    DMA destinations is unproven on real hardware).
     """
+    if isinstance(k_pages, QuantPages):
+        k_new, v_new = _pad_new_lanes(k_pages, k_new, v_new)
+        s = jnp.arange(page_table.shape[0], dtype=jnp.int32)
+        page_idx = _safe_page_idx(
+            lambda p: page_table[s, p], positions, active, page_size,
+            page_table.shape[1], k_pages.data.shape[1],
+        )
+        offset = positions % page_size
+        record_kernel_path("write_decode", False)
+        kq, ksc = quantize_kv_rows(k_new)   # [L, S, KVH, D] / [L, S]
+        vq, vsc = quantize_kv_rows(v_new)
+        return (
+            QuantPages(
+                k_pages.data.at[:, page_idx, offset].set(kq, mode="drop"),
+                k_pages.scale.at[:, page_idx, offset].set(ksc, mode="drop"),
+            ),
+            QuantPages(
+                v_pages.data.at[:, page_idx, offset].set(vq, mode="drop"),
+                v_pages.scale.at[:, page_idx, offset].set(vsc, mode="drop"),
+            ),
+        )
     k_new, v_new = _pad_new_lanes(k_pages, k_new, v_new)
     s = jnp.arange(page_table.shape[0], dtype=jnp.int32)
     page_idx = _safe_page_idx(
@@ -449,7 +551,36 @@ def write_multi_all(
     rows, which is exactly paged_write_decode's contract (one [KVH, D]
     row per destination, destinations never colliding — positions within
     a slot are consecutive and distinct, pages are slot-exclusive).
+
+    int8 pools (QuantPages): the flattened rows quantize per row and the
+    scales scatter alongside, exactly like write_decode_all.
     """
+    if isinstance(k_pages, QuantPages):
+        k_new, v_new = _pad_new_lanes(k_pages, k_new, v_new)
+        n_layers, s, t = k_new.shape[:3]
+        pos = positions.reshape(-1)
+        slot_of = jnp.repeat(
+            jnp.arange(page_table.shape[0], dtype=jnp.int32), t)
+        page_idx = _safe_page_idx(
+            lambda p: page_table[slot_of, p], pos, jnp.repeat(active, t),
+            page_size, page_table.shape[1], k_pages.data.shape[1],
+        )
+        offset = pos % page_size
+        record_kernel_path("write_multi", False)
+        kq, ksc = quantize_kv_rows(
+            k_new.reshape(n_layers, s * t, *k_new.shape[3:]))
+        vq, vsc = quantize_kv_rows(
+            v_new.reshape(n_layers, s * t, *v_new.shape[3:]))
+        return (
+            QuantPages(
+                k_pages.data.at[:, page_idx, offset].set(kq, mode="drop"),
+                k_pages.scale.at[:, page_idx, offset].set(ksc, mode="drop"),
+            ),
+            QuantPages(
+                v_pages.data.at[:, page_idx, offset].set(vq, mode="drop"),
+                v_pages.scale.at[:, page_idx, offset].set(vsc, mode="drop"),
+            ),
+        )
     k_new, v_new = _pad_new_lanes(k_pages, k_new, v_new)
     n_layers, s, t = k_new.shape[:3]
     pos = positions.reshape(-1)
@@ -529,7 +660,32 @@ def write_prefill_all(
     Kernel path (TPU) requires T % page_size == 0 (static check) and
     page-aligned `start` (engine-guaranteed; see paged_write_chunk).
     Under `mesh`: full-manual shard_map, kv-heads split over tp.
+
+    int8 pools (QuantPages): per-row quantize + scale scatter, like
+    write_decode_all (scatter path — see the rationale there).
     """
+    if isinstance(k_pages, QuantPages):
+        k_new, v_new = _pad_new_lanes(k_pages, k_new, v_new)
+        t = jnp.arange(k_new.shape[1], dtype=jnp.int32)
+        pos = start + t
+        page_idx = _safe_page_idx(
+            lambda p: table_row[p], pos, t < length, page_size,
+            table_row.shape[0], k_pages.data.shape[1],
+        )
+        offset = pos % page_size
+        record_kernel_path("write_prefill", False)
+        kq, ksc = quantize_kv_rows(k_new)   # [L, T, KVH, D] / [L, T]
+        vq, vsc = quantize_kv_rows(v_new)
+        return (
+            QuantPages(
+                k_pages.data.at[:, page_idx, offset].set(kq, mode="drop"),
+                k_pages.scale.at[:, page_idx, offset].set(ksc, mode="drop"),
+            ),
+            QuantPages(
+                v_pages.data.at[:, page_idx, offset].set(vq, mode="drop"),
+                v_pages.scale.at[:, page_idx, offset].set(vsc, mode="drop"),
+            ),
+        )
     k_new, v_new = _pad_new_lanes(k_pages, k_new, v_new)
     use, interpret = _pallas_mode(use_pallas)
     mode, ax = kernel_mesh_axis(mesh, k_new.shape[2])
@@ -571,10 +727,17 @@ def gather_kv(
     """Materialize one slot's K/V [max_pages*page_size, KVH, D] from the pool.
 
     Reference implementation (CPU-testable); the Pallas paged-attention
-    kernel reads pages in place instead of materializing.
+    kernel reads pages in place instead of materializing. int8 pools
+    (QuantPages) dequantize here — float32 out, which the refs cast to
+    anyway — so every jnp fallback reads correct values for free.
     """
-    pages_k = k_pages[jnp.maximum(table_row, 0)]  # [maxp, ps, KVH, D]
-    pages_v = v_pages[jnp.maximum(table_row, 0)]
+    rows = jnp.maximum(table_row, 0)
+    if isinstance(k_pages, QuantPages):
+        pages_k = k_pages.take(rows)              # [maxp, ps, KVH, D] f32
+        pages_v = v_pages.take(rows)
+    else:
+        pages_k = k_pages[rows]                   # [maxp, ps, KVH, D]
+        pages_v = v_pages[rows]
     kvh, d = k_pages.shape[-2], k_pages.shape[-1]
     n = table_row.shape[0] * page_size
     return pages_k.reshape(n, kvh, d), pages_v.reshape(n, kvh, d)
@@ -622,6 +785,17 @@ class PageAllocator:
         self.max_pages_per_slot = max_pages_per_slot
         self.cache_pages = cache_pages
         self.model = model or "unknown"
+        # Tiered KV cache (ISSUE 11): optional host-tier hooks the engine
+        # installs. spill_sink(page, chain_key) fires right before a
+        # REGISTERED page is evicted from the reuse LRU (the engine copies
+        # the page to host RAM); restore_source(chain_key) is consulted by
+        # match_prefix on a chain miss and returns a freshly installed,
+        # registered, refcount-0 page id (or None). Both run under the
+        # engine's _alloc_lock — the same lock every allocator mutation
+        # holds — so the callback may call back into claim_page /
+        # register_claimed / unpin_pages safely (RLock).
+        self.spill_sink: Any = None
+        self.restore_source: Any = None
         self._free: list[int] = list(range(num_pages - 1, -1, -1))
         self._owned: dict[int, list[int]] = {}
         self._refs: dict[int, int] = {}          # page → owners (≥ 1)
@@ -673,11 +847,31 @@ class PageAllocator:
             return self._free.pop()
         if self._lru:  # evict the least-recently-released cached block
             page, _ = self._lru.popitem(last=False)
+            self._spill(page)
             self._drop_key(page)
             self.evictions += 1
             _PREFIX_EVICTIONS.inc(model=self.model)
             return page
         return None
+
+    def _spill(self, page: int) -> None:
+        """Offer an about-to-be-evicted registered page to the host tier
+        (no-op without a sink). A sink failure loses the page from the
+        tier — the later match is just a miss — never the eviction."""
+        sink = self.spill_sink
+        if sink is None:
+            return
+        key = self._key_of.get(page)
+        if key is None:
+            return
+        try:
+            sink(page, key)
+        except Exception as e:  # noqa: BLE001 — spill is best-effort
+            from gridllm_tpu.utils.logging import get_logger
+
+            get_logger("kvcache").warning(
+                "host-tier spill failed; page content lost from tier",
+                model=self.model, page=page, error=str(e))
 
     def _drop_key(self, page: int) -> None:
         key = self._key_of.pop(page, None)
@@ -707,6 +901,21 @@ class PageAllocator:
         for i in range(max_full):
             key = _page_chain_key(key, token_ids[i * ps:(i + 1) * ps])
             page = self._page_by_key.get(key)
+            if page is None and self.restore_source is not None:
+                # tiered KV cache (ISSUE 11): the chain misses in HBM but
+                # the host tier may hold the spilled page — the engine
+                # callback pages it back in (claim + device write +
+                # register) and we keep walking, so a long request's
+                # eviction storm costs restores, not cold prefills
+                try:
+                    page = self.restore_source(key)
+                except Exception as e:  # noqa: BLE001 — degrade to cold
+                    from gridllm_tpu.utils.logging import get_logger
+
+                    get_logger("kvcache").warning(
+                        "host-tier restore failed; cold prefill",
+                        model=self.model, error=str(e))
+                    page = None
             if page is None:
                 break
             self._lru.pop(page, None)
@@ -814,12 +1023,30 @@ class PageAllocator:
             cap = self.cache_pages
             while cap > 0 and len(self._lru) > cap:
                 old, _ = self._lru.popitem(last=False)
+                self._spill(old)
                 self._drop_key(old)
                 self.evictions += 1
                 _PREFIX_EVICTIONS.inc(model=self.model)
                 self._free.append(old)
         else:
             self._free.append(page)
+
+    def evict_cached(self, pages: list[int]) -> int:
+        """Force-drop refcount-0 cached pages to the free list WITHOUT
+        the spill hook — the suspend-to-host park path (engine
+        ``park_to_host``) calls this after it has already copied the
+        pages into the host tier, which is what actually frees the HBM.
+        Pages still pinned by a live request (not in the LRU) are left
+        untouched: a shared page must never be freed mid-decode. Returns
+        the number of pages dropped."""
+        n = 0
+        for page in pages:
+            if page in self._lru:
+                self._lru.pop(page)
+                self._drop_key(page)
+                self._free.append(page)
+                n += 1
+        return n
 
     # -- KV-page migration (ISSUE 7) ----------------------------------------
     #

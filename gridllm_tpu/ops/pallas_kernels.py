@@ -809,6 +809,7 @@ def _ragged_attn_kernel(
     *refs,
     ps: int, bq: int, bk: int, c: int, kvh: int, g: int, d: int,
     td: int, nct: int, softcap: float, has_chunk: bool, has_group: bool,
+    quant: bool = False,
 ):
     """One grid over query-token tiles serving all phases at once
     (the Ragged Paged Attention shape): tiles [0, nct) are the prefill
@@ -836,11 +837,18 @@ def _ragged_attn_kernel(
         vg_ref = next(it)
     k_hbm = next(it)             # ANY [L, P, ps, KVH, D]
     v_hbm = next(it)
+    if quant:
+        ks_hbm = next(it)        # ANY [L, P, ps] f32 per-row scales
+        vs_hbm = next(it)
     oc_ref = next(it) if has_chunk else None
     og_ref = next(it) if has_group else None
     k_scr = next(it)             # VMEM (2, ps, KVH, D) double buffer
     v_scr = next(it)
     sems = next(it)              # DMA sems (2, 2)
+    if quant:
+        ks_scr = next(it)        # VMEM (2, ps) f32 scale double buffer
+        vs_scr = next(it)
+        sc_sems = next(it)       # DMA sems (2, 2)
 
     i = pl.program_id(0)
     layer = scal_ref[0]
@@ -878,6 +886,19 @@ def _ragged_attn_kernel(
                 v_hbm.at[layer, page], v_scr.at[slot], sems.at[slot, 1]
             )
 
+        def scale_dmas(slot, page_no):
+            # int8 pools (ISSUE 11): the page's [ps] per-row scale rows
+            # ride their own small DMAs next to the page copies
+            page = jnp.maximum(page_of(page_no), 0)
+            return (
+                pltpu.make_async_copy(
+                    ks_hbm.at[layer, page], ks_scr.at[slot],
+                    sc_sems.at[slot, 0]),
+                pltpu.make_async_copy(
+                    vs_hbm.at[layer, page], vs_scr.at[slot],
+                    sc_sems.at[slot, 1]),
+            )
+
         n_pages = jnp.minimum(
             pl.cdiv(jnp.maximum(ctx_limit, 0), ps), n_table
         )
@@ -889,6 +910,9 @@ def _ragged_attn_kernel(
         def _():
             k_dma(0, p0).start()
             v_dma(0, p0).start()
+            if quant:
+                for dma in scale_dmas(0, p0):
+                    dma.start()
 
         def body(p, carry):
             m, l, acc = carry
@@ -899,14 +923,38 @@ def _ragged_attn_kernel(
                 nxt = jax.lax.rem(p + 1 - p0, 2)
                 k_dma(nxt, p + 1).start()
                 v_dma(nxt, p + 1).start()
+                if quant:
+                    for dma in scale_dmas(nxt, p + 1):
+                        dma.start()
 
             k_dma(slot, p).wait()
             v_dma(slot, p).wait()
             k_page = k_scr[slot]                    # [ps, KVH, D]
             v_page = v_scr[slot]
+            if quant:
+                # dequant epilogue: the flat-row page load multiplies by
+                # its [ps, 1] scale column right after the DMA — the dots
+                # below see exactly the values an fp pool would hold
+                for dma in scale_dmas(slot, p):
+                    dma.wait()
+                kscale = ks_scr[slot].reshape(ps, 1)
+                vscale = vs_scr[slot].reshape(ps, 1)
+
+            def k_head(h):
+                x = k_page[:, h, :].astype(jnp.float32)
+                if quant:
+                    x = x * kscale
+                return _lp(x)
+
+            def v_head(h):
+                x = v_page[:, h, :].astype(jnp.float32)
+                if quant:
+                    x = x * vscale
+                return _lp(x)
+
             logits = jnp.stack([
                 jax.lax.dot_general(
-                    q_f32[h], _lp(k_page[:, h, :].astype(jnp.float32)),
+                    q_f32[h], k_head(h),
                     (((1,), (1,)), ((), ())),
                     preferred_element_type=jnp.float32,
                 )
@@ -928,7 +976,7 @@ def _ragged_attn_kernel(
             l_new = l * alpha + prob.sum(axis=2, keepdims=True)
             acc_new = acc * alpha + jnp.stack([
                 jax.lax.dot_general(
-                    prob[h], _lp(v_page[:, h, :].astype(jnp.float32)),
+                    prob[h], v_head(h),
                     (((1,), (0,)), ((), ())),
                     preferred_element_type=jnp.float32,
                 )
@@ -1098,6 +1146,8 @@ def ragged_attention(
     interpret: bool = False,
     softcap: float = 0.0,
     window: jnp.ndarray | int = 0,
+    k_scale: jnp.ndarray | None = None,
+    v_scale: jnp.ndarray | None = None,
 ) -> tuple[jnp.ndarray | None, jnp.ndarray | None]:
     """Kernel form of ops.attention.ragged_paged_attention: ONE launch,
     static grid (C/BQ chunk tiles + S group tiles) serving chunked
@@ -1111,9 +1161,13 @@ def ragged_attention(
     has_chunk = q_chunk is not None
     has_group = q_group is not None
     assert has_chunk or has_group
+    quant = k_scale is not None
     if k_pages.ndim == 4:
         k_pages = k_pages[None]
         v_pages = v_pages[None]
+        if quant:
+            k_scale = k_scale[None]
+            v_scale = v_scale[None]
     if layer is None:
         layer = jnp.int32(0)
     kvh, d = k_pages.shape[-2], k_pages.shape[-1]
@@ -1136,7 +1190,7 @@ def ragged_attention(
     kernel = functools.partial(
         _ragged_attn_kernel, ps=page_size, bq=bq, bk=bk, c=c, kvh=kvh,
         g=g, d=d, td=td, nct=nct, softcap=softcap,
-        has_chunk=has_chunk, has_group=has_group,
+        has_chunk=has_chunk, has_group=has_group, quant=quant,
     )
 
     scal = jnp.stack([
@@ -1192,6 +1246,12 @@ def ragged_attention(
     in_specs += [pl.BlockSpec(memory_space=pl.ANY),
                  pl.BlockSpec(memory_space=pl.ANY)]
     args += [k_pages, v_pages]
+    if quant:
+        # int8 pool (ISSUE 11): per-row scales stay in HBM and are DMA'd
+        # page-by-page next to the value pages (dequant epilogue)
+        in_specs += [pl.BlockSpec(memory_space=pl.ANY),
+                     pl.BlockSpec(memory_space=pl.ANY)]
+        args += [k_scale.astype(jnp.float32), v_scale.astype(jnp.float32)]
 
     out_specs = []
     out_shape = []
@@ -1217,7 +1277,11 @@ def ragged_attention(
             pltpu.VMEM((2, page_size, kvh, d), k_pages.dtype),
             pltpu.VMEM((2, page_size, kvh, d), v_pages.dtype),
             pltpu.SemaphoreType.DMA((2, 2)),
-        ],
+        ] + ([
+            pltpu.VMEM((2, page_size), jnp.float32),
+            pltpu.VMEM((2, page_size), jnp.float32),
+            pltpu.SemaphoreType.DMA((2, 2)),
+        ] if quant else []),
     )
     outs = pl.pallas_call(
         kernel,

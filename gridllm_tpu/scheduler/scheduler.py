@@ -125,7 +125,7 @@ class JobScheduler(EventEmitter):
             "gridllm_scheduler_jobs_total",
             "Job lifecycle events (queued/dispatched/completed/failed/"
             "timeout/cancelled/retried/orphaned/nacked/deadline_exceeded/"
-            "retry_budget_exhausted).",
+            "retry_budget_exhausted/preempt_requested/preempted).",
             ("event",),
         )
         self._queue_wait = self.metrics.histogram(
@@ -173,6 +173,12 @@ class JobScheduler(EventEmitter):
         # re-emits nothing the client already saw (exactly-once).
         self._resume_snap: dict[str, dict[str, Any]] = {}
         self._stream_chars: dict[str, int] = {}
+        # Preemption-based priority (ISSUE 11): victim jobId → request
+        # time of an in-flight suspend-to-host ask. One preemption in
+        # flight fleet-wide (a burst must not suspend the whole fleet);
+        # stale entries (victim finished / worker never answered) prune
+        # on the next trigger pass.
+        self._preempting: dict[str, float] = {}
         self._resume_total = self.metrics.counter(
             "gridllm_resume_jobs_total",
             "Decode-resume lifecycle events (stamped = a requeue carried "
@@ -207,6 +213,7 @@ class JobScheduler(EventEmitter):
             ("job:handoff", self._on_handoff),
             ("job:snapshot", self._on_snapshot),
             ("job:drain", self._on_drain),
+            ("job:preempted", self._on_preempted),
         ]:
             self._subs.append(await self.bus.subscribe(channel, handler))
         # worker-side span timelines arrive on trace:{request_id}; merging
@@ -653,13 +660,14 @@ class JobScheduler(EventEmitter):
                 md = qj.request.metadata or {}
                 deadline_at = md.get("deadlineAt")
                 if (deadline_at and now > float(deadline_at)
-                        # a job that already RAN (orphan/drain/resume
-                        # requeue) is past admission: the client may hold
-                        # half a stream, so the resume machinery finishes
-                        # it — the deadline only sheds work that never
-                        # started
+                        # a job that already RAN (orphan/drain/resume/
+                        # preempt requeue) is past admission: the client
+                        # may hold half a stream, so the resume machinery
+                        # finishes it — the deadline only sheds work that
+                        # never started
                         and not (md.get("resume") or md.get("orphaned")
-                                 or md.get("drained"))):
+                                 or md.get("drained")
+                                 or md.get("preempted"))):
                     # past its class deadline while still queued: shed
                     # instead of occupying the queue (ISSUE 9); the
                     # gateway maps the failure to HTTP 504
@@ -670,6 +678,12 @@ class JobScheduler(EventEmitter):
                 worker, disagg = self._plan_placement(qj.request)
                 if worker is None:
                     owners = self.registry.get_workers_with_model(qj.request.model)
+                    if owners:
+                        # preemption-based priority (ISSUE 11): the model
+                        # is served but every worker is saturated — a
+                        # waiting higher-priority job may suspend a
+                        # lower-priority running one to the host KV tier
+                        await self._maybe_preempt(qj, now)
                     if not owners:
                         # loud no-owner log (reference: JobScheduler.ts:176-204),
                         # rate-limited to once per model per 5 s
@@ -1304,6 +1318,121 @@ class JobScheduler(EventEmitter):
             log.job("drained job requeued with resume snapshot", job_id,
                     from_worker=from_worker)
             self.request_dispatch()
+
+    # -- preemption-based priority (ISSUE 11) --------------------------------
+
+    async def _maybe_preempt(self, qj: _QueuedJob, now: float) -> None:
+        """Suspend-to-host trigger: a queued generation of a strictly
+        higher priority class, unplaceable for preempt_after_ms while the
+        model's workers are saturated, asks ONE worker to suspend its
+        lowest-priority running generation (``job_preempt``). The victim
+        parks its KV in the host tier, requeues at the BACK of its own
+        class with its resume watermark (exactly-once via the drain/
+        resume machinery), and pages back in when pressure clears."""
+        cfg_ms = self.config.preempt_after_ms
+        if cfg_ms <= 0:
+            return
+        req = qj.request
+        if (now - qj.enqueued_at) * 1000 < cfg_ms:
+            return
+        # prune stale asks (victim resolved meanwhile / worker never
+        # answered) so a lost publish cannot wedge preemption forever
+        for jid, t in list(self._preempting.items()):
+            if jid not in self.active_jobs or now - t > 15.0:
+                self._preempting.pop(jid, None)
+        if self._preempting:
+            return  # one suspend-to-host in flight fleet-wide
+        rank = req.priority.rank
+
+        def preemptible(a: JobAssignment) -> bool:
+            if (a.request.model != req.model
+                    or a.request.priority.rank <= rank
+                    or a.request.request_type not in ("inference", "chat",
+                                                      "generate")):
+                return False
+            # a draining worker NACKs/ignores preempt asks (its jobs are
+            # already being suspended out) — asking it would silently
+            # stall the one-in-flight gate until the stale prune
+            w = self.registry.get_worker(a.workerId)
+            return w is not None and w.status in ("online", "busy")
+
+        victims = [a for a in self.active_jobs.values() if preemptible(a)]
+        if not victims:
+            return
+        # lowest priority first; among equals the most recently assigned
+        # (least progress lost to the suspend/resume round trip)
+        victim = max(victims,
+                     key=lambda a: (a.request.priority.rank, a.assignedAt))
+        self._preempting[victim.jobId] = now
+        self._jobs_total.inc(event="preempt_requested")
+        self.flightrec.record("scheduler", "preempt_requested",
+                              job=victim.jobId, worker=victim.workerId,
+                              waiting=req.id)
+        self.tracer.event(victim.jobId, "scheduler.preempt_requested",
+                          waitingJob=req.id, worker=victim.workerId)
+        log.job("preempting lower-priority job for queued work",
+                victim.jobId, worker_id=victim.workerId, waiting=req.id)
+        try:
+            await self.bus.publish(
+                f"worker:{victim.workerId}:job",
+                json.dumps({"type": "job_preempt", "jobId": victim.jobId,
+                            "reason": f"priority:{req.id}"}))
+        except Exception as e:  # noqa: BLE001 — retried next dispatch pass
+            self._preempting.pop(victim.jobId, None)
+            log.warning("preempt publish failed", job_id=victim.jobId,
+                        error=str(e))
+
+    async def _on_preempted(self, _ch: str, raw: str) -> None:
+        """``job:preempted`` from a worker that suspended a generation to
+        the host KV tier. Requeue the victim at the BACK of its own
+        priority class (the waiting higher-priority job must dispatch
+        into the freed slot first) with its resume watermark stamped —
+        when pressure clears it re-dispatches and its warm admission
+        restores the parked pages from host."""
+        try:
+            data = json.loads(raw)
+            job_id = data["jobId"]
+        except Exception:
+            return
+        from_worker = str(data.get("fromWorker") or "")
+        self._preempting.pop(job_id, None)
+        assignment = self.active_jobs.get(job_id)
+        if assignment is None or assignment.workerId != from_worker:
+            return  # resolved/reassigned meanwhile — stale report
+        snap = data.get("snapshot")
+        if isinstance(snap, dict):
+            self._merge_snapshot(job_id, snap)
+        self._migrations.pop(job_id, None)
+        await self._clear_active(job_id, free_worker=True,
+                                 assignment=assignment)
+        if job_id in self._cancelled:
+            self._drop_resume_state(job_id)
+            return
+        request = assignment.request
+        request.metadata.pop("disagg", None)
+        request.metadata.pop("disaggPhase", None)
+        self._stamp_resume(request)
+        self._stream_progress.pop(job_id, None)
+        # already-ran marker: deadline shed exempts it, and the priority
+        # deliberately stays the victim's own — back of ITS class, so the
+        # preemptor (higher class) sorts first regardless of seq
+        request.metadata["preempted"] = True
+        qj = _QueuedJob(request, self._seq)
+        self._seq += 1
+        self.job_queue.append(qj)
+        await self._persist_queued(qj)
+        self._jobs_total.inc(event="preempted")
+        self.flightrec.record("scheduler", "preempted", job=job_id,
+                              fromWorker=from_worker,
+                              parkedTokens=int(data.get("parkedTokens")
+                                               or 0))
+        self._begin_queue_span(request, preempted=True)
+        self.tracer.event(job_id, "scheduler.preempted",
+                          fromWorker=from_worker,
+                          parkedTokens=int(data.get("parkedTokens") or 0))
+        log.job("preempted job requeued with resume snapshot", job_id,
+                from_worker=from_worker)
+        self.request_dispatch()
 
     def _deadline_for(self, request: InferenceRequest) -> int:
         """Effective deadline (ms) for a request's SLO class; the class

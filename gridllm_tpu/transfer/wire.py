@@ -114,6 +114,96 @@ def iter_chunks(header: dict[str, Any], payload: bytes):
         })
 
 
+def build_spill_header(
+    key_hex: str,
+    model: str,
+    k: np.ndarray,
+    v: np.ndarray,
+    *,
+    k_scale: np.ndarray | None = None,
+    v_scale: np.ndarray | None = None,
+    quant: str | None = None,
+    chunk_bytes: int = 256 * 1024,
+) -> tuple[dict[str, Any], bytes]:
+    """(header, payload) for ONE host-tier page spill (ISSUE 11). The
+    spill codec IS the migration wire format — same version, chunk/crc
+    framing, and whole-payload digest — addressed by the prefix cache's
+    CHAIN KEY instead of token ids (at eviction time the allocator knows
+    the key, not the tokens; a later ``match_prefix`` re-derives the same
+    key from the prompt and restores). ``k``/``v``: [L, 1, ps, KVH, D]
+    host arrays sliced to the UNPADDED model head dim. ``quant`` names
+    the scale layout riding in ``k_scale``/``v_scale`` (float32):
+    ``int8-page`` = one scale per (layer, page) — the host-side spill
+    quantization of an fp pool; ``int8-rows`` = per-row scales copied
+    verbatim from a resident int8 pool (GRIDLLM_KV_INT8)."""
+    if k.shape != v.shape:
+        raise ValueError(f"k/v shape mismatch: {k.shape} vs {v.shape}")
+    if k.ndim != 5 or k.shape[1] != 1:
+        raise ValueError(f"expected [L, 1, ps, KVH, D] page, got {k.shape}")
+    if (k_scale is None) != (v_scale is None) or (
+        (quant is None) != (k_scale is None)
+    ):
+        raise ValueError("quant and k_scale/v_scale travel together")
+    payload = payload_bytes(k, v)
+    scale_shape: list[int] = []
+    if k_scale is not None:
+        k_scale = np.ascontiguousarray(k_scale, np.float32)
+        v_scale = np.ascontiguousarray(v_scale, np.float32)
+        if k_scale.shape != v_scale.shape:
+            raise ValueError(
+                f"scale shape mismatch: {k_scale.shape} vs {v_scale.shape}")
+        scale_shape = list(k_scale.shape)
+        payload += k_scale.tobytes() + v_scale.tobytes()
+    n_layers, _, page_size, kv_heads, head_dim = k.shape
+    chunk_bytes = max(int(chunk_bytes), 1)
+    header = {
+        "v": WIRE_VERSION,
+        "kind": "kv-spill",
+        "chainKey": key_hex,
+        "model": model,
+        "dtype": str(k.dtype),
+        "pageSize": page_size,
+        "numLayers": n_layers,
+        "kvHeads": kv_heads,
+        "headDim": head_dim,
+        "numPages": 1,
+        "quant": quant,
+        "scaleShape": scale_shape,
+        "totalBytes": len(payload),
+        "chunkBytes": chunk_bytes,
+        "numChunks": -(-len(payload) // chunk_bytes),
+        "digest": hashlib.blake2b(payload, digest_size=16).hexdigest(),
+    }
+    return header, payload
+
+
+def spill_arrays(
+    header: dict[str, Any], payload: bytes
+) -> tuple[np.ndarray, np.ndarray, np.ndarray | None, np.ndarray | None]:
+    """(k, v, k_scale, v_scale) from a verified spill payload (feed it
+    through :class:`Assembler` first — that is what checks the digest)."""
+    h = header
+    dtype = _np_dtype(h["dtype"])
+    shape = (int(h["numLayers"]), int(h["numPages"]), int(h["pageSize"]),
+             int(h["kvHeads"]), int(h["headDim"]))
+    n = int(np.prod(shape)) * dtype.itemsize
+    scale_shape = tuple(int(s) for s in (h.get("scaleShape") or []))
+    sn = int(np.prod(scale_shape)) * 4 if scale_shape else 0
+    if len(payload) != 2 * n + 2 * sn:
+        raise WireError(
+            f"spill payload {len(payload)} bytes does not match "
+            f"2×{n} + 2×{sn} for shape {shape} {dtype}")
+    k = np.frombuffer(payload[:n], dtype=dtype).reshape(shape)
+    v = np.frombuffer(payload[n:2 * n], dtype=dtype).reshape(shape)
+    k_scale = v_scale = None
+    if sn:
+        k_scale = np.frombuffer(
+            payload[2 * n:2 * n + sn], dtype=np.float32).reshape(scale_shape)
+        v_scale = np.frombuffer(
+            payload[2 * n + sn:], dtype=np.float32).reshape(scale_shape)
+    return k, v, k_scale, v_scale
+
+
 class WireError(RuntimeError):
     """Integrity/shape failure during reassembly — the import is aborted
     and the sender falls back to local serving."""

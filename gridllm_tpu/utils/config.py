@@ -218,6 +218,24 @@ register_env("GRIDLLM_MOE_RAGGED", "auto",
              "MoE grouped-matmul via ragged_dot: auto (TPU only), "
              "1 (force on), 0 (dense fallback).")
 
+# tiered KV cache (ISSUE 11): host-RAM spill + int8 KV pages
+register_env("GRIDLLM_KV_HOST_BYTES", "0",
+             "Host-RAM KV tier capacity in bytes: prefix-cache pages "
+             "evicted from HBM spill here and page back in on "
+             "match_prefix hits; 0 disables the tier.")
+register_env("GRIDLLM_KV_SPILL_INT8", "1",
+             "Quantize fp16/bf16 KV pages to int8 (scale-per-page) on "
+             "host-tier spill, halving spill bytes; 0 spills raw bytes "
+             "(lossless — restored streams byte-identical).")
+register_env("GRIDLLM_KV_INT8", "0",
+             "Resident int8 KV pool (per-row scales, dequant epilogue in "
+             "the attention read path): halves KV HBM at a bounded "
+             "accuracy cost; 1 enables.")
+register_env("GRIDLLM_PREEMPT_AFTER_MS", "0",
+             "Scheduler preemption: a queued higher-priority generation "
+             "unplaceable for this long triggers suspend-to-host of one "
+             "lower-priority running job; 0 disables preemption.")
+
 # prefix caching
 register_env("GRIDLLM_PREFIX_CACHE", "1",
              "Automatic prefix caching of completed requests' KV pages; "
@@ -423,6 +441,14 @@ class SchedulerConfig(BaseModel):
     # dead. Without this, a 10-second broker restart triggers a mass
     # orphan-requeue storm of perfectly healthy jobs.
     bus_rejoin_grace_ms: int = Field(10_000, ge=0)
+    # Preemption-based priority (ISSUE 11): when a queued generation of a
+    # strictly higher priority class has been unplaceable for this long
+    # (ms) and a lower-priority job is running on a worker serving its
+    # model, the scheduler asks that worker to suspend the job to the
+    # host KV tier (``job_preempt``); the victim requeues at the BACK of
+    # its own priority class with its resume watermark and pages back in
+    # from host when pressure clears. 0 (default) disables preemption.
+    preempt_after_ms: int = Field(0, ge=0)
     # capacity NACKs requeue without consuming the retry ladder, but only
     # this many times — a nack storm then falls through to the real ladder
     max_nacks: int = Field(25, ge=0)
@@ -659,6 +685,7 @@ def load_config() -> Config:
                 request_deadline_ms=env_int("GRIDLLM_REQUEST_DEADLINE_MS"),
                 request_deadline_classes=_deadline_classes_from_env(),
                 bus_rejoin_grace_ms=env_int("GRIDLLM_BUS_REJOIN_GRACE_MS"),
+                preempt_after_ms=env_int("GRIDLLM_PREEMPT_AFTER_MS"),
             ),
             gateway=GatewayConfig(
                 host=_env("HOST", "0.0.0.0"),
