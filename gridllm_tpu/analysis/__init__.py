@@ -1,16 +1,20 @@
 """gridllm_tpu.analysis — repo-wide static invariant analyzer + runtime
-lock-discipline sanitizer (ISSUE 8).
+sanitizers (ISSUE 8, extended by ISSUE 13).
 
 Static half: ``python -m gridllm_tpu.analysis`` runs AST-based rules
 (config-discipline, lock-discipline, dashboard-drift, jit-discipline,
-span-pairing, metric-hygiene) over the repo and reports ``file:line``
-findings in human or JSON form; ``--strict`` exits nonzero on any
-finding and gates tier-1 CI.
+span-pairing, metric-hygiene, channel-discipline, async-discipline,
+fault-coverage) over the repo and reports ``file:line`` findings in
+human or JSON form; ``--strict`` exits nonzero on any finding and gates
+tier-1 CI.
 
-Runtime half: ``analysis/lockcheck.py`` (``GRIDLLM_SANITIZE=1``)
-instruments ``threading.Lock``/``RLock`` during tests, builds the
-process lock-order graph, and fails on cycles or unlocked
-``PageAllocator`` mutation.
+Runtime half (both armed by ``GRIDLLM_SANITIZE=1``):
+``analysis/lockcheck.py`` instruments ``threading.Lock``/``RLock``
+during tests, builds the process lock-order graph, and fails on cycles
+or unlocked ``PageAllocator`` mutation; ``analysis/statecheck.py``
+tracks attribute writes on registered hot objects (scheduler job
+tables, registry worker map, allocator state) keyed by thread and held
+locks, and fails on cross-thread mutation with no common lock.
 """
 
 from gridllm_tpu.analysis.core import (  # noqa: F401

@@ -106,6 +106,21 @@ class _Monitor:
                         del other[i]
                         return
 
+    def held_sites(self) -> tuple[str, ...]:
+        """Creation sites of the locks the CALLING thread currently
+        holds, outermost first (the shared-state sanitizer keys write
+        records by these). Lock-free on purpose: this runs on every
+        tracked write, and taking ``_mu`` here would serialize hot-path
+        writes against all proxy bookkeeping. The list is mutated under
+        the GIL (almost always by this thread; a cross-thread release's
+        fallback scan is the rare exception), so ``list()`` snapshots a
+        consistent before-or-after state — at worst one momentarily
+        stale entry, which only widens a lock intersection."""
+        held = getattr(self._tls, "held", None)
+        if not held:
+            return ()
+        return tuple(site for site, _ in list(held))
+
     def snapshot_edges(self) -> dict[tuple[str, str], int]:
         with self._mu:
             return dict(self.edges)
@@ -243,6 +258,12 @@ def uninstall() -> None:
 
 def installed() -> bool:
     return _installed
+
+
+def current_held_sites() -> tuple[str, ...]:
+    """Creation sites of the locks the calling thread holds right now
+    (empty when the proxies are not installed)."""
+    return _MON.held_sites()
 
 
 def reset() -> None:

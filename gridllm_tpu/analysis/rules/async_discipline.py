@@ -1,0 +1,153 @@
+"""async-discipline: no blocking calls inside ``async def`` bodies in the
+control-plane subsystems (ISSUE 13).
+
+One stalled event loop stalls everything that shares it: heartbeats stop
+beating (the registry reads that as worker death), watchdog sweeps slip,
+and stream flushes back up — the exact failure class behind the PR 4
+watchdog-profile caveat. The rule bans, directly inside ``async def``
+bodies under ``gateway/``, ``scheduler/``, ``worker/``, ``bus/``, and
+``transfer/``:
+
+- ``time.sleep`` (use ``asyncio.sleep``)
+- synchronous subprocess calls (``subprocess.run``/``call``/
+  ``check_call``/``check_output``/``Popen`` — use
+  ``asyncio.create_subprocess_*`` or an executor)
+- synchronous HTTP (``requests.*``, ``urllib.request.urlopen``,
+  ``http.client`` connections)
+- synchronous file I/O (``open``, ``Path.read_text``/``write_text``/
+  ``read_bytes``/``write_bytes``)
+- unbounded ``<lock>.acquire()`` on a threading-style lock (no timeout,
+  not awaited — an asyncio lock's awaited acquire is fine)
+
+Routing through an executor is naturally exempt: ``await
+asyncio.to_thread(time.sleep, x)`` passes the function, it does not call
+it. Code nested inside a *sync* ``def``/``lambda`` within an async
+function is exempt too — those closures are typically thread targets or
+executor payloads. A deliberate, justified exception carries an
+``# async-ok`` comment on the offending line.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from gridllm_tpu.analysis.core import Finding, Repo, ancestors, dotted_name, rule
+
+RULE = "async-discipline"
+
+SUBSYSTEMS = (
+    "gridllm_tpu/gateway/",
+    "gridllm_tpu/scheduler/",
+    "gridllm_tpu/worker/",
+    "gridllm_tpu/bus/",
+    "gridllm_tpu/transfer/",
+)
+
+_BLOCKING_CALLS = {
+    "time.sleep": "time.sleep() blocks the event loop — use "
+                  "asyncio.sleep()",
+    "subprocess.run": "synchronous subprocess call blocks the event loop "
+                      "— use asyncio.create_subprocess_exec or an "
+                      "executor",
+    "subprocess.call": "synchronous subprocess call blocks the event loop",
+    "subprocess.check_call": "synchronous subprocess call blocks the "
+                             "event loop",
+    "subprocess.check_output": "synchronous subprocess call blocks the "
+                               "event loop",
+    "subprocess.Popen": "synchronous subprocess spawn blocks the event "
+                        "loop",
+    "urllib.request.urlopen": "synchronous HTTP blocks the event loop — "
+                              "use the bus/worker HTTP helpers or an "
+                              "executor",
+    "http.client.HTTPConnection": "synchronous HTTP blocks the event loop",
+    "http.client.HTTPSConnection": "synchronous HTTP blocks the event "
+                                   "loop",
+}
+
+_PATH_IO_ATTRS = {"read_text", "write_text", "read_bytes", "write_bytes"}
+
+_WAIVER = "# async-ok"
+
+
+def _nearest_function(node: ast.AST) -> ast.AST | None:
+    for anc in ancestors(node):
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.Lambda)):
+            return anc
+    return None
+
+
+def _line_waived(f, lineno: int) -> bool:
+    lines = f.text.splitlines()
+    return 0 < lineno <= len(lines) and _WAIVER in lines[lineno - 1]
+
+
+def _is_lockish(name: str) -> bool:
+    tail = name.split(".")[-1].lower()
+    return "lock" in tail or tail in ("mu", "mutex")
+
+
+@rule(RULE, "no blocking calls (time.sleep, sync HTTP/file I/O, unbounded "
+            "lock.acquire, subprocess) inside async def bodies in "
+            "gateway/scheduler/worker/bus/transfer")
+def check(repo: Repo) -> list[Finding]:
+    findings: list[Finding] = []
+    for f in repo.package_files():
+        if not f.rel.startswith(SUBSYSTEMS):
+            continue
+        for node in f.walk():
+            if not isinstance(node, ast.Call):
+                continue
+            owner = _nearest_function(node)
+            if not isinstance(owner, ast.AsyncFunctionDef):
+                continue  # sync code, or a closure handed to a thread
+            fn = dotted_name(node.func)
+            msg: str | None = None
+            for pat, why in _BLOCKING_CALLS.items():
+                if fn == pat or fn.endswith("." + pat):
+                    msg = why
+                    break
+            if msg is None and fn == "open":
+                msg = ("synchronous open() blocks the event loop — use "
+                       "asyncio.to_thread (or do the I/O off-loop)")
+            if msg is None and fn.startswith("requests."):
+                # module-rooted only: self.requests.append() is a list
+                # named "requests", not the HTTP library
+                msg = "synchronous requests.* HTTP blocks the event loop"
+            if msg is None and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in _PATH_IO_ATTRS:
+                msg = (f".{node.func.attr}() is synchronous file I/O — "
+                       "route it through asyncio.to_thread")
+            if msg is None and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "acquire" \
+                    and _is_lockish(dotted_name(node.func.value)) \
+                    and _acquire_is_unbounded(node) \
+                    and not isinstance(getattr(node, "parent", None),
+                                       ast.Await):
+                msg = ("unbounded lock.acquire() inside an async body can "
+                       "park the whole event loop — pass a timeout, use "
+                       "an asyncio.Lock, or route through an executor")
+            if msg is not None and not _line_waived(f, node.lineno):
+                findings.append(Finding(
+                    RULE, f.rel, node.lineno,
+                    f"{msg} (in async def {owner.name}; waive a "
+                    f"deliberate exception with {_WAIVER!r})"))
+    return findings
+
+
+def _acquire_is_unbounded(node: ast.Call) -> bool:
+    """True when the acquire can park forever: acquire(), acquire(True),
+    acquire(blocking=True). Bounded: a timeout (second positional or
+    keyword) or a non-blocking try (first arg / blocking= is False)."""
+    if len(node.args) >= 2:
+        return False  # acquire(blocking, timeout)
+    for kw in node.keywords:
+        if kw.arg == "timeout":
+            return False
+        if kw.arg == "blocking" and isinstance(kw.value, ast.Constant) \
+                and kw.value.value is False:
+            return False
+    if node.args and isinstance(node.args[0], ast.Constant) \
+            and node.args[0].value is False:
+        return False  # acquire(False): non-blocking try
+    return True

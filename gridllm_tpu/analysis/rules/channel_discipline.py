@@ -1,0 +1,541 @@
+"""channel-discipline: every bus channel goes through the typed channel
+registry in ``bus/base.py`` (ISSUE 13 — the protocol twin of PR 8's
+config-discipline).
+
+Invariants:
+
+1. No raw channel-name string (literal or f-string) as the channel
+   argument of a ``bus.publish``/``subscribe``/``psubscribe`` call site
+   outside ``gridllm_tpu/bus/`` (implementations relay caller-supplied
+   names; tests own their protocol). Call sites use the registered
+   ``CH_*`` constants / ``*_channel`` helpers.
+2. Publish/subscribe direction matches the registry: a module publishing
+   on a family must be a declared publisher, ditto subscribers; every
+   declared publisher/subscriber module actually references the family's
+   constant/helper (a channel published but never subscribed — or vice
+   versa — cannot hide behind the registry).
+3. Publisher-side payload keys agree with the declared payload model
+   both ways: a ``json.dumps({...})`` literal key that is not declared is
+   a finding, and so is a declared key no publisher ever sends (skipped
+   when any publish site for the family is statically unauditable, e.g.
+   a ``**splat``). Model-typed families (``JobResult``/``StreamChunk``/
+   ``WorkerInfo``) check the constructed class where it resolves.
+4. The registry's constants/helpers spell exactly the registered
+   pattern, and ``durable_channel``/``channel_class`` DERIVE from the
+   registry — no hardcoded channel literals inside them, so a channel
+   cannot be durable-in-docs but fire-and-forget-in-code.
+5. The README "Bus channels" table and the registry agree both ways
+   (name, durability, payload), the way config-discipline pins the
+   Configuration table.
+
+Like config-discipline, the registry is parsed from the ANALYZED tree so
+``--root`` on another checkout validates that checkout; fixture repos
+without a bus/base.py registry fall back to the imported registry and
+skip the repo-structure checks (2, 4, 5).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from gridllm_tpu.analysis.core import (
+    Finding,
+    Repo,
+    dotted_name,
+    enclosing_function,
+    rule,
+    str_const,
+)
+
+RULE = "channel-discipline"
+BUS_BASE = "gridllm_tpu/bus/base.py"
+_PUBLISH_ATTRS = {"publish"}
+_SUBSCRIBE_ATTRS = {"subscribe", "psubscribe"}
+
+
+class _Spec:
+    __slots__ = ("family", "pattern", "payload", "keys", "durable",
+                 "publishers", "subscribers", "helper", "line")
+
+    def __init__(self, family, pattern, payload, keys, durable,
+                 publishers, subscribers, helper, line):
+        self.family = family
+        self.pattern = pattern
+        self.payload = payload
+        self.keys = keys
+        self.durable = durable
+        self.publishers = publishers
+        self.subscribers = subscribers
+        self.helper = helper
+        self.line = line
+
+
+def _tuple_const(node: ast.AST | None) -> tuple[str, ...] | None:
+    if isinstance(node, (ast.Tuple, ast.List)):
+        vals = [str_const(e) for e in node.elts]
+        if all(v is not None for v in vals):
+            return tuple(vals)  # type: ignore[arg-type]
+    return None
+
+
+def _parse_registry(repo: Repo) -> tuple[dict[str, _Spec], bool]:
+    """(family -> spec, from_tree). Parsed from the analyzed tree's
+    bus/base.py; falls back to the imported registry for fixture repos,
+    which then skip the repo-structure checks."""
+    f = repo.file(BUS_BASE)
+    specs: dict[str, _Spec] = {}
+    if f is not None:
+        for node in f.walk():
+            if not (isinstance(node, ast.Call)
+                    and dotted_name(node.func).endswith("register_channel")
+                    and node.args):
+                continue
+            family = str_const(node.args[0])
+            if family is None:
+                continue
+            kw = {k.arg: k.value for k in node.keywords if k.arg}
+            specs[family] = _Spec(
+                family,
+                str_const(kw.get("pattern")) or family,
+                str_const(kw.get("payload")) or "keys",
+                _tuple_const(kw.get("keys")) or (),
+                isinstance(kw.get("durable"), ast.Constant)
+                and bool(kw["durable"].value),  # type: ignore[union-attr]
+                _tuple_const(kw.get("publishers")) or (),
+                _tuple_const(kw.get("subscribers")) or (),
+                str_const(kw.get("helper")) or "",
+                node.lineno,
+            )
+    if specs:
+        return specs, True
+    from gridllm_tpu.bus.base import CHANNELS
+
+    return {s.family: _Spec(s.family, s.pattern, s.payload, s.keys,
+                            s.durable, s.publishers, s.subscribers,
+                            s.helper, 0)
+            for s in CHANNELS.values()}, False
+
+
+def _normalize(pattern: str) -> str:
+    return re.sub(r"\{[^{}]*\}", "{}", pattern)
+
+
+def _fstring_pattern(node: ast.AST,
+                     consts: dict[str, str] | None = None) -> str | None:
+    """Normalized pattern a return expression spells: a string constant,
+    or an f-string whose placeholders become ``{}`` — except names bound
+    to module-level string constants (``consts``), which substitute
+    their value so single-source prefixes like ``TRACE_CHANNEL_PREFIX``
+    stay auditable. None when anything is not statically known."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.JoinedStr):
+        out = []
+        for part in node.values:
+            if isinstance(part, ast.Constant) and isinstance(part.value, str):
+                out.append(part.value)
+            elif isinstance(part, ast.FormattedValue) \
+                    and isinstance(part.value, ast.Name):
+                bound = (consts or {}).get(part.value.id)
+                out.append(bound if bound is not None else "{}")
+            else:
+                return None
+        return "".join(out)
+    return None
+
+
+def _module_str_consts(f) -> dict[str, str]:
+    """Module-level ``NAME = "literal"`` bindings (top-level statements
+    only — f-string prefix constants are module-level by convention)."""
+    out: dict[str, str] = {}
+    tree = f.tree
+    if tree is None:
+        return out
+    for node in tree.body:
+        if isinstance(node, ast.Assign):
+            val = str_const(node.value)
+            if val is not None:
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        out[tgt.id] = val
+    return out
+
+
+def _collect_symbols(repo: Repo) -> dict[str, tuple[dict[str, int], str]]:
+    """rel -> ({referenced name: first line}, source text) for quick
+    "does this module reference the helper" checks. Names include bare
+    Name loads, attribute tails, and imported names."""
+    out: dict[str, tuple[dict[str, int], str]] = {}
+    for f in repo.files:
+        names: dict[str, int] = {}
+        for node in f.walk():
+            if isinstance(node, ast.Name):
+                names.setdefault(node.id, node.lineno)
+            elif isinstance(node, ast.Attribute):
+                names.setdefault(node.attr, node.lineno)
+            elif isinstance(node, ast.ImportFrom):
+                for alias in node.names:
+                    names.setdefault(alias.name, node.lineno)
+        out[f.rel] = (names, f.text)
+    return out
+
+
+def _resolve_model_class(call: ast.Call) -> str | None:
+    """Class name behind ``X.model_dump_json()``: the enclosing function's
+    ``X = SomeModel(...)`` / ``X = SomeModel.model_validate*(...)``
+    assignment, best-effort (None when unresolvable)."""
+    if not (isinstance(call.func, ast.Attribute)
+            and call.func.attr == "model_dump_json"
+            and isinstance(call.func.value, ast.Name)):
+        return None
+    var = call.func.value.id
+    fn = enclosing_function(call)
+    if fn is None:
+        return None
+    best: str | None = None
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign) and node.lineno < call.lineno \
+                and isinstance(node.value, ast.Call):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name) and tgt.id == var:
+                    first = dotted_name(node.value.func).split(".")[0]
+                    if first[:1].isupper():
+                        best = first
+    return best
+
+
+def _payload_of(call: ast.Call) -> ast.AST | None:
+    if len(call.args) > 1:
+        return call.args[1]
+    for kw in call.keywords:
+        if kw.arg == "message":
+            return kw.value
+    return None
+
+
+def _dict_literal_keys(node: ast.AST) -> tuple[list[str], bool] | None:
+    """(keys, has_splat) for a ``json.dumps({...})`` payload; None when
+    the payload is not a statically visible dict literal."""
+    if not (isinstance(node, ast.Call)
+            and dotted_name(node.func).endswith("json.dumps")
+            and node.args and isinstance(node.args[0], ast.Dict)):
+        return None
+    d = node.args[0]
+    keys: list[str] = []
+    splat = False
+    for k in d.keys:
+        if k is None:
+            splat = True  # {**payload} — unauditable extras
+        else:
+            kv = str_const(k)
+            if kv is None:
+                splat = True
+            else:
+                keys.append(kv)
+    return keys, splat
+
+
+@rule(RULE, "bus channels go through the typed registry in bus/base.py; "
+            "payload keys, durability, direction, and the README Bus "
+            "channels table must all agree with it")
+def check(repo: Repo) -> list[Finding]:
+    findings: list[Finding] = []
+    specs, from_tree = _parse_registry(repo)
+    by_helper = {s.helper: s for s in specs.values() if s.helper}
+
+    bus_base = repo.file(BUS_BASE)
+    constants: dict[str, str] = {}
+    helper_fns: dict[str, tuple[str, str, int]] = {}  # name -> (pat, rel, ln)
+    for f in repo.package_files():
+        mod_consts = _module_str_consts(f)
+        for node in f.walk():
+            if isinstance(node, ast.Assign) and f.rel == BUS_BASE:
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name) \
+                            and re.fullmatch(r"CH_[A-Z0-9_]+", tgt.id):
+                        val = str_const(node.value)
+                        if val is not None:
+                            constants[tgt.id] = val
+            if isinstance(node, ast.FunctionDef) and (
+                    node.name.endswith("_channel")
+                    or node.name in by_helper):
+                for stmt in node.body:
+                    if isinstance(stmt, ast.Return) and stmt.value is not None:
+                        pat = _fstring_pattern(stmt.value, mod_consts)
+                        if pat is not None:
+                            helper_fns[node.name] = (pat, f.rel, node.lineno)
+
+    # -- 4. registry constants/helpers spell the registered pattern;
+    #       durable_channel/channel_class derive from the registry
+    if from_tree:
+        for s in specs.values():
+            if not s.helper:
+                findings.append(Finding(
+                    RULE, BUS_BASE, s.line,
+                    f"channel family {s.family!r} declares no helper — "
+                    "call sites have no sanctioned spelling"))
+            elif s.helper.isupper() or s.helper.startswith("CH_"):
+                lit = constants.get(s.helper)
+                if lit is None:
+                    findings.append(Finding(
+                        RULE, BUS_BASE, s.line,
+                        f"channel family {s.family!r}: constant "
+                        f"{s.helper} is not defined in bus/base.py"))
+                elif lit != s.pattern:
+                    findings.append(Finding(
+                        RULE, BUS_BASE, s.line,
+                        f"constant {s.helper} = {lit!r} disagrees with "
+                        f"the registered pattern {s.pattern!r}"))
+            else:
+                got = helper_fns.get(s.helper)
+                if got is None:
+                    findings.append(Finding(
+                        RULE, BUS_BASE, s.line,
+                        f"channel family {s.family!r}: helper "
+                        f"{s.helper}() not found (or its return is not a "
+                        "static f-string)"))
+                elif _normalize(got[0]) != _normalize(s.pattern):
+                    findings.append(Finding(
+                        RULE, got[1], got[2],
+                        f"{s.helper}() builds {got[0]!r} but the "
+                        f"registered pattern is {s.pattern!r}"))
+        if bus_base is not None:
+            for node in bus_base.walk():
+                if isinstance(node, ast.FunctionDef) \
+                        and node.name in ("durable_channel", "channel_class"):
+                    for sub in ast.walk(node):
+                        val = str_const(sub)
+                        # channel-ish literal: colon-joined tokens, no
+                        # prose (docstrings have spaces)
+                        if val is not None and ":" in val \
+                                and " " not in val:
+                            findings.append(Finding(
+                                RULE, BUS_BASE, sub.lineno,
+                                f"{node.name}() hardcodes channel name "
+                                f"{val!r} — durability/classification "
+                                "must derive from the CHANNELS registry"))
+
+    # -- 1-3. call-site discipline + payload keys
+    published_keys: dict[str, set[str]] = {}
+    open_payload: set[str] = set()
+    for f in repo.files:
+        if f.rel.startswith(("tests/", "gridllm_tpu/bus/")):
+            continue
+        for node in f.walk():
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)):
+                continue
+            attr = node.func.attr
+            if attr not in _PUBLISH_ATTRS | _SUBSCRIBE_ATTRS:
+                continue
+            recv = dotted_name(node.func.value)
+            if "bus" not in recv.lower().split(".")[-1]:
+                continue
+            ch = node.args[0] if node.args else None
+            if ch is None:
+                continue
+            lit = str_const(ch)
+            if lit is not None:
+                findings.append(Finding(
+                    RULE, f.rel, node.lineno,
+                    f"raw channel literal {lit!r} at bus.{attr}() — use "
+                    "the registered constant/helper from bus/base.py"))
+                continue
+            if isinstance(ch, ast.JoinedStr):
+                findings.append(Finding(
+                    RULE, f.rel, node.lineno,
+                    f"f-string channel name at bus.{attr}() — use the "
+                    "registered helper from bus/base.py"))
+                continue
+            # resolve the family behind a constant / helper call
+            spec: _Spec | None = None
+            if isinstance(ch, ast.Call):
+                fn_name = dotted_name(ch.func).split(".")[-1]
+                spec = by_helper.get(fn_name)
+            else:
+                sym = dotted_name(ch).split(".")[-1]
+                spec = by_helper.get(sym)
+            if spec is None:
+                continue  # opaque variable — built by a helper upstream
+            if from_tree:
+                if attr in _PUBLISH_ATTRS and f.rel not in spec.publishers:
+                    findings.append(Finding(
+                        RULE, f.rel, node.lineno,
+                        f"{f.rel} publishes on {spec.family!r} but is not "
+                        "a declared publisher in the channel registry"))
+                if attr in _SUBSCRIBE_ATTRS \
+                        and f.rel not in spec.subscribers:
+                    findings.append(Finding(
+                        RULE, f.rel, node.lineno,
+                        f"{f.rel} subscribes to {spec.family!r} but is "
+                        "not a declared subscriber in the channel "
+                        "registry"))
+            if attr not in _PUBLISH_ATTRS or spec.payload == "opaque":
+                continue
+            payload = _payload_of(node)
+            if payload is None:
+                open_payload.add(spec.family)
+                continue
+            dict_keys = _dict_literal_keys(payload)
+            model = (_resolve_model_class(payload)
+                     if isinstance(payload, ast.Call) else None)
+            if spec.payload not in ("keys",):
+                # model-typed family
+                if dict_keys is not None:
+                    findings.append(Finding(
+                        RULE, f.rel, node.lineno,
+                        f"{spec.family!r} payload is declared as "
+                        f"{spec.payload} but this publish sends a "
+                        "json.dumps dict"))
+                elif model is not None and model != spec.payload:
+                    findings.append(Finding(
+                        RULE, f.rel, node.lineno,
+                        f"{spec.family!r} payload is declared as "
+                        f"{spec.payload} but this publish sends "
+                        f"{model}"))
+                continue
+            if dict_keys is None:
+                if model is not None:
+                    findings.append(Finding(
+                        RULE, f.rel, node.lineno,
+                        f"{spec.family!r} payload is key-declared "
+                        f"({', '.join(spec.keys)}) but this publish "
+                        f"sends model {model}"))
+                else:
+                    open_payload.add(spec.family)
+                continue
+            keys, splat = dict_keys
+            if splat:
+                open_payload.add(spec.family)
+            for k in keys:
+                if k not in spec.keys:
+                    findings.append(Finding(
+                        RULE, f.rel, node.lineno,
+                        f"payload key {k!r} published on {spec.family!r} "
+                        "is not declared in the channel registry"))
+            published_keys.setdefault(spec.family, set()).update(keys)
+
+    for family, sent in sorted(published_keys.items()):
+        spec = specs[family]
+        if family in open_payload:
+            continue  # an unauditable site may send the rest
+        for k in spec.keys:
+            if k not in sent:
+                findings.append(Finding(
+                    RULE, BUS_BASE, spec.line,
+                    f"channel {family!r} declares payload key {k!r} "
+                    "that no publisher ever sends"))
+
+    # -- 2. every declared publisher/subscriber module references the
+    #       family's helper (both ways: no ghost channels)
+    if from_tree:
+        symbols = _collect_symbols(repo)
+        for s in specs.values():
+            for role, mods in (("publisher", s.publishers),
+                               ("subscriber", s.subscribers)):
+                if not mods:
+                    findings.append(Finding(
+                        RULE, BUS_BASE, s.line,
+                        f"channel {s.family!r} declares no {role}s — a "
+                        "channel nobody speaks on (or listens to) is "
+                        "protocol drift"))
+                for mod in mods:
+                    entry = symbols.get(mod)
+                    if entry is None:
+                        findings.append(Finding(
+                            RULE, BUS_BASE, s.line,
+                            f"channel {s.family!r} declares {role} "
+                            f"{mod}, which does not exist"))
+                    elif s.helper and not any(
+                            sym in entry[0] for sym in
+                            # a psubscribe side may use the helper's
+                            # *_pattern twin (e.g. trace_pattern for
+                            # trace_channel) — same family, same module
+                            {s.helper,
+                             s.helper.replace("_channel", "_pattern")}):
+                        findings.append(Finding(
+                            RULE, BUS_BASE, s.line,
+                            f"channel {s.family!r}: declared {role} "
+                            f"{mod} never references {s.helper} — dead "
+                            f"{role} declaration or missed migration"))
+
+    # -- 5. README "Bus channels" table <-> registry, both ways
+    if from_tree:
+        findings.extend(_check_readme(repo, specs))
+    return findings
+
+
+def _who_cell(s: _Spec) -> str:
+    """The expected "Publishers → subscribers" README cell: module
+    basenames, .py stripped, in declaration order."""
+    def short(mods: tuple[str, ...]) -> str:
+        return ", ".join(m.rsplit("/", 1)[-1].removesuffix(".py")
+                         for m in mods)
+
+    return f"{short(s.publishers)} → {short(s.subscribers)}"
+
+
+def _check_readme(repo: Repo, specs: dict[str, _Spec]) -> list[Finding]:
+    findings: list[Finding] = []
+    readme = repo.read_text("README.md")
+    if readme is None:
+        return [Finding(RULE, "README.md", 0, "README.md missing")]
+    in_section = False
+    # pattern -> (durable, payload, who, line)
+    rows: dict[str, tuple[str, str, str, int]] = {}
+    for i, line in enumerate(readme.splitlines(), 1):
+        if line.startswith("#"):
+            in_section = (line.lstrip("#").strip().lower() == "bus channels")
+            continue
+        if not in_section or not line.lstrip().startswith("|"):
+            continue
+        cells = [c.strip() for c in line.strip().strip("|").split("|")]
+        if len(cells) < 3:
+            continue
+        m = re.fullmatch(r"`([^`]+)`", cells[0])
+        if m is None or m.group(1) in ("Channel",):
+            continue
+        payload_cell = cells[2].strip("`")
+        who_cell = cells[3] if len(cells) > 3 else ""
+        rows.setdefault(m.group(1),
+                        (cells[1].lower(), payload_cell, who_cell, i))
+    if not rows:
+        return [Finding(
+            RULE, "README.md", 0,
+            "README has no \"Bus channels\" table documenting the "
+            "channel registry")]
+    by_pattern = {s.pattern: s for s in specs.values()}
+    for pattern, (durable_cell, payload_cell, who_cell, line) \
+            in sorted(rows.items()):
+        s = by_pattern.get(pattern)
+        if s is None:
+            findings.append(Finding(
+                RULE, "README.md", line,
+                f"README documents channel {pattern!r}, which is not in "
+                "the bus/base.py channel registry"))
+            continue
+        want = "yes" if s.durable else "no"
+        if durable_cell != want:
+            findings.append(Finding(
+                RULE, "README.md", line,
+                f"README says channel {pattern!r} durability is "
+                f"{durable_cell!r} but the registry says {want!r}"))
+        if payload_cell != s.payload:
+            findings.append(Finding(
+                RULE, "README.md", line,
+                f"README says channel {pattern!r} payload is "
+                f"{payload_cell!r} but the registry says {s.payload!r}"))
+        want_who = _who_cell(s)
+        if who_cell and who_cell != want_who:
+            findings.append(Finding(
+                RULE, "README.md", line,
+                f"README says channel {pattern!r} direction is "
+                f"{who_cell!r} but the registry says {want_who!r}"))
+    for s in specs.values():
+        if s.pattern not in rows:
+            findings.append(Finding(
+                RULE, "README.md", 0,
+                f"registered channel {s.pattern!r} missing from the "
+                "README Bus channels table"))
+    return findings

@@ -1,0 +1,207 @@
+"""fault-coverage: the fault-injection registry and its call sites agree
+both ways (ISSUE 13).
+
+``faults.py``'s ``SITES`` tuple is the chaos surface the fault-tolerance
+machinery claims to cover. A site registered there but wired nowhere
+means a chaos spec can name it, parse cleanly, and silently inject
+NOTHING — the test goes green having tested nothing. A call site using
+an unregistered name raises at runtime only when that path executes.
+Both are drift this rule catches statically:
+
+1. Every site in ``SITES`` has at least one live ``faults.inject(...)``
+   / ``faults.check(...)`` call site in package code.
+2. Every literal site name at an inject/check call site is registered
+   (and is a literal — a computed site name defeats static audit).
+3. Each critical subsystem carries at least one live site: ``bus/``,
+   ``transfer/``, and ``worker/`` by directory, the KV host tier by its
+   ``kvtier.*`` site names (its injection points guard engine-side tier
+   operations). Chaos specs for those subsystems can therefore never
+   inject nothing.
+4. The README fault-site table and ``SITES`` agree both ways (the
+   config-discipline treatment, applied to the chaos surface).
+
+Fixture repos without a ``gridllm_tpu/faults.py`` skip everything except
+the literal-site check against the imported registry.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from gridllm_tpu.analysis.core import Finding, Repo, dotted_name, rule, str_const
+
+RULE = "fault-coverage"
+FAULTS_MODULE = "gridllm_tpu/faults.py"
+_SITE_ROW = re.compile(r"^`([a-z_]+\.[a-z_]+)`$")
+
+# critical subsystems: directory prefixes that must carry ≥ 1 live site,
+# plus site-name prefixes whose wiring may live outside their home dir
+CRITICAL_DIRS = {
+    "bus": "gridllm_tpu/bus/",
+    "transfer": "gridllm_tpu/transfer/",
+    "worker": "gridllm_tpu/worker/",
+}
+CRITICAL_SITE_PREFIXES = {
+    "kvtier": "kvtier.",
+}
+
+
+def _parse_sites(repo: Repo) -> dict[str, int] | None:
+    """site -> lineno from the analyzed tree's faults.py SITES tuple;
+    None when the module is absent (fixture repos)."""
+    f = repo.file(FAULTS_MODULE)
+    if f is None:
+        return None
+    for node in f.walk():
+        if isinstance(node, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == "SITES"
+                for t in node.targets) \
+                and isinstance(node.value, (ast.Tuple, ast.List)):
+            out: dict[str, int] = {}
+            for elt in node.value.elts:
+                val = str_const(elt)
+                if val is not None:
+                    out[val] = elt.lineno
+            return out
+    return {}
+
+
+def _call_sites(repo: Repo) -> list[tuple[str, int, str | None]]:
+    """(file, line, literal-site-or-None) for every faults.inject/check
+    call outside faults.py itself and tests."""
+    out: list[tuple[str, int, str | None]] = []
+    for f in repo.package_files():
+        if f.rel == FAULTS_MODULE:
+            continue
+        imported_bare: set[str] = set()
+        for node in f.walk():
+            if isinstance(node, ast.ImportFrom) \
+                    and (node.module or "").endswith("faults"):
+                imported_bare.update(
+                    a.asname or a.name for a in node.names
+                    if a.name in ("inject", "check"))
+        for node in f.walk():
+            if not isinstance(node, ast.Call):
+                continue
+            fn = dotted_name(node.func)
+            is_site_call = (
+                fn in ("faults.inject", "faults.check")
+                or fn.endswith(".faults.inject")
+                or fn.endswith(".faults.check")
+                or (isinstance(node.func, ast.Name)
+                    and node.func.id in imported_bare))
+            if not is_site_call:
+                continue
+            out.append((f.rel, node.lineno,
+                        str_const(node.args[0]) if node.args else None))
+    return out
+
+
+@rule(RULE, "every registered fault site is wired to a live inject/check "
+            "call site and vice versa; bus/transfer/worker/kvtier each "
+            "carry at least one; README fault table matches SITES")
+def check(repo: Repo) -> list[Finding]:
+    findings: list[Finding] = []
+    sites = _parse_sites(repo)
+    calls = _call_sites(repo)
+    if sites is None:
+        # fixture fallback: literal check against the imported registry
+        from gridllm_tpu.faults import SITES
+
+        for rel, line, lit in calls:
+            if lit is not None and lit not in SITES:
+                findings.append(Finding(
+                    RULE, rel, line,
+                    f"fault site {lit!r} is not registered in "
+                    "faults.py SITES"))
+        return findings
+
+    live: dict[str, list[tuple[str, int]]] = {}
+    for rel, line, lit in calls:
+        if lit is None:
+            findings.append(Finding(
+                RULE, rel, line,
+                "faults.inject/check needs a literal site name for "
+                "static coverage auditing"))
+            continue
+        if lit not in sites:
+            findings.append(Finding(
+                RULE, rel, line,
+                f"fault site {lit!r} is not registered in faults.py "
+                "SITES — a typo here would fail loudly only when this "
+                "path runs"))
+            continue
+        live.setdefault(lit, []).append((rel, line))
+
+    for site, line in sorted(sites.items()):
+        if site not in live:
+            findings.append(Finding(
+                RULE, FAULTS_MODULE, line,
+                f"fault site {site!r} is registered but has no live "
+                "inject()/check() call site — a chaos spec naming it "
+                "injects nothing"))
+
+    for name, prefix in sorted(CRITICAL_DIRS.items()):
+        if not any(f.rel.startswith(prefix) for f in repo.files):
+            continue  # subsystem absent (fixture repo)
+        if not any(rel.startswith(prefix)
+                   for uses in live.values() for rel, _ in uses):
+            findings.append(Finding(
+                RULE, FAULTS_MODULE, 0,
+                f"critical subsystem {name!r} ({prefix}) carries no live "
+                "fault site — its failure paths are untestable by "
+                "GRIDLLM_FAULT_SPEC"))
+    for name, site_prefix in sorted(CRITICAL_SITE_PREFIXES.items()):
+        named = [s for s in sites if s.startswith(site_prefix)]
+        if named and not any(s in live for s in named):
+            findings.append(Finding(
+                RULE, FAULTS_MODULE, 0,
+                f"critical subsystem {name!r} registers sites "
+                f"({', '.join(named)}) but none is wired to a live call "
+                "site"))
+
+    findings.extend(_check_readme(repo, sites))
+    return findings
+
+
+def _check_readme(repo: Repo, sites: dict[str, int]) -> list[Finding]:
+    findings: list[Finding] = []
+    readme = repo.read_text("README.md")
+    if readme is None:
+        return [Finding(RULE, "README.md", 0, "README.md missing")]
+    documented: dict[str, int] = {}
+    in_fault_section = False
+    for i, line in enumerate(readme.splitlines(), 1):
+        if line.startswith("#"):
+            # anchor on the fault section the way channel-discipline
+            # anchors on "Bus channels": a backticked dotted name in some
+            # unrelated table must not read as a documented fault site
+            in_fault_section = "fault" in line.lstrip("#").strip().lower()
+            continue
+        if not in_fault_section or not line.lstrip().startswith("|"):
+            continue
+        cells = [c.strip() for c in line.strip().strip("|").split("|")]
+        if not cells:
+            continue
+        m = _SITE_ROW.fullmatch(cells[0])
+        if m is not None:
+            documented.setdefault(m.group(1), i)
+    if not documented:
+        return [Finding(
+            RULE, "README.md", 0,
+            "README has no fault-site table (| `site.name` | effect |) "
+            "documenting faults.py SITES")]
+    for site, line in sorted(documented.items()):
+        if site not in sites:
+            findings.append(Finding(
+                RULE, "README.md", line,
+                f"README documents fault site {site!r}, which is not "
+                "registered in faults.py SITES"))
+    for site in sorted(sites):
+        if site not in documented:
+            findings.append(Finding(
+                RULE, "README.md", 0,
+                f"registered fault site {site!r} missing from the README "
+                "fault-site table"))
+    return findings

@@ -1,0 +1,311 @@
+"""Runtime shared-state sanitizer (``GRIDLLM_SANITIZE=1``, ISSUE 13).
+
+The lock-order checker (lockcheck.py) proves that the locks the code
+DOES take compose without deadlock; it cannot see a mutation that takes
+no lock at all. This module covers that gap for a registered set of hot
+objects — the scheduler's job tables, the registry's worker map, the
+engine's allocator state: every attribute write (and in-place mutation
+of dict/list-valued attributes) is recorded keyed by the writing thread
+and the lock creation-sites that thread held (from lockcheck's proxy
+stacks). An attribute written from two or more threads with NO lock
+site common to all of its writes is a cross-thread unguarded mutation —
+exactly the class of race the lock-order graph can't flag, reported
+with the first write site per thread so the fix is a grep, not a
+bisect.
+
+Mechanics: :func:`track_object` patches the object's CLASS
+``__setattr__`` once (a dict lookup per write for untracked instances)
+and swaps tracked plain-``dict``/``list`` attribute values for
+recording subclasses, re-wrapping on rebind. Registration itself
+records nothing — object construction is single-threaded by
+happens-before (``Thread.start``), and counting it would poison the
+intersection with the init thread's (lockless) writes.
+
+Dormant unless ``GRIDLLM_SANITIZE`` is truthy: ``track_object`` is a
+no-op, nothing is patched, zero hot-path cost. ``tests/conftest.py``
+fails the session (exit 3) on violations, alongside lockcheck's cycle
+check. Single-threaded writers never violate, whatever locks they hold
+— an asyncio-only subsystem is clean by construction.
+
+Known limits (best-effort, like every sanitizer here): mutations
+through an alias taken before tracking, non-dict/list containers
+(OrderedDict, set), and reads are not tracked.
+"""
+
+from __future__ import annotations
+
+import threading
+import traceback
+import weakref
+from typing import Any, Iterable
+
+from gridllm_tpu.analysis import lockcheck
+from gridllm_tpu.utils.config import env_bool
+
+# the monitor's own lock must be a REAL lock: a proxied one would record
+# itself into the very held-stacks it is reading
+_mu = lockcheck._REAL_LOCK()
+
+
+class SharedStateError(AssertionError):
+    """A registered hot object was mutated cross-thread without any
+    common lock."""
+
+
+def enabled() -> bool:
+    return env_bool("GRIDLLM_SANITIZE")
+
+
+class _Entry:
+    __slots__ = ("threads", "common", "writes")
+
+    def __init__(self, tid: int, site: str, held: frozenset[str]):
+        self.threads: dict[int, str] = {tid: site}  # tid -> first write site
+        self.common: frozenset[str] = held          # ∩ held-locks over writes
+        self.writes = 1
+
+
+# (object name, attr) -> _Entry
+_entries: dict[tuple[str, str], _Entry] = {}
+# id(obj) -> (name, tracked attrs or None for all)
+_tracked: dict[int, tuple[str, frozenset[str] | None]] = {}
+# id(obj) -> weakref keeping the cleanup callback alive
+_reapers: dict[int, Any] = {}
+_patched: set[type] = set()
+
+
+def _caller_site() -> str:
+    for frame in reversed(traceback.extract_stack()):
+        if frame.filename != __file__:
+            return f"{frame.filename}:{frame.lineno}"
+    return "<unknown>"
+
+
+def _record(name: str, attr: str) -> None:
+    held = frozenset(lockcheck.current_held_sites())
+    tid = threading.get_ident()
+    key = (name, attr)
+    with _mu:
+        e = _entries.get(key)
+        if e is None:
+            _entries[key] = _Entry(tid, _caller_site(), held)
+            return
+        e.writes += 1
+        e.common = e.common & held
+        if tid not in e.threads:
+            e.threads[tid] = _caller_site()
+
+
+class _TrackedDict(dict):
+    """dict that reports in-place mutation to the monitor."""
+
+    _ss_name = "?"
+    _ss_attr = "?"
+
+    def _note(self) -> None:
+        _record(self._ss_name, self._ss_attr)
+
+    def __setitem__(self, k, v):
+        self._note()
+        dict.__setitem__(self, k, v)
+
+    def __delitem__(self, k):
+        self._note()
+        dict.__delitem__(self, k)
+
+    def pop(self, *a, **kw):
+        self._note()
+        return dict.pop(self, *a, **kw)
+
+    def popitem(self):
+        self._note()
+        return dict.popitem(self)
+
+    def clear(self):
+        self._note()
+        dict.clear(self)
+
+    def update(self, *a, **kw):
+        self._note()
+        dict.update(self, *a, **kw)
+
+    def setdefault(self, *a, **kw):
+        self._note()
+        return dict.setdefault(self, *a, **kw)
+
+
+class _TrackedList(list):
+    """list that reports in-place mutation to the monitor."""
+
+    _ss_name = "?"
+    _ss_attr = "?"
+
+    def _note(self) -> None:
+        _record(self._ss_name, self._ss_attr)
+
+    def append(self, v):
+        self._note()
+        list.append(self, v)
+
+    def extend(self, it):
+        self._note()
+        list.extend(self, it)
+
+    def insert(self, i, v):
+        self._note()
+        list.insert(self, i, v)
+
+    def pop(self, *a):
+        self._note()
+        return list.pop(self, *a)
+
+    def remove(self, v):
+        self._note()
+        list.remove(self, v)
+
+    def clear(self):
+        self._note()
+        list.clear(self)
+
+    def sort(self, *a, **kw):
+        self._note()
+        list.sort(self, *a, **kw)
+
+    def reverse(self):
+        self._note()
+        list.reverse(self)
+
+    def __setitem__(self, i, v):
+        self._note()
+        list.__setitem__(self, i, v)
+
+    def __delitem__(self, i):
+        self._note()
+        list.__delitem__(self, i)
+
+    def __iadd__(self, it):
+        self._note()
+        list.extend(self, it)
+        return self
+
+
+def _wrap_container(name: str, attr: str, val: Any) -> Any:
+    """Recording twin for a plain dict/list value; anything else passes
+    through (attr rebinds are still tracked by the class patch)."""
+    if type(val) is dict:
+        w: Any = _TrackedDict(val)
+    elif type(val) is list:
+        w = _TrackedList(val)
+    else:
+        return val
+    w._ss_name = name
+    w._ss_attr = attr
+    return w
+
+
+def track_object(obj: Any, name: str,
+                 attrs: Iterable[str] | None = None) -> Any:
+    """Register ``obj`` for cross-thread write tracking under ``name``.
+    ``attrs`` limits tracking to those attribute names (None = all).
+    No-op (returns ``obj`` untouched) unless GRIDLLM_SANITIZE is on."""
+    if not enabled():
+        return obj
+    cls = type(obj)
+    if cls not in _patched:
+        orig = cls.__setattr__
+
+        def traced_setattr(self: Any, attr: str, value: Any,
+                           _orig: Any = orig) -> None:
+            ent = _tracked.get(id(self))
+            if ent is not None:
+                nm, only = ent
+                if only is None or attr in only:
+                    _record(nm, attr)
+                    value = _wrap_container(nm, attr, value)
+            _orig(self, attr, value)
+
+        cls.__setattr__ = traced_setattr  # type: ignore[method-assign]
+        _patched.add(cls)
+    attr_set = frozenset(attrs) if attrs is not None else None
+    oid = id(obj)
+    _tracked[oid] = (name, attr_set)
+    # wrap every tracked dict/list value that already exists — with
+    # attrs=None ("all") that is everything currently on the instance
+    wrap_attrs = (attr_set if attr_set is not None
+                  else tuple(vars(obj)) if hasattr(obj, "__dict__") else ())
+    for attr in wrap_attrs:
+        cur = getattr(obj, attr, None)
+        wrapped = _wrap_container(name, attr, cur)
+        if wrapped is not cur:
+            # direct install — wrapping is not a mutation and must not
+            # seed the entry with the registering thread's lock set
+            object.__setattr__(obj, attr, wrapped)
+    try:
+        # drop the registration when the object dies, so a recycled id()
+        # cannot alias a new object onto stale tracking
+        _reapers[oid] = weakref.ref(
+            obj, lambda _r, oid=oid: (_tracked.pop(oid, None),
+                                      _reapers.pop(oid, None)))
+    except TypeError:
+        pass  # not weakref-able: tracked for the process lifetime
+    return obj
+
+
+def violations() -> list[dict[str, Any]]:
+    """Attributes written from ≥ 2 threads with no common lock across
+    all of their writes — each with the first write site per thread."""
+    with _mu:
+        return [{
+            "object": name,
+            "attr": attr,
+            "threads": len(e.threads),
+            "writes": e.writes,
+            "sites": sorted(e.threads.values()),
+        } for (name, attr), e in sorted(_entries.items())
+            if len(e.threads) > 1 and not e.common]
+
+
+def report() -> dict[str, Any]:
+    v = violations()
+    with _mu:
+        tracked = len(_tracked)
+        observed = len(_entries)
+    return {"tracked_objects": tracked, "observed_attrs": observed,
+            "violations": v, "ok": not v}
+
+
+def assert_clean() -> None:
+    v = violations()
+    if v:
+        lines = [
+            f"{x['object']}.{x['attr']}: {x['threads']} threads, "
+            f"{x['writes']} writes, no common lock — first writes at "
+            + "; ".join(x["sites"]) for x in v]
+        raise SharedStateError(
+            "cross-thread unguarded mutation of registered shared "
+            "state:\n  " + "\n  ".join(lines))
+
+
+def reset() -> None:
+    """Forget observations and registrations (class patches stay, and
+    miss on every untracked instance)."""
+    with _mu:
+        _entries.clear()
+        _tracked.clear()
+        _reapers.clear()
+
+
+def snapshot() -> dict[str, Any]:
+    """State capture for tests that reset the process-global monitor —
+    the lockcheck snapshot/restore pattern: a sanitized session's
+    end-of-run verdict must still cover what earlier suites recorded."""
+    with _mu:
+        return {"entries": dict(_entries), "tracked": dict(_tracked),
+                "reapers": dict(_reapers)}
+
+
+def restore(snap: dict[str, Any]) -> None:
+    with _mu:
+        _entries.update(snap["entries"])
+        _tracked.update(snap["tracked"])
+        _reapers.update(snap["reapers"])

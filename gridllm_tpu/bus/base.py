@@ -12,13 +12,18 @@ pub/sub with a `GridLLM:` key prefix. Design fixes baked in (SURVEY.md §2.8):
 
 The protocol carried over this interface (channels `worker:*`, `job:*`,
 keys `workers`, `heartbeat:{id}`, `active_jobs`, `job_queue`) is inventoried
-in SURVEY.md §2.6 and implemented by scheduler/ and worker/.
+in SURVEY.md §2.6 and implemented by scheduler/ and worker/. Every channel
+family is declared in the typed CHANNELS registry below (ISSUE 13) — call
+sites use the CH_* constants / *_channel helpers, never raw name strings;
+the channel-discipline analyzer rule enforces it.
 """
 
 from __future__ import annotations
 
 import abc
 import asyncio
+import dataclasses
+import re
 import time
 from typing import Any, Awaitable, Callable
 
@@ -47,57 +52,350 @@ _DELIVERY_LATENCY = obs.default_registry().histogram(
     ("channel",),
 )
 
-_CHANNEL_CLASS_PREFIXES = (
-    ("job:stream:", "job:stream"),
-    ("job:result:", "job:result"),
-    ("admin:result:", "admin:result"),
-    ("worker:reregister:", "worker:reregister"),
-    ("trace:", "trace"),
-    # multi-host SPMD plan replay: slice:{worker_id}:plan and
-    # slice:{worker_id}:ready:{pid} — collapse both under one class
-    ("slice:", "slice"),
-    # KV-page migration chunk streams (ISSUE 7): kvx:{request_id}
-    ("kvx:", "kvx"),
-)
+# -- typed channel registry (ISSUE 13) --------------------------------------
+#
+# Every channel family the protocol carries is declared here ONCE —
+# mirroring the ENV_VARS registry in utils/config.py — with its name
+# pattern, payload contract, durability class, and intended publisher/
+# subscriber modules. Call sites never spell a channel name as a raw
+# string: fixed channels use the CH_* constants below, parameterized
+# channels go through the *_channel helpers. The channel-discipline rule
+# (gridllm_tpu/analysis/) enforces all of it statically: raw literals at
+# publish/subscribe call sites are findings, publish/subscribe direction
+# must match the declared modules, publisher-side payload keys must
+# agree with the declared model both ways, and ``durable_channel`` /
+# ``channel_class`` below DERIVE from this registry so a channel can't
+# be durable-in-docs but fire-and-forget-in-code. The README "Bus
+# channels" table is cross-checked against this registry by the same
+# rule, so docs cannot drift from the protocol.
+
+
+@dataclasses.dataclass(frozen=True)
+class ChannelSpec:
+    """One channel family: the single source of truth for its wire name,
+    payload shape, durability class, and who talks on it."""
+
+    family: str                   # metric-label class (collapses per-id names)
+    pattern: str                  # "job:result:{job_id}" / fixed literal
+    payload: str                  # pydantic model name, "keys", or "opaque"
+    keys: tuple[str, ...]         # declared payload keys ("keys" payloads)
+    durable: bool                 # broker sequences + ring-buffers it
+    publishers: tuple[str, ...]   # repo-relative modules that may publish
+    subscribers: tuple[str, ...]  # repo-relative modules that may subscribe
+    helper: str                   # the constant / helper call sites must use
+    description: str
+
+
+CHANNELS: dict[str, ChannelSpec] = {}
+
+
+def register_channel(family: str, *, pattern: str, payload: str = "keys",
+                     keys: tuple[str, ...] = (), durable: bool = False,
+                     publishers: tuple[str, ...] = (),
+                     subscribers: tuple[str, ...] = (),
+                     helper: str = "", description: str = "") -> None:
+    if family in CHANNELS:
+        # same contract as register_env: silent last-writer-wins would
+        # let two registrations disagree with no signal anywhere
+        raise ValueError(f"duplicate register_channel({family!r})")
+    CHANNELS[family] = ChannelSpec(family, pattern, payload, tuple(keys),
+                                   durable, tuple(publishers),
+                                   tuple(subscribers), helper, description)
+
+
+# Durability rationale (ISSUE 10): durable=True marks channels whose loss
+# mid-outage is NOT recoverable by the at-least-once sweeps alone —
+# result/stream frames feed live client streams, snapshots are the
+# crash-resume watermarks, handoff/drain/preempted move live assignments,
+# kvx:* carries KV-page migration chunks, and worker:{id}:job carries
+# assignments/cancellations (an assignment published while the worker's
+# subscriber is mid-reconnect must not vanish until the job timeout).
+# Everything else (heartbeats, registration, traces, plan replay) is
+# periodic or best-effort and stays plain fire-and-forget pub/sub.
+
+register_channel(
+    "worker:job", pattern="worker:{worker_id}:job", payload="keys",
+    keys=("type", "job", "jobId", "reason", "xfer", "fromWorker", "header"),
+    durable=True,
+    publishers=("gridllm_tpu/scheduler/scheduler.py",
+                "gridllm_tpu/transfer/migrate.py"),
+    subscribers=("gridllm_tpu/worker/service.py",),
+    helper="worker_job_channel",
+    description="Per-worker control: job_assignment/job_cancellation/"
+                "job_preempt/kv_import/kv_release messages, demuxed by "
+                "the 'type' key.")
+register_channel(
+    "worker:reregister", pattern="worker:reregister:{worker_id}",
+    payload="keys", keys=("type", "timestamp"),
+    publishers=("gridllm_tpu/scheduler/registry.py",),
+    subscribers=("gridllm_tpu/worker/service.py",),
+    helper="worker_reregister_channel",
+    description="Registry asks one silent-but-alive worker to re-publish "
+                "its registration.")
+register_channel(
+    "worker:admin", pattern="worker:admin", payload="keys",
+    keys=("op", "id", "model", "source", "destination", "if_idle"),
+    publishers=("gridllm_tpu/gateway/admin.py",),
+    subscribers=("gridllm_tpu/worker/service.py",),
+    helper="CH_WORKER_ADMIN",
+    description="Gateway broadcast of model-management ops "
+                "(load/unload/copy); workers answer on admin:result.")
+register_channel(
+    "admin:result", pattern="admin:result:{op_id}", payload="keys",
+    keys=("workerId", "op", "ack", "ok", "detail"), durable=True,
+    publishers=("gridllm_tpu/worker/service.py",),
+    subscribers=("gridllm_tpu/gateway/admin.py",),
+    helper="admin_result_channel",
+    description="Per-op admin answers: immediate ack, then ok/detail "
+                "when the op resolves.")
+register_channel(
+    "worker:registered", pattern="worker:registered", payload="WorkerInfo",
+    publishers=("gridllm_tpu/worker/service.py",),
+    subscribers=("gridllm_tpu/scheduler/registry.py",),
+    helper="CH_WORKER_REGISTERED",
+    description="Worker self-registration (full WorkerInfo).")
+register_channel(
+    "worker:unregistered", pattern="worker:unregistered", payload="keys",
+    keys=("workerId",),
+    publishers=("gridllm_tpu/worker/service.py",),
+    subscribers=("gridllm_tpu/scheduler/registry.py",),
+    helper="CH_WORKER_UNREGISTERED",
+    description="Graceful worker shutdown announcement.")
+register_channel(
+    "worker:heartbeat", pattern="worker:heartbeat", payload="keys",
+    keys=("workerId", "status", "currentJobs", "prefixKeys", "role",
+          "decodeSlotsFree", "httpAddr"),
+    publishers=("gridllm_tpu/worker/service.py",),
+    subscribers=("gridllm_tpu/scheduler/registry.py",),
+    helper="CH_WORKER_HEARTBEAT",
+    description="Periodic liveness + load + prefix-affinity keys + "
+                "disagg role/headroom/transfer address.")
+register_channel(
+    "worker:status_update", pattern="worker:status_update", payload="keys",
+    keys=("workerId", "status", "currentJobs"),
+    publishers=("gridllm_tpu/worker/service.py",),
+    subscribers=("gridllm_tpu/scheduler/registry.py",),
+    helper="CH_WORKER_STATUS_UPDATE",
+    description="Change-deduped online/busy/draining transitions.")
+register_channel(
+    "worker:disconnected", pattern="worker:disconnected", payload="keys",
+    keys=("workerId", "reason"),
+    publishers=("gridllm_tpu/worker/group.py",),
+    subscribers=("gridllm_tpu/scheduler/registry.py",),
+    helper="CH_WORKER_DISCONNECTED",
+    description="Fast-path worker death announcement (multi-host slice "
+                "failure) — beats the heartbeat TTL by ~10 s.")
+register_channel(
+    "job:completed", pattern="job:completed", payload="JobResult",
+    durable=True,
+    publishers=("gridllm_tpu/worker/service.py",),
+    subscribers=("gridllm_tpu/scheduler/scheduler.py",),
+    helper="CH_JOB_COMPLETED",
+    description="Global job-success lifecycle event.")
+register_channel(
+    "job:failed", pattern="job:failed", payload="JobResult", durable=True,
+    publishers=("gridllm_tpu/worker/service.py",),
+    subscribers=("gridllm_tpu/scheduler/scheduler.py",),
+    helper="CH_JOB_FAILED",
+    description="Global job-failure / NACK lifecycle event (nack=True "
+                "requeues without burning the retry ladder).")
+register_channel(
+    "job:result", pattern="job:result:{job_id}", payload="JobResult",
+    durable=True,
+    publishers=("gridllm_tpu/worker/service.py",
+                "gridllm_tpu/scheduler/scheduler.py"),
+    subscribers=("gridllm_tpu/scheduler/scheduler.py",),
+    helper="job_result_channel",
+    description="Per-job final result delivered to the submit waiter.")
+register_channel(
+    "job:stream", pattern="job:stream:{job_id}", payload="StreamChunk",
+    durable=True,
+    publishers=("gridllm_tpu/worker/service.py",),
+    subscribers=("gridllm_tpu/scheduler/scheduler.py",),
+    helper="job_stream_channel",
+    description="Per-job token stream frames (absolute char offsets; "
+                "the gateway trims resume overlap).")
+register_channel(
+    "job:snapshot", pattern="job:snapshot", payload="keys",
+    keys=("jobId", "workerId", "tokens", "seed"), durable=True,
+    publishers=("gridllm_tpu/worker/service.py",),
+    subscribers=("gridllm_tpu/scheduler/scheduler.py",),
+    helper="CH_JOB_SNAPSHOT",
+    description="Decode-resume watermarks (generated ids + resolved "
+                "sampler seed) at the snapshot cadence.")
+register_channel(
+    "job:handoff", pattern="job:handoff", payload="keys",
+    keys=("jobId", "fromWorker", "toWorker", "ok", "reason", "tokens",
+          "bytes", "seconds", "path"), durable=True,
+    publishers=("gridllm_tpu/worker/service.py",),
+    subscribers=("gridllm_tpu/scheduler/scheduler.py",),
+    helper="CH_JOB_HANDOFF",
+    description="Disagg prefill→decode handoff report (ok=False counts "
+                "the local-serve fallback).")
+register_channel(
+    "job:drain", pattern="job:drain", payload="keys",
+    keys=("jobId", "fromWorker", "toWorker", "migrated", "snapshot",
+          "tokens", "bytes"), durable=True,
+    publishers=("gridllm_tpu/worker/service.py",),
+    subscribers=("gridllm_tpu/scheduler/scheduler.py",),
+    helper="CH_JOB_DRAIN",
+    description="Graceful-drain handoff: suspended decode moved to a "
+                "peer (or requeued) with its resume snapshot.")
+register_channel(
+    "job:preempted", pattern="job:preempted", payload="keys",
+    keys=("jobId", "fromWorker", "snapshot", "tokens", "parkedTokens"),
+    durable=True,
+    publishers=("gridllm_tpu/worker/service.py",),
+    subscribers=("gridllm_tpu/scheduler/scheduler.py",),
+    helper="CH_JOB_PREEMPTED",
+    description="Suspend-to-host preemption report; the victim requeues "
+                "behind the higher-priority work.")
+register_channel(
+    "trace", pattern="trace:{request_id}", payload="keys",
+    keys=("requestId", "workerId", "spans"),
+    publishers=("gridllm_tpu/worker/service.py",),
+    subscribers=("gridllm_tpu/scheduler/scheduler.py",),
+    helper="trace_channel",
+    description="Worker-side span timelines, stitched into one trace by "
+                "the gateway (helper lives in obs/tracer.py; the "
+                "scheduler psubscribes trace_pattern()).")
+register_channel(
+    "kvx", pattern="kvx:{xfer_id}", payload="opaque", durable=True,
+    publishers=("gridllm_tpu/transfer/migrate.py",),
+    subscribers=("gridllm_tpu/transfer/migrate.py",),
+    helper="kvx_channel",
+    description="KV-page migration chunk streams (versioned wire frames, "
+                "per-attempt transfer id — transfer/wire.py).")
+register_channel(
+    "slice", pattern="slice:{worker_id}:plan", payload="keys",
+    keys=("seq", "rec"),
+    publishers=("gridllm_tpu/worker/plan.py",),
+    subscribers=("gridllm_tpu/worker/plan.py",),
+    helper="plan_channel",
+    description="Multi-host SPMD plan replay: liaison publishes ordered "
+                "engine plan ops, followers apply in lockstep.")
+
+
+# -- registry constants & helpers (the only sanctioned channel spellings) ----
+
+CH_WORKER_ADMIN = "worker:admin"
+CH_WORKER_REGISTERED = "worker:registered"
+CH_WORKER_UNREGISTERED = "worker:unregistered"
+CH_WORKER_HEARTBEAT = "worker:heartbeat"
+CH_WORKER_STATUS_UPDATE = "worker:status_update"
+CH_WORKER_DISCONNECTED = "worker:disconnected"
+CH_JOB_COMPLETED = "job:completed"
+CH_JOB_FAILED = "job:failed"
+CH_JOB_SNAPSHOT = "job:snapshot"
+CH_JOB_HANDOFF = "job:handoff"
+CH_JOB_DRAIN = "job:drain"
+CH_JOB_PREEMPTED = "job:preempted"
+
+
+def worker_job_channel(worker_id: str) -> str:
+    return f"worker:{worker_id}:job"
+
+
+def worker_reregister_channel(worker_id: str) -> str:
+    return f"worker:reregister:{worker_id}"
+
+
+def admin_result_channel(op_id: str) -> str:
+    return f"admin:result:{op_id}"
+
+
+def job_result_channel(job_id: str) -> str:
+    return f"job:result:{job_id}"
+
+
+def job_stream_channel(job_id: str) -> str:
+    return f"job:stream:{job_id}"
+
+
+def kvx_channel(xfer_id: str) -> str:
+    return f"kvx:{xfer_id}"
+
+
+def plan_channel(worker_id: str) -> str:
+    return f"slice:{worker_id}:plan"
+
+
+# -- derived classification (pattern matchers over the registry) -------------
+
+def _compile_pattern(pattern: str) -> Callable[[str], bool]:
+    """Matcher for one registered pattern: literal segments must appear in
+    order, ``{placeholder}`` segments match one-or-more characters."""
+    parts = re.split(r"\{[^{}]+\}", pattern)
+    if len(parts) == 1:
+        lit = parts[0]
+        return lambda ch: ch == lit
+    first, *mid, last = parts
+
+    def match(ch: str) -> bool:
+        if not ch.startswith(first):
+            return False
+        pos = len(first)
+        for seg in mid:
+            idx = ch.find(seg, pos + 1)  # placeholder is ≥ 1 char
+            if idx < 0:
+                return False
+            pos = idx + len(seg)
+        if last:
+            return ch.endswith(last) and len(ch) >= pos + 1 + len(last)
+        return len(ch) > pos
+
+    return match
+
+
+# fixed channels resolve by dict lookup; parameterized ones walk matchers.
+# Compiled lazily and invalidated by registry size so a register_channel()
+# call after import (tests, future plugins) is never silently ignored by
+# durable_channel()/channel_class().
+_MATCHERS: tuple[int, dict[str, ChannelSpec],
+                 tuple[tuple[Callable[[str], bool], ChannelSpec], ...]] \
+    = (-1, {}, ())
+
+
+def _matchers() -> tuple[dict[str, ChannelSpec],
+                         tuple[tuple[Callable[[str], bool],
+                                     ChannelSpec], ...]]:
+    global _MATCHERS
+    version, fixed, param = _MATCHERS
+    if version != len(CHANNELS):
+        fixed = {s.pattern: s for s in CHANNELS.values()
+                 if "{" not in s.pattern}
+        param = tuple((_compile_pattern(s.pattern), s)
+                      for s in CHANNELS.values() if "{" in s.pattern)
+        _MATCHERS = (len(CHANNELS), fixed, param)
+    return fixed, param
+
+
+def channel_spec(channel: str) -> ChannelSpec | None:
+    """The registered spec a concrete channel name belongs to, or None."""
+    fixed, param = _matchers()
+    spec = fixed.get(channel)
+    if spec is not None:
+        return spec
+    for match, s in param:
+        if match(channel):
+            return s
+    return None
 
 
 def channel_class(channel: str) -> str:
     """Collapse per-id channels (``job:stream:{id}``, ``worker:{id}:job``)
-    into their fixed class name for metric labels."""
-    for prefix, cls in _CHANNEL_CLASS_PREFIXES:
-        if channel.startswith(prefix):
-            return cls
-    if channel.startswith("worker:") and channel.endswith(":job"):
-        return "worker:job"
-    return channel
-
-
-# -- durable channel classes (ISSUE 10) -------------------------------------
-#
-# Channels whose loss mid-outage is NOT recoverable by the at-least-once
-# sweeps alone: result/stream frames feed live client streams, snapshots
-# are the crash-resume watermarks, handoff/drain move live assignments,
-# kvx:* carries KV-page migration chunks, and worker:{id}:job carries
-# assignments/cancellations (an assignment published while the worker's
-# subscriber is mid-reconnect would otherwise vanish until the job
-# timeout). The broker assigns these a per-channel monotonic sequence
-# number and keeps a bounded replay ring; a reconnecting RespBus
-# subscriber issues RESUME to replay the gap and dedupes by seq, so
-# consumer-observed delivery is exactly-once across a broker bounce.
-# Everything else (heartbeats, registration, traces) is periodic or
-# best-effort and stays plain fire-and-forget pub/sub.
-_DURABLE_PREFIXES = ("job:result:", "job:stream:", "admin:result:", "kvx:")
-_DURABLE_CHANNELS = frozenset((
-    "job:completed", "job:failed", "job:timeout",
-    "job:snapshot", "job:handoff", "job:drain", "job:preempted",
-))
+    into their registered family name for metric labels. Derived from the
+    channel registry; unregistered channels pass through unchanged."""
+    spec = channel_spec(channel)
+    return channel if spec is None else spec.family
 
 
 def durable_channel(channel: str) -> bool:
-    """True when the broker sequences + ring-buffers this channel."""
-    if channel in _DURABLE_CHANNELS or channel.startswith(_DURABLE_PREFIXES):
-        return True
-    return channel.startswith("worker:") and channel.endswith(":job")
+    """True when the broker sequences + ring-buffers this channel.
+    Derived from the channel registry — durability is declared exactly
+    once, on the ChannelSpec (ISSUE 10 semantics unchanged)."""
+    spec = channel_spec(channel)
+    return spec is not None and spec.durable
 
 
 # Sequence framing on durable channels: the broker prefixes the payload

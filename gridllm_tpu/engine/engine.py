@@ -718,8 +718,17 @@ class InferenceEngine:
         # later; dormant (no import, no wrap) otherwise
         if env_bool("GRIDLLM_SANITIZE"):
             from gridllm_tpu.analysis.lockcheck import guard_allocator
+            from gridllm_tpu.analysis.statecheck import track_object
 
             guard_allocator(self.alloc, self._alloc_lock)
+            # shared-state sanitizer (ISSUE 13): allocator state is
+            # mutated from the runner thread AND gateway executor
+            # threads — every write must hold _alloc_lock in common,
+            # which the write tracker verifies independently of the
+            # call-site guard above
+            track_object(self.alloc, f"alloc:{mc.name}", (
+                "_free", "_owned", "_refs", "_key_of", "_page_by_key",
+                "_staged_stats"))
         self.sampling = SamplingParams.defaults(c.max_slots)
         self.counts = jnp.zeros((c.max_slots, mc.vocab_size), jnp.int32)
         # repeat-penalty window: last ≤ repeat_last_n context tokens per

@@ -13,6 +13,7 @@ import time
 import uuid
 from typing import Callable
 
+from gridllm_tpu.bus.base import CH_WORKER_ADMIN, admin_result_channel
 from gridllm_tpu.scheduler import WorkerRegistry
 from gridllm_tpu.utils.logging import get_logger
 
@@ -108,10 +109,10 @@ class ModelAdmin:
             if on_result is not None:
                 await on_result(rec)
 
-        sub = await bus.subscribe(f"admin:result:{rid}", handler)
+        sub = await bus.subscribe(admin_result_channel(rid), handler)
         try:
             await asyncio.sleep(0.05)  # pub/sub delivery is async (broker)
-            await bus.publish("worker:admin",
+            await bus.publish(CH_WORKER_ADMIN,
                               json.dumps({"op": op, "id": rid, **payload}))
             try:
                 await asyncio.wait_for(done.wait(), min(5.0, timeout_s))

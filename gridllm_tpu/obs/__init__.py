@@ -47,6 +47,7 @@ from gridllm_tpu.obs.tracer import (
     Span,
     Tracer,
     trace_channel,
+    trace_pattern,
 )
 from gridllm_tpu.obs.watchdog import HangWatchdog
 
@@ -78,6 +79,7 @@ __all__ = [
     "register_memory_probe",
     "render_registries",
     "trace_channel",
+    "trace_pattern",
     "unregister_engine_probe",
     "unregister_memory_probe",
 ]

@@ -28,11 +28,22 @@ from collections import OrderedDict
 from contextlib import contextmanager
 from typing import Any, Iterator
 
+# The trace channel family is registered in the bus channel registry
+# (bus/base.py, family "trace") but its helpers live HERE: bus/base
+# imports obs.metrics, so importing back from obs would be circular.
+# The channel-discipline rule resolves this constant inside the helper
+# and verifies it against the registered pattern, so the spellings
+# cannot drift.
 TRACE_CHANNEL_PREFIX = "trace:"
 
 
 def trace_channel(request_id: str) -> str:
     return f"{TRACE_CHANNEL_PREFIX}{request_id}"
+
+
+def trace_pattern() -> str:
+    """Glob pattern covering every trace channel (psubscribe)."""
+    return f"{TRACE_CHANNEL_PREFIX}*"
 
 
 class Span:

@@ -29,7 +29,17 @@ import asyncio
 import json
 import time
 
-from gridllm_tpu.bus.base import MessageBus, Subscription, liveness_suspended
+from gridllm_tpu.bus.base import (
+    CH_WORKER_DISCONNECTED,
+    CH_WORKER_HEARTBEAT,
+    CH_WORKER_REGISTERED,
+    CH_WORKER_STATUS_UPDATE,
+    CH_WORKER_UNREGISTERED,
+    MessageBus,
+    Subscription,
+    liveness_suspended,
+    worker_reregister_channel,
+)
 from gridllm_tpu.obs import Counter, Gauge, MetricsRegistry, default_flight_recorder
 from gridllm_tpu.utils.config import SchedulerConfig
 from gridllm_tpu.utils.events import EventEmitter
@@ -93,12 +103,18 @@ class WorkerRegistry(EventEmitter):
     # -- lifecycle ----------------------------------------------------------
     async def initialize(self) -> None:
         self._running = True
+        from gridllm_tpu.analysis import statecheck
+
+        if statecheck.enabled():
+            # shared-state sanitizer (ISSUE 13): the worker map is
+            # event-loop state; flag any lockless cross-thread write
+            statecheck.track_object(self, "registry", ("workers",))
         for channel, handler in [
-            ("worker:registered", self._on_registered),
-            ("worker:unregistered", self._on_unregistered),
-            ("worker:heartbeat", self._on_heartbeat),
-            ("worker:status_update", self._on_status_update),
-            ("worker:disconnected", self._on_disconnected),
+            (CH_WORKER_REGISTERED, self._on_registered),
+            (CH_WORKER_UNREGISTERED, self._on_unregistered),
+            (CH_WORKER_HEARTBEAT, self._on_heartbeat),
+            (CH_WORKER_STATUS_UPDATE, self._on_status_update),
+            (CH_WORKER_DISCONNECTED, self._on_disconnected),
         ]:
             self._subs.append(await self.bus.subscribe(channel, handler))
         await self._load_existing_workers()
@@ -324,7 +340,7 @@ class WorkerRegistry(EventEmitter):
         """reference: WorkerRegistry.ts:496-515."""
         log.worker("requesting re-registration", worker_id)
         await self.bus.publish(
-            f"worker:reregister:{worker_id}",
+            worker_reregister_channel(worker_id),
             json.dumps({"type": "reregistration_request", "timestamp": time.time()}),
         )
 

@@ -40,7 +40,20 @@ import random
 import time
 from typing import Any, Awaitable, Callable
 
-from gridllm_tpu.bus.base import MessageBus, Subscription, liveness_suspended
+from gridllm_tpu.bus.base import (
+    CH_JOB_COMPLETED,
+    CH_JOB_DRAIN,
+    CH_JOB_FAILED,
+    CH_JOB_HANDOFF,
+    CH_JOB_PREEMPTED,
+    CH_JOB_SNAPSHOT,
+    MessageBus,
+    Subscription,
+    job_result_channel,
+    job_stream_channel,
+    liveness_suspended,
+    worker_job_channel,
+)
 from gridllm_tpu.obs import (
     HangWatchdog,
     MetricsRegistry,
@@ -49,7 +62,7 @@ from gridllm_tpu.obs import (
     classify_request,
     default_flight_recorder,
 )
-from gridllm_tpu.obs.tracer import TRACE_CHANNEL_PREFIX
+from gridllm_tpu.obs.tracer import TRACE_CHANNEL_PREFIX, trace_pattern
 from gridllm_tpu.scheduler.registry import WorkerRegistry
 from gridllm_tpu.utils.config import SchedulerConfig, SLOConfig, WatchdogConfig
 from gridllm_tpu.utils.events import EventEmitter
@@ -206,21 +219,31 @@ class JobScheduler(EventEmitter):
     # -- lifecycle ----------------------------------------------------------
     async def initialize(self) -> None:
         self._running = True
+        from gridllm_tpu.analysis import statecheck
+
+        if statecheck.enabled():
+            # shared-state sanitizer (ISSUE 13): the job tables and
+            # resume/migration maps are event-loop-thread state — any
+            # cross-thread write with no common lock is a race the
+            # lock-order graph cannot see. Dormant otherwise.
+            statecheck.track_object(self, "scheduler", (
+                "active_jobs", "job_queue", "_timeout_handles",
+                "_retry_handles", "_migrations", "_resume_snap",
+                "_stream_chars", "_preempting", "_cancelled",
+                "_stream_progress", "_queue_spans"))
         for channel, handler in [
-            ("job:completed", self._on_job_completed),
-            ("job:failed", self._on_job_failed),
-            ("job:timeout", self._on_job_timeout_report),
-            ("job:handoff", self._on_handoff),
-            ("job:snapshot", self._on_snapshot),
-            ("job:drain", self._on_drain),
-            ("job:preempted", self._on_preempted),
+            (CH_JOB_COMPLETED, self._on_job_completed),
+            (CH_JOB_FAILED, self._on_job_failed),
+            (CH_JOB_HANDOFF, self._on_handoff),
+            (CH_JOB_SNAPSHOT, self._on_snapshot),
+            (CH_JOB_DRAIN, self._on_drain),
+            (CH_JOB_PREEMPTED, self._on_preempted),
         ]:
             self._subs.append(await self.bus.subscribe(channel, handler))
         # worker-side span timelines arrive on trace:{request_id}; merging
         # them here is what stitches one end-to-end timeline per request
         self._subs.append(
-            await self.bus.psubscribe(f"{TRACE_CHANNEL_PREFIX}*",
-                                      self._on_trace))
+            await self.bus.psubscribe(trace_pattern(), self._on_trace))
         await self._load_existing_jobs()
         self._sweep_task = asyncio.create_task(self._sweep_loop())
         self.watchdog.start()
@@ -375,7 +398,7 @@ class JobScheduler(EventEmitter):
                 for channel, handler in extra_subs or []:
                     subs.append(await self.bus.subscribe(channel, handler))
                 subs.append(await self.bus.subscribe(
-                    f"job:result:{request.id}", on_result))
+                    job_result_channel(request.id), on_result))
                 await self.add_job(request)
                 try:
                     result = await asyncio.wait_for(future, timeout_ms / 1000)
@@ -520,7 +543,7 @@ class JobScheduler(EventEmitter):
 
         return await self._submit_and_await(
             request, timeout_ms,
-            extra_subs=[(f"job:stream:{request.id}", on_stream)],
+            extra_subs=[(job_stream_channel(request.id), on_stream)],
             ttft_ref=ttft_ref, settle=settle)
 
     async def publish_cancellation(self, worker_id: str, job_id: str,
@@ -529,7 +552,7 @@ class JobScheduler(EventEmitter):
         waiter-cancel, timeout, and watchdog-hang paths all send the same
         shape to ``worker:{id}:job``."""
         await self.bus.publish(
-            f"worker:{worker_id}:job",
+            worker_job_channel(worker_id),
             json.dumps({"type": "job_cancellation", "jobId": job_id,
                         "reason": reason}),
         )
@@ -851,7 +874,7 @@ class JobScheduler(EventEmitter):
         await self.bus.hdel(JOB_QUEUE_KEY, request.id)
         await self.registry.mark_worker_busy(worker.workerId)
         await self.bus.publish(
-            f"worker:{worker.workerId}:job",
+            worker_job_channel(worker.workerId),
             json.dumps({"type": "job_assignment", "job": assignment.model_dump(mode="json")}),
         )
         self._arm_timeout(assignment, remaining_ms=timeout_ms)
@@ -1001,19 +1024,9 @@ class JobScheduler(EventEmitter):
                                   error=str(result.error)[:200])
             self.tracer.abort(result.jobId, reason="failed")
             log.job("job failed permanently", result.jobId, error=result.error)
-            await self.bus.publish(f"job:result:{result.jobId}", result.model_dump_json())
+            await self.bus.publish(job_result_channel(result.jobId), result.model_dump_json())
             self.emit("job_failed", result)
         self.request_dispatch()
-
-    async def _on_job_timeout_report(self, _ch: str, raw: str) -> None:
-        """Worker-side timeout report on `job:timeout` (subscribed by the
-        reference at JobScheduler.ts:31-39)."""
-        try:
-            job_id = json.loads(raw).get("jobId")
-        except Exception:
-            return
-        if job_id:
-            await self._handle_job_timeout(job_id)
 
     async def _handle_job_timeout(self, job_id: str) -> None:
         """Server-side job timeout (reference: JobScheduler.ts:516-551)."""
@@ -1043,7 +1056,7 @@ class JobScheduler(EventEmitter):
                                      assignment=assignment)
         result = JobResult(jobId=job_id, workerId=assignment.workerId,
                            success=False, error="Job timed out")
-        await self.bus.publish(f"job:result:{job_id}", result.model_dump_json())
+        await self.bus.publish(job_result_channel(job_id), result.model_dump_json())
         self.emit("job_timeout", result)
         self.request_dispatch()
 
@@ -1086,7 +1099,7 @@ class JobScheduler(EventEmitter):
             if to_worker:
                 try:
                     await self.bus.publish(
-                        f"worker:{to_worker}:job",
+                        worker_job_channel(to_worker),
                         json.dumps({"type": "kv_release", "jobId": job_id}))
                 except Exception as e:  # noqa: BLE001 — best-effort
                     log.warning("kv_release publish failed", job_id=job_id,
@@ -1138,7 +1151,7 @@ class JobScheduler(EventEmitter):
                             handoff.model_dump_json())
         await self.registry.mark_worker_busy(to_worker)
         await self.bus.publish(
-            f"worker:{to_worker}:job",
+            worker_job_channel(to_worker),
             json.dumps({"type": "job_assignment",
                         "job": handoff.model_dump(mode="json")}),
         )
@@ -1282,7 +1295,7 @@ class JobScheduler(EventEmitter):
                                 handoff.model_dump_json())
             await self.registry.mark_worker_busy(to_worker)
             await self.bus.publish(
-                f"worker:{to_worker}:job",
+                worker_job_channel(to_worker),
                 json.dumps({"type": "job_assignment",
                             "job": handoff.model_dump(mode="json")}),
             )
@@ -1374,7 +1387,7 @@ class JobScheduler(EventEmitter):
                 victim.jobId, worker_id=victim.workerId, waiting=req.id)
         try:
             await self.bus.publish(
-                f"worker:{victim.workerId}:job",
+                worker_job_channel(victim.workerId),
                 json.dumps({"type": "job_preempt", "jobId": victim.jobId,
                             "reason": f"priority:{req.id}"}))
         except Exception as e:  # noqa: BLE001 — retried next dispatch pass
@@ -1456,7 +1469,7 @@ class JobScheduler(EventEmitter):
                            error="deadline_exceeded", retryable=False)
         log.job("queued job shed past deadline", job_id,
                 model=request.model)
-        await self.bus.publish(f"job:result:{job_id}",
+        await self.bus.publish(job_result_channel(job_id),
                                result.model_dump_json())
         self.emit("job_failed", result)
 
@@ -1517,7 +1530,7 @@ class JobScheduler(EventEmitter):
             for wid in {mig["from"], mig["to"]}:
                 try:
                     await self.bus.publish(
-                        f"worker:{wid}:job",
+                        worker_job_channel(wid),
                         json.dumps({"type": "kv_release", "jobId": job_id}))
                 except Exception as e:  # noqa: BLE001 — best-effort release
                     log.warning("kv_release publish failed", job_id=job_id,

@@ -43,6 +43,7 @@ import time
 from typing import Any, Callable
 
 from gridllm_tpu import faults
+from gridllm_tpu.bus.base import kvx_channel, worker_job_channel
 from gridllm_tpu.obs import default_flight_recorder, default_registry
 from gridllm_tpu.transfer.wire import Assembler, WireError, iter_chunks
 from gridllm_tpu.utils.config import env_int_lenient
@@ -71,10 +72,6 @@ _MIG_INFLIGHT = _OBS.gauge(
     "gridllm_kv_migrations_inflight",
     "KV migrations currently in flight in this process (both sides).",
 )
-
-
-def kvx_channel(xfer_id: str) -> str:
-    return f"kvx:{xfer_id}"
 
 
 def ready_key(xfer_id: str) -> str:
@@ -172,7 +169,7 @@ async def send_kv(
         # receiver prepare: the decode worker's KVImportManager subscribes
         # the chunk channel and sets the ready key (header travels here,
         # out of band of the chunk stream)
-        await bus.publish(f"worker:{target_worker}:job", json.dumps({
+        await bus.publish(worker_job_channel(target_worker), json.dumps({
             "type": "kv_import",
             "jobId": request_id,
             "xfer": xfer,

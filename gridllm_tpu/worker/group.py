@@ -26,7 +26,7 @@ import json
 import time
 from typing import Awaitable, Callable
 
-from gridllm_tpu.bus.base import MessageBus
+from gridllm_tpu.bus.base import CH_WORKER_DISCONNECTED, MessageBus
 from gridllm_tpu.parallel.distributed import GroupConfig
 from gridllm_tpu.utils.logging import get_logger
 
@@ -139,7 +139,7 @@ async def fail_logical_worker(bus: MessageBus, worker_id: str, reason: str) -> N
     evicts the worker and orphans its jobs immediately (fast path — the
     heartbeat TTL would get there ~10 s later anyway)."""
     try:
-        await bus.publish("worker:disconnected", json.dumps({
+        await bus.publish(CH_WORKER_DISCONNECTED, json.dumps({
             "workerId": worker_id, "reason": reason,
         }))
         await bus.hdel("workers", worker_id)

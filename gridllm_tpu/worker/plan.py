@@ -37,15 +37,11 @@ import queue
 import threading
 from typing import Awaitable, Callable
 
-from gridllm_tpu.bus.base import MessageBus, Subscription
+from gridllm_tpu.bus.base import MessageBus, Subscription, plan_channel
 from gridllm_tpu.engine import InferenceEngine
 from gridllm_tpu.utils.logging import get_logger
 
 log = get_logger("worker.plan")
-
-
-def plan_channel(worker_id: str) -> str:
-    return f"slice:{worker_id}:plan"
 
 
 def ready_key(worker_id: str, process_id: int) -> str:

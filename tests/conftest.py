@@ -52,7 +52,10 @@ import pytest  # noqa: E402
 # threading.Lock/RLock factories for instrumented proxies BEFORE any test
 # module builds an engine/scheduler, so every lock those construct joins
 # the lock-order graph. The session hook below fails the run on cycles.
-from gridllm_tpu.analysis import lockcheck  # noqa: E402
+# The shared-state sanitizer (ISSUE 13) rides the same switch: scheduler/
+# registry/allocator register their hot state for cross-thread
+# unguarded-write tracking, judged at session end alongside the graph.
+from gridllm_tpu.analysis import lockcheck, statecheck  # noqa: E402
 
 if lockcheck.enabled():
     lockcheck.install()
@@ -70,6 +73,19 @@ def pytest_sessionfinish(session, exitstatus):
     edges = lockcheck.edges()
     print(f"\nGRIDLLM_SANITIZE: lock-order graph acyclic "
           f"({len(edges)} distinct edges observed)")
+    state = statecheck.report()
+    if not state["ok"]:
+        lines = "\n  ".join(
+            f"{v['object']}.{v['attr']}: {v['threads']} threads, no "
+            f"common lock — " + "; ".join(v["sites"])
+            for v in state["violations"])
+        print(f"\nGRIDLLM_SANITIZE: cross-thread unguarded shared-state "
+              f"mutation:\n  {lines}")
+        pytest.exit("shared-state violation detected by the sanitizer",
+                    returncode=3)
+    print(f"GRIDLLM_SANITIZE: shared-state writes clean "
+          f"({state['observed_attrs']} tracked attrs, "
+          f"{state['tracked_objects']} live objects)")
 
 
 @pytest.fixture

@@ -326,6 +326,306 @@ def test_metric_hygiene_fires_on_bad_name_label_help(tmp_path):
                for m in msgs), msgs
 
 
+# -- channel-discipline (ISSUE 13) ------------------------------------------
+
+# a minimal bus/base.py channel registry for fixture repos: two families
+# (one fixed, one parameterized), registry-derived durable_channel
+_FIXTURE_BUS = """\
+CHANNELS = {}
+
+
+def register_channel(family, **kw):
+    CHANNELS[family] = kw
+
+
+CH_PING = "svc:ping"
+
+
+def box_channel(box_id):
+    return f"svc:box:{box_id}"
+
+
+def durable_channel(channel):
+    return channel in CHANNELS
+
+
+register_channel(
+    "svc:ping", pattern="svc:ping", payload="keys", keys=("a", "b"),
+    durable=False, publishers=("gridllm_tpu/pub.py",),
+    subscribers=("gridllm_tpu/sub.py",), helper="CH_PING",
+    description="fixture fixed channel")
+register_channel(
+    "svc:box", pattern="svc:box:{box_id}", payload="keys", keys=("x",),
+    durable=True, publishers=("gridllm_tpu/pub.py",),
+    subscribers=("gridllm_tpu/sub.py",), helper="box_channel",
+    description="fixture parameterized channel")
+"""
+
+_FIXTURE_CHANNEL_TABLE = (
+    "\n## Bus channels\n\n"
+    "| Channel | Durable | Payload | Who |\n|---|---|---|---|\n"
+    "| `svc:ping` | no | `keys` | pub → sub |\n"
+    "| `svc:box:{box_id}` | yes | `keys` | pub → sub |\n")
+
+
+def _channel_repo(tmp_path, **overrides):
+    files = {
+        "gridllm_tpu/bus/base.py": _FIXTURE_BUS,
+        "gridllm_tpu/pub.py": (
+            "import json\n"
+            "from gridllm_tpu.bus.base import CH_PING, box_channel\n"
+            "async def go(bus):\n"
+            "    await bus.publish(CH_PING, json.dumps({'a': 1, 'b': 2}))\n"
+            "    await bus.publish(box_channel('1'), json.dumps({'x': 1}))\n"
+        ),
+        "gridllm_tpu/sub.py": (
+            "from gridllm_tpu.bus.base import CH_PING, box_channel\n"
+            "async def listen(bus, h):\n"
+            "    await bus.subscribe(CH_PING, h)\n"
+            "    await bus.subscribe(box_channel('1'), h)\n"
+        ),
+        "README.md": _full_env_table() + _FIXTURE_CHANNEL_TABLE,
+    }
+    files.update(overrides)
+    return make_repo(tmp_path, files)
+
+
+def test_channel_discipline_clean_fixture(tmp_path):
+    root = _channel_repo(tmp_path)
+    assert findings_for(root, "channel-discipline") == []
+
+
+def test_channel_discipline_fires_on_raw_literal_and_fstring(tmp_path):
+    root = _channel_repo(tmp_path, **{"gridllm_tpu/pub.py": (
+        "import json\n"
+        "from gridllm_tpu.bus.base import CH_PING, box_channel\n"
+        "async def go(bus, rid):\n"
+        "    await bus.publish(CH_PING, json.dumps({'a': 1, 'b': 2}))\n"
+        "    await bus.publish(box_channel('1'), json.dumps({'x': 1}))\n"
+        "    await bus.publish('svc:ping', '{}')\n"
+        "    await bus.subscribe(f'svc:box:{rid}', go)\n"
+    )})
+    msgs = [f.message for f in findings_for(root, "channel-discipline")]
+    assert any("raw channel literal 'svc:ping'" in m for m in msgs), msgs
+    assert any("f-string channel name" in m for m in msgs), msgs
+
+
+def test_channel_discipline_fires_on_payload_key_drift(tmp_path):
+    # publishes an undeclared key 'c' and never sends declared key 'b'
+    root = _channel_repo(tmp_path, **{"gridllm_tpu/pub.py": (
+        "import json\n"
+        "from gridllm_tpu.bus.base import CH_PING, box_channel\n"
+        "async def go(bus):\n"
+        "    await bus.publish(CH_PING, json.dumps({'a': 1, 'c': 2}))\n"
+        "    await bus.publish(box_channel('1'), json.dumps({'x': 1}))\n"
+    )})
+    msgs = [f.message for f in findings_for(root, "channel-discipline")]
+    assert any("payload key 'c'" in m and "not declared" in m
+               for m in msgs), msgs
+    assert any("declares payload key 'b'" in m
+               and "no publisher ever sends" in m for m in msgs), msgs
+
+
+def test_channel_discipline_fires_on_undeclared_direction(tmp_path):
+    # sub.py publishes on a family it is only declared to subscribe to
+    root = _channel_repo(tmp_path, **{"gridllm_tpu/sub.py": (
+        "import json\n"
+        "from gridllm_tpu.bus.base import CH_PING, box_channel\n"
+        "async def listen(bus, h):\n"
+        "    await bus.subscribe(CH_PING, h)\n"
+        "    await bus.subscribe(box_channel('1'), h)\n"
+        "    await bus.publish(CH_PING, json.dumps({'a': 1, 'b': 2}))\n"
+    )})
+    msgs = [f.message for f in findings_for(root, "channel-discipline")]
+    assert any("not a declared publisher" in m for m in msgs), msgs
+
+
+def test_channel_discipline_fires_on_hardcoded_durability(tmp_path):
+    bus = _FIXTURE_BUS.replace(
+        "def durable_channel(channel):\n    return channel in CHANNELS",
+        "def durable_channel(channel):\n"
+        "    return channel in ('svc:box',)")
+    root = _channel_repo(tmp_path, **{"gridllm_tpu/bus/base.py": bus})
+    msgs = [f.message for f in findings_for(root, "channel-discipline")]
+    assert any("hardcodes channel name" in m and "derive" in m
+               for m in msgs), msgs
+
+
+def test_channel_discipline_fires_on_readme_table_drift(tmp_path):
+    table = _FIXTURE_CHANNEL_TABLE.replace(
+        "| `svc:box:{box_id}` | yes |", "| `svc:box:{box_id}` | no |")
+    root = _channel_repo(
+        tmp_path, **{"README.md": _full_env_table() + table})
+    msgs = [f.message for f in findings_for(root, "channel-discipline")]
+    assert any("durability" in m and "'no'" in m and "'yes'" in m
+               for m in msgs), msgs
+    # and a missing row is drift too
+    root2 = _channel_repo(tmp_path / "r2", **{
+        "README.md": _full_env_table() + _FIXTURE_CHANNEL_TABLE.replace(
+            "| `svc:ping` | no | `keys` | pub → sub |\n", "")})
+    msgs2 = [f.message for f in findings_for(root2, "channel-discipline")]
+    assert any("'svc:ping'" in m and "missing from the README" in m
+               for m in msgs2), msgs2
+    # and so is the Publishers → subscribers column
+    root3 = _channel_repo(tmp_path / "r3", **{
+        "README.md": _full_env_table() + _FIXTURE_CHANNEL_TABLE.replace(
+            "| `svc:ping` | no | `keys` | pub → sub |",
+            "| `svc:ping` | no | `keys` | sub → pub |")})
+    msgs3 = [f.message for f in findings_for(root3, "channel-discipline")]
+    assert any("direction" in m and "sub → pub" in m for m in msgs3), msgs3
+
+
+def test_channel_discipline_fires_on_helper_pattern_drift(tmp_path):
+    bus = _FIXTURE_BUS.replace(
+        'def box_channel(box_id):\n    return f"svc:box:{box_id}"',
+        'def box_channel(box_id):\n    return f"svc:crate:{box_id}"')
+    root = _channel_repo(tmp_path, **{"gridllm_tpu/bus/base.py": bus})
+    msgs = [f.message for f in findings_for(root, "channel-discipline")]
+    assert any("box_channel()" in m and "svc:crate" in m
+               for m in msgs), msgs
+
+
+# -- async-discipline (ISSUE 13) --------------------------------------------
+
+def test_async_discipline_fires_on_blocking_calls(tmp_path):
+    root = make_repo(tmp_path, {"gridllm_tpu/gateway/svc.py": (
+        "import time, subprocess, asyncio\n"
+        "async def bad(my_lock, path):\n"
+        "    time.sleep(1)\n"                        # 3
+        "    subprocess.run(['x'])\n"                # 4
+        "    open('f').read()\n"                     # 5
+        "    path.read_text()\n"                     # 6
+        "    my_lock.acquire()\n"                    # 7
+        "    my_lock.acquire(True)\n"                # 8: still unbounded
+        "    time.sleep(0)  # async-ok\n"            # waived
+        "    my_lock.acquire(timeout=1)\n"           # bounded: fine
+        "    my_lock.acquire(False)\n"               # non-blocking: fine
+        "    my_lock.acquire(blocking=False)\n"      # non-blocking: fine
+        "    await asyncio.to_thread(time.sleep, 1)\n"  # routed: fine
+        "def sync_helper():\n"
+        "    time.sleep(1)\n"                        # sync def: fine
+        "async def uses_closure():\n"
+        "    def thread_target():\n"
+        "        time.sleep(1)\n"                    # nested sync: fine
+        "    return thread_target\n"
+    )})
+    fs = findings_for(root, "async-discipline")
+    assert sorted(f.line for f in fs) == [3, 4, 5, 6, 7, 8], fs
+    msgs = [f.message for f in fs]
+    assert any("asyncio.sleep" in m for m in msgs), msgs
+    assert any("lock.acquire" in m for m in msgs), msgs
+
+
+def test_async_discipline_ignores_other_subsystems(tmp_path):
+    # models/ops code is sync-world; the rule scopes to the async planes
+    root = make_repo(tmp_path, {"gridllm_tpu/ops/helper.py": (
+        "import time\n"
+        "async def odd_but_out_of_scope():\n"
+        "    time.sleep(1)\n"
+    )})
+    assert findings_for(root, "async-discipline") == []
+
+
+# -- fault-coverage (ISSUE 13) ----------------------------------------------
+
+_FIXTURE_FAULTS = (
+    'SITES = (\n    "svc.alive",\n    "svc.dead",\n)\n'
+    "def check(site):\n    return False\n"
+    "def inject(site):\n    check(site)\n"
+)
+
+_FIXTURE_FAULT_TABLE = (
+    "\n## Faults\n\n| site | effect |\n|---|---|\n"
+    "| `svc.alive` | fixture |\n| `svc.dead` | fixture |\n")
+
+
+def test_fault_coverage_fires_on_dead_and_unregistered_sites(tmp_path):
+    root = make_repo(tmp_path, {
+        "gridllm_tpu/faults.py": _FIXTURE_FAULTS,
+        "gridllm_tpu/bus/mod.py": (
+            "from gridllm_tpu import faults\n"
+            "def f():\n"
+            "    faults.check('svc.alive')\n"
+            "    faults.inject('svc.ghost')\n"
+        ),
+        "README.md": _full_env_table() + _FIXTURE_FAULT_TABLE,
+    })
+    msgs = [f.message for f in findings_for(root, "fault-coverage")]
+    assert any("'svc.dead'" in m and "no live inject()/check()" in m
+               for m in msgs), msgs
+    assert any("'svc.ghost'" in m and "not registered" in m
+               for m in msgs), msgs
+
+
+def test_fault_coverage_fires_on_nonliteral_site_and_readme_drift(tmp_path):
+    root = make_repo(tmp_path, {
+        "gridllm_tpu/faults.py": _FIXTURE_FAULTS,
+        "gridllm_tpu/bus/mod.py": (
+            "from gridllm_tpu import faults\n"
+            "def f(site):\n"
+            "    faults.check(site)\n"
+            "    faults.check('svc.alive')\n"
+            "    faults.check('svc.dead')\n"
+        ),
+        # table documents a ghost site and misses svc.dead
+        "README.md": _full_env_table() +
+            "\n## Faults\n\n| site | effect |\n|---|---|\n"
+            "| `svc.alive` | fixture |\n| `svc.ghost` | fixture |\n",
+    })
+    msgs = [f.message for f in findings_for(root, "fault-coverage")]
+    assert any("literal site name" in m for m in msgs), msgs
+    assert any("'svc.ghost'" in m and "not registered" in m
+               for m in msgs), msgs
+    assert any("'svc.dead'" in m and "missing from the README" in m
+               for m in msgs), msgs
+
+
+def test_fault_coverage_fires_on_uncovered_critical_subsystem(tmp_path):
+    # a bus/ directory exists but carries no live site
+    root = make_repo(tmp_path, {
+        "gridllm_tpu/faults.py": _FIXTURE_FAULTS,
+        "gridllm_tpu/bus/mod.py": "def quiet():\n    pass\n",
+        "gridllm_tpu/other.py": (
+            "from gridllm_tpu import faults\n"
+            "def f():\n"
+            "    faults.check('svc.alive')\n"
+            "    faults.check('svc.dead')\n"
+        ),
+        "README.md": _full_env_table() + _FIXTURE_FAULT_TABLE,
+    })
+    msgs = [f.message for f in findings_for(root, "fault-coverage")]
+    assert any("critical subsystem 'bus'" in m for m in msgs), msgs
+
+
+def test_new_rules_cli_rule_filtering(tmp_path):
+    """--rule runs exactly the selected new rules (ISSUE 13 satellite):
+    one seeded violation each, reported under the right rule name."""
+    root = make_repo(tmp_path, {
+        "gridllm_tpu/faults.py": _FIXTURE_FAULTS,
+        "gridllm_tpu/gateway/svc.py": (
+            "import time\n"
+            "async def bad(bus):\n"
+            "    time.sleep(1)\n"
+            "    await bus.publish('raw:chan', '{}')\n"
+        ),
+        "gridllm_tpu/bus/mod.py": (
+            "from gridllm_tpu import faults\n"
+            "def f():\n    faults.check('svc.alive')\n"
+        ),
+        "README.md": _full_env_table() + _FIXTURE_FAULT_TABLE,
+    })
+    proc = subprocess.run(
+        [sys.executable, "-m", "gridllm_tpu.analysis", "--json",
+         "--rule", "channel-discipline", "--rule", "async-discipline",
+         "--rule", "fault-coverage", "--root", str(root)],
+        capture_output=True, text=True, cwd=REPO_ROOT, timeout=120)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    payload = json.loads(proc.stdout)
+    fired = {f["rule"] for f in payload["findings"]}
+    assert fired == {"channel-discipline", "async-discipline",
+                     "fault-coverage"}, payload["findings"]
+
+
 # -- helpers ----------------------------------------------------------------
 
 def test_expand_braces():

@@ -84,6 +84,36 @@ def test_durable_channel_classification():
         assert not durable_channel(ch), ch
 
 
+def test_durable_classification_matches_legacy_patterns():
+    """ISSUE 13 satellite: durable_channel now DERIVES from the typed
+    channel registry — prove the derived classification agrees with the
+    PR 10 hardcoded pattern list on every registered channel family
+    (instantiated with representative ids). The one deliberate
+    divergence: job:timeout, which the legacy list called durable but
+    which turned out to be subscribed-and-never-published drift — it is
+    no longer a registered channel at all."""
+    import re
+
+    from gridllm_tpu.bus.base import CHANNELS
+
+    legacy_prefixes = ("job:result:", "job:stream:", "admin:result:",
+                      "kvx:")
+    legacy_fixed = {"job:completed", "job:failed", "job:timeout",
+                    "job:snapshot", "job:handoff", "job:drain",
+                    "job:preempted"}
+
+    def legacy(ch: str) -> bool:
+        if ch in legacy_fixed or ch.startswith(legacy_prefixes):
+            return True
+        return ch.startswith("worker:") and ch.endswith(":job")
+
+    assert len(CHANNELS) >= 20
+    for spec in CHANNELS.values():
+        ch = re.sub(r"\{[^{}]+\}", "w1-abc123", spec.pattern)
+        assert durable_channel(ch) == spec.durable == legacy(ch), \
+            (spec.family, ch)
+
+
 def test_seq_framing_roundtrip():
     framed = encode_seq(42, '{"x": 1}')
     assert split_seq(framed) == (42, '{"x": 1}')
