@@ -1,20 +1,24 @@
 """gridllm_tpu.analysis — repo-wide static invariant analyzer + runtime
-sanitizers (ISSUE 8, extended by ISSUE 13).
+sanitizers (ISSUE 8, extended by ISSUEs 13 and 14).
 
-Static half: ``python -m gridllm_tpu.analysis`` runs AST-based rules
+Static half: ``python -m gridllm_tpu.analysis`` runs 12 AST-based rules
 (config-discipline, lock-discipline, dashboard-drift, jit-discipline,
 span-pairing, metric-hygiene, channel-discipline, async-discipline,
-fault-coverage) over the repo and reports ``file:line`` findings in
-human or JSON form; ``--strict`` exits nonzero on any finding and gates
-tier-1 CI.
+fault-coverage, kernel-parity, dtype-discipline, host-sync-discipline)
+over the repo and reports ``file:line`` findings in human or JSON form
+(``--json`` includes per-rule wall time); ``--strict`` exits nonzero on
+any finding and gates tier-1 CI.
 
-Runtime half (both armed by ``GRIDLLM_SANITIZE=1``):
+Runtime half (all armed by ``GRIDLLM_SANITIZE=1``):
 ``analysis/lockcheck.py`` instruments ``threading.Lock``/``RLock``
 during tests, builds the process lock-order graph, and fails on cycles
 or unlocked ``PageAllocator`` mutation; ``analysis/statecheck.py``
 tracks attribute writes on registered hot objects (scheduler job
 tables, registry worker map, allocator state) keyed by thread and held
-locks, and fails on cross-thread mutation with no common lock.
+locks, and fails on cross-thread mutation with no common lock;
+``analysis/numcheck.py`` shadow-executes sampled kernel dispatches
+against their KERNELS-registry jnp references at per-op tolerances and
+NaN/Inf-tripwires sampler logits and KV writes.
 """
 
 from gridllm_tpu.analysis.core import (  # noqa: F401

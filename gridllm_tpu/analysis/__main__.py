@@ -13,7 +13,7 @@ import json
 import sys
 from pathlib import Path
 
-from gridllm_tpu.analysis.core import RULES, load_rules, run
+from gridllm_tpu.analysis.core import RULES, load_rules, run_timed
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -45,7 +45,7 @@ def main(argv: list[str] | None = None) -> int:
               "(no gridllm_tpu/ package)", file=sys.stderr)
         return 2
     try:
-        findings = run(root, args.rule)
+        findings, timings = run_timed(root, args.rule)
     except KeyError as e:
         print(f"error: {e.args[0]}", file=sys.stderr)
         return 2
@@ -56,6 +56,9 @@ def main(argv: list[str] | None = None) -> int:
             "root": str(root.resolve()),
             "rules": args.rule or sorted(RULES),
             "findings": [f.to_dict() for f in findings],
+            # per-rule wall seconds ("_load" = parse + parent-annotate,
+            # paid once and shared) — CI watches for a rule gone slow
+            "timings": {k: round(v, 6) for k, v in timings.items()},
         }, indent=2))
     else:
         for f in findings:

@@ -18,6 +18,7 @@ import ast
 import dataclasses
 import importlib
 import pkgutil
+import time
 from pathlib import Path
 from typing import Any, Callable, Iterator
 
@@ -46,15 +47,29 @@ class Finding:
 
 
 class SourceFile:
-    """One parsed python file. The AST is annotated with ``.parent``
-    back-references so rules can walk upward (enclosing with/try/def)."""
+    """One parsed python file. The AST is parsed + parent-annotated ONCE
+    (``.parent`` back-references let rules walk upward — enclosing
+    with/try/def) and the flattened node list is cached, so all rules
+    share one parse and one tree walk per file instead of redoing either
+    per rule."""
 
     def __init__(self, root: Path, path: Path):
         self.path = path
         self.rel = path.relative_to(root).as_posix()
         self.text = path.read_text(encoding="utf-8", errors="replace")
         self._tree: ast.Module | None = None
+        self._nodes: list[ast.AST] | None = None
+        self._lines: list[str] | None = None
         self.parse_error: SyntaxError | None = None
+
+    @property
+    def lines(self) -> list[str]:
+        """Split source lines, cached — waiver-comment lookups run once
+        per candidate node, and re-splitting the text each time is
+        O(file × nodes) waste."""
+        if self._lines is None:
+            self._lines = self.text.splitlines()
+        return self._lines
 
     @property
     def tree(self) -> ast.Module | None:
@@ -70,8 +85,10 @@ class SourceFile:
         return self._tree
 
     def walk(self) -> Iterator[ast.AST]:
-        tree = self.tree
-        return iter(()) if tree is None else ast.walk(tree)
+        if self._nodes is None:
+            tree = self.tree
+            self._nodes = [] if tree is None else list(ast.walk(tree))
+        return iter(self._nodes)
 
 
 class Repo:
@@ -92,12 +109,15 @@ class Repo:
             p = self.root / name
             if p.is_file():
                 self.files.append(SourceFile(self.root, p))
+        self._by_rel = {f.rel: f for f in self.files}
+        # parse + parent-annotate every file ONCE, here in the loader —
+        # the trees (and cached node lists) are shared by all rules;
+        # syntax errors surface exactly once as findings in run()
+        for f in self.files:
+            f.tree
 
     def file(self, rel: str) -> SourceFile | None:
-        for f in self.files:
-            if f.rel == rel:
-                return f
-        return None
+        return self._by_rel.get(rel)
 
     def package_files(self, include_tests: bool = False) -> list[SourceFile]:
         out = [f for f in self.files if f.rel.startswith("gridllm_tpu/")]
@@ -145,11 +165,22 @@ def load_rules() -> None:
 
 def run(root: str | Path, rule_names: list[str] | None = None) -> list[Finding]:
     """Run the selected rules (default: all) over the repo at ``root``."""
+    return run_timed(root, rule_names)[0]
+
+
+def run_timed(
+    root: str | Path, rule_names: list[str] | None = None,
+) -> tuple[list[Finding], dict[str, float]]:
+    """Like :func:`run`, also returning per-rule wall time in seconds —
+    surfaced in the CLI's ``--json`` output so CI can spot a rule whose
+    cost regressed (the repo loader parses every tree once up front;
+    a slow rule is a slow RULE, not a re-parse)."""
     load_rules()
+    t0 = time.perf_counter()
     repo = Repo(Path(root))
+    timings: dict[str, float] = {"_load": time.perf_counter() - t0}
     findings: list[Finding] = []
     for f in repo.files:
-        f.tree  # force-parse so syntax errors surface exactly once
         if f.parse_error is not None:
             findings.append(Finding(
                 "parse", f.rel, f.parse_error.lineno or 0,
@@ -158,9 +189,11 @@ def run(root: str | Path, rule_names: list[str] | None = None) -> list[Finding]:
     for name in names:
         if name not in RULES:
             raise KeyError(f"unknown rule {name!r}; known: {sorted(RULES)}")
+        t0 = time.perf_counter()
         findings.extend(RULES[name].check(repo))
+        timings[name] = time.perf_counter() - t0
     findings.sort(key=lambda x: (x.path, x.line, x.rule))
-    return findings
+    return findings, timings
 
 
 # -- shared AST helpers -----------------------------------------------------

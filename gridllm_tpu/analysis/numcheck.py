@@ -1,0 +1,258 @@
+"""Runtime numerics sanitizer (``GRIDLLM_SANITIZE=1``, gridcheck v3).
+
+The differential tests prove each Pallas kernel against its jnp oracle
+on the shapes the tests happen to exercise; this module proves the SAME
+contract on whatever shapes the serving path actually dispatches. When
+armed it does two things:
+
+1. **Shadow execution.** A sampled fraction of kernel dispatches (the
+   attention dispatchers in ``ops/attention.py``) also trace the
+   registry's jnp reference and compare the two outputs inside the
+   compiled program, at the per-op tolerance ``ops/kernels.py``
+   declares. The excess error (beyond ``atol + rtol * |ref|``) reaches
+   the host through ``jax.debug.callback``; any excess > 0 is a
+   violation. Sampling is decided at TRACE time — one decision per
+   compiled program, a pure function of (GRIDLLM_NUMCHECK_SEED, op,
+   trace #), same determinism contract as faults.py — so a shadowed
+   program checks every step it runs while unshadowed programs pay
+   nothing.
+2. **NaN/Inf tripwire.** Sampler logits (``ops/sampling.py``) and fresh
+   KV rows at the pool-write boundary (``ops/kvcache.py``) are checked
+   finite every step. A NaN here is the first observable symptom of a
+   diverged kernel, a poisoned weight load, or an out-of-range int8
+   scale — caught at the write, not three requests later in a garbled
+   stream.
+
+Violations are recorded here, mirrored to the flight recorder
+(``numcheck`` ring), and fail the test session exit-3 in
+``tests/conftest.py`` — exactly like lockcheck's cycle check and
+statecheck's shared-state verdict. Dormant unless ``GRIDLLM_SANITIZE``
+is truthy: the hot-path cost is one module-boolean check per dispatch.
+
+Comparisons honor each dispatcher's validity mask (padding rows and
+inactive slots are UNSPECIFIED kernel output by contract — the
+differential tests skip them and so does the shadow).
+"""
+
+from __future__ import annotations
+
+import functools
+import random
+import threading
+from typing import Any, Callable
+
+from gridllm_tpu.utils.config import env_bool, env_float, env_int
+
+_lock = threading.Lock()
+_loaded = False
+_armed = False
+_sample = 0.0
+_rngs: dict[str, random.Random] = {}
+_stats = {"shadowed": 0, "finite_checks": 0}
+_violations: list[dict[str, Any]] = []
+
+
+def enabled() -> bool:
+    return env_bool("GRIDLLM_SANITIZE")
+
+
+def _load() -> None:
+    global _loaded, _armed, _sample
+    with _lock:
+        if _loaded:
+            return
+        _armed = enabled()
+        _sample = min(max(env_float("GRIDLLM_NUMCHECK_SAMPLE"), 0.0), 1.0)
+        _loaded = True
+
+
+def configure(sample: float | None = None, seed: int | None = None,
+              armed: bool | None = None) -> None:
+    """Test/driver entry point: override the env-resolved policy (and
+    reset the per-op decision streams so a reconfigure is reproducible
+    from call #1)."""
+    global _loaded, _armed, _sample
+    _load()
+    with _lock:
+        if sample is not None:
+            _sample = min(max(sample, 0.0), 1.0)
+        if armed is not None:
+            _armed = armed
+        _rngs.clear()
+        if seed is not None:
+            _seed_override["seed"] = seed
+
+
+_seed_override: dict[str, int] = {}
+
+
+def _decide(op: str) -> bool:
+    """One trace-time sampling decision for `op` — pure function of
+    (seed, op, call #), the faults.py determinism contract."""
+    if _sample >= 1.0:
+        return True
+    if _sample <= 0.0:
+        return False
+    with _lock:
+        rng = _rngs.get(op)
+        if rng is None:
+            seed = _seed_override.get("seed",
+                                      env_int("GRIDLLM_NUMCHECK_SEED"))
+            rng = _rngs[op] = random.Random(f"{seed}|{op}")
+        return rng.random() < _sample
+
+
+def active() -> bool:
+    _load()
+    return _armed
+
+
+def _record(kind: str, op: str, **fields: Any) -> None:
+    entry = {"kind": kind, "op": op, **fields}
+    with _lock:
+        _violations.append(entry)
+    from gridllm_tpu.obs.flightrec import default_flight_recorder
+
+    default_flight_recorder().record("numcheck", kind, op=op, **fields)
+
+
+def _on_shadow(op: str, rtol: float, atol: float, excess, maxerr) -> None:
+    # NaN excess (kernel went non-finite where the reference is finite)
+    # must COUNT: `x > 0` is False for NaN, so test the negation
+    if not float(excess) <= 0.0:
+        _record("tolerance", op, rtol=rtol, atol=atol,
+                excess=float(excess), max_err=float(maxerr))
+
+
+def _on_finite(site: str, bad) -> None:
+    if int(bad):
+        _record("nonfinite", site, bad_elements=int(bad))
+
+
+def shadow(op: str, out: Any, ref_thunk: Callable[[], Any],
+           valid: Any = None) -> Any:
+    """Maybe weave a reference-comparison into the traced program around
+    a kernel dispatch. ``out`` is the kernel output (array, or a tuple
+    possibly containing None — the ragged dispatcher's shape);
+    ``ref_thunk`` builds the jnp reference lazily (only traced when this
+    dispatch is sampled); ``valid`` is an optional bool mask (or
+    matching tuple) selecting the contractually-specified elements.
+    Returns ``out`` unchanged — the shadow only observes."""
+    _load()
+    if not _armed or not _decide(op):
+        return out
+    import jax
+    import jax.numpy as jnp
+
+    from gridllm_tpu.ops.kernels import tolerance
+
+    rtol, atol = tolerance(op)
+    ref = ref_thunk()
+    outs = out if isinstance(out, tuple) else (out,)
+    refs = ref if isinstance(ref, tuple) else (ref,)
+    valids = valid if isinstance(valid, tuple) else (valid,) * len(outs)
+    excess = jnp.float32(0.0)
+    maxerr = jnp.float32(0.0)
+    for o, r, v in zip(outs, refs, valids):
+        if o is None or r is None:
+            continue
+        of = o.astype(jnp.float32)
+        rf = r.astype(jnp.float32)
+        err = jnp.abs(of - rf)
+        bound = atol + rtol * jnp.abs(rf)
+        over = err - bound
+        if v is not None:
+            mask = jnp.broadcast_to(
+                jnp.reshape(v, v.shape + (1,) * (of.ndim - v.ndim)),
+                of.shape)
+            err = jnp.where(mask, err, 0.0)
+            over = jnp.where(mask, over, -jnp.inf)
+        excess = jnp.maximum(excess, over.max())
+        maxerr = jnp.maximum(maxerr, err.max())
+    with _lock:
+        _stats["shadowed"] += 1
+    # static context (op name, tolerances) closes over the callback;
+    # only the two scalars travel through the device boundary
+    jax.debug.callback(functools.partial(_on_shadow, op, rtol, atol),
+                       excess, maxerr)
+    return out
+
+
+def check_finite(site: str, *arrays: Any) -> None:
+    """NaN/Inf tripwire: count non-finite elements across ``arrays``
+    (floating-point leaves only) and report any through the callback.
+    No-op unless the sanitizer is armed."""
+    _load()
+    if not _armed:
+        return
+    import jax
+    import jax.numpy as jnp
+
+    bad = jnp.int32(0)
+    counted = False
+    for a in arrays:
+        if a is None or not jnp.issubdtype(a.dtype, jnp.floating):
+            continue
+        bad = bad + jnp.sum(~jnp.isfinite(a)).astype(jnp.int32)
+        counted = True
+    if not counted:
+        return
+    with _lock:
+        _stats["finite_checks"] += 1
+    jax.debug.callback(functools.partial(_on_finite, site), bad)
+
+
+def violations() -> list[dict[str, Any]]:
+    with _lock:
+        return list(_violations)
+
+
+def report() -> dict[str, Any]:
+    with _lock:
+        return {"armed": _armed, "sample": _sample,
+                "shadowed_dispatches": _stats["shadowed"],
+                "finite_checks": _stats["finite_checks"],
+                "violations": list(_violations),
+                "ok": not _violations}
+
+
+def assert_clean() -> None:
+    v = violations()
+    if v:
+        lines = [
+            f"{x['op']}: {x['kind']} "
+            + (f"(excess {x['excess']:.3e} past rtol={x['rtol']} "
+               f"atol={x['atol']}, max err {x['max_err']:.3e})"
+               if x["kind"] == "tolerance"
+               else f"({x['bad_elements']} non-finite elements)")
+            for x in v]
+        raise NumericsError(
+            "kernel numerics violation(s) observed:\n  "
+            + "\n  ".join(lines))
+
+
+class NumericsError(AssertionError):
+    """A shadowed kernel dispatch diverged from its jnp reference past
+    the registry tolerance, or a tripwired array went non-finite."""
+
+
+def reset() -> None:
+    """Forget observations and decision streams (tests that deliberately
+    trip the sanitizer restore cleanliness before session end)."""
+    with _lock:
+        _violations.clear()
+        _rngs.clear()
+        _stats["shadowed"] = 0
+        _stats["finite_checks"] = 0
+
+
+def reload_from_env() -> None:
+    """Drop any configure() overrides and re-resolve armed/sample/seed
+    from the environment on the next use — the exact restore for tests
+    that reconfigured the sanitizer (a hardcoded restore would clobber a
+    CI run's forced GRIDLLM_NUMCHECK_SAMPLE for every later suite)."""
+    global _loaded
+    with _lock:
+        _loaded = False
+        _rngs.clear()
+        _seed_override.clear()

@@ -1,0 +1,189 @@
+"""dtype-discipline: the numerics dtype policy of ``ops/`` is lexically
+auditable (gridcheck v3, ISSUE 14).
+
+The kernels and their jnp oracles keep accumulation in float32 no matter
+what dtype the serving path feeds them (bf16 weights, int8-dequant KV).
+That policy only survives review if it is VISIBLE at every site, so this
+rule bans the constructs that hide it:
+
+1. **Accumulation dtype** — every ``dot_general`` call in ``ops/`` must
+   pin ``preferred_element_type`` (the MXU accumulates in the output
+   type; leaving it implicit means bf16 inputs silently accumulate in
+   bf16), and every ``jnp.einsum`` must pin ``precision`` (the reference
+   paths' equivalent knob).
+2. **f32 softmax** — any function calling ``jnp.exp`` / ``jax.nn
+   .softmax`` / ``logsumexp`` must establish float32 somewhere in its
+   body (an ``astype(jnp.float32)`` cast, an ``jnp.float32`` dtype
+   argument, or f32 carry inits): exp/softmax in bf16 loses real
+   accuracy at long context.
+3. **No dtype-less array construction** — ``jnp.array``/``jnp.asarray``
+   in ``ops/`` must pass an explicit dtype; the default-inference path
+   is exactly where a python float silently becomes f64-weak/f32 and a
+   python int an i32 that later upcasts a whole expression.
+4. **Named mask sentinels** — float literals of magnitude >= 1e6 (the
+   ``-1e30`` masking class) must be module-level named constants, not
+   inline: the value is a dtype commitment (it overflows f16, saturates
+   bf16) and must be auditable at one site per module.
+5. **QuantPages pairing** — a function that unwraps ``QuantPages`` (an
+   ``isinstance(..., QuantPages)`` check) and consumes ``.data`` must
+   also consume ``.scale``: int8 page values without their dequant
+   scales are garbage that still parses, runs, and decodes.
+
+Scope: ``gridllm_tpu/ops/`` (check 5 also covers ``engine/engine.py``,
+which handles QuantPages on the spill/export paths). Waive a deliberate
+exception with ``# dtype-ok`` on the offending line.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from gridllm_tpu.analysis.core import Finding, Repo, dotted_name, rule
+
+RULE = "dtype-discipline"
+OPS_PREFIX = "gridllm_tpu/ops/"
+ENGINE = "gridllm_tpu/engine/engine.py"
+_WAIVER = "# dtype-ok"
+_SENTINEL_MIN = 1e6
+_EXPISH = {"exp", "softmax", "logsumexp"}
+
+
+def _waived(f, lineno: int) -> bool:
+    lines = f.lines
+    return 0 < lineno <= len(lines) and _WAIVER in lines[lineno - 1]
+
+
+def _has_f32_anchor(fn: ast.AST) -> bool:
+    """True when the function body visibly establishes float32: a
+    ``float32`` attribute/name anywhere (astype(jnp.float32), dtype
+    args, f32 carry inits)."""
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Attribute) and node.attr == "float32":
+            return True
+        if isinstance(node, ast.Name) and node.id == "float32":
+            return True
+    return False
+
+
+def _toplevel_functions(tree: ast.Module):
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+        elif isinstance(node, ast.ClassDef):
+            for sub in node.body:
+                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    yield sub
+
+
+def _check_quant_pairing(f, findings: list[Finding]) -> None:
+    if f.tree is None:
+        return
+    for fn in _toplevel_functions(f.tree):
+        quant_names: set[str] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call) \
+                    and dotted_name(node.func).endswith("isinstance") \
+                    and len(node.args) == 2 \
+                    and dotted_name(node.args[1]).endswith("QuantPages") \
+                    and isinstance(node.args[0], ast.Name):
+                quant_names.add(node.args[0].id)
+        if not quant_names:
+            continue
+        reads: dict[str, set[str]] = {}
+        first_data_line: dict[str, int] = {}
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Attribute) \
+                    and isinstance(node.value, ast.Name) \
+                    and node.value.id in quant_names \
+                    and node.attr in ("data", "scale"):
+                reads.setdefault(node.value.id, set()).add(node.attr)
+                if node.attr == "data":
+                    first_data_line.setdefault(node.value.id, node.lineno)
+        for name, attrs in sorted(reads.items()):
+            if "data" in attrs and "scale" not in attrs \
+                    and not _waived(f, first_data_line[name]):
+                findings.append(Finding(
+                    RULE, f.rel, first_data_line[name],
+                    f"{fn.name}() consumes QuantPages {name}.data without "
+                    f"its .scale sibling — int8 values without dequant "
+                    "scales are silent garbage"))
+
+
+@rule(RULE, "ops/ numerics policy is visible: dot_general pins "
+            "preferred_element_type, einsum pins precision, softmax/exp "
+            "functions anchor f32, array constructions carry a dtype, "
+            "mask sentinels are named constants, QuantPages .data never "
+            "travels without .scale")
+def check(repo: Repo) -> list[Finding]:
+    findings: list[Finding] = []
+    for f in repo.package_files():
+        in_ops = f.rel.startswith(OPS_PREFIX)
+        if not in_ops and f.rel != ENGINE:
+            continue
+        _check_quant_pairing(f, findings)
+        if not in_ops or f.tree is None:
+            continue
+
+        # module-level named sentinel assignments (annotated or not) are
+        # the allowed homes
+        sentinel_lines: set[int] = set()
+        for node in f.tree.body:
+            if isinstance(node, (ast.Assign, ast.AnnAssign)):
+                for sub in ast.walk(node):
+                    if hasattr(sub, "lineno"):
+                        sentinel_lines.add(sub.lineno)
+
+        for node in f.walk():
+            if isinstance(node, ast.Call):
+                fn_name = dotted_name(node.func)
+                kws = {kw.arg for kw in node.keywords}
+                if fn_name.endswith("dot_general") \
+                        and "preferred_element_type" not in kws \
+                        and not _waived(f, node.lineno):
+                    findings.append(Finding(
+                        RULE, f.rel, node.lineno,
+                        "dot_general without preferred_element_type — "
+                        "bf16 inputs would accumulate in bf16; pin "
+                        "preferred_element_type=jnp.float32"))
+                if fn_name.endswith("einsum") and fn_name.startswith("jnp") \
+                        and "precision" not in kws \
+                        and not _waived(f, node.lineno):
+                    findings.append(Finding(
+                        RULE, f.rel, node.lineno,
+                        "jnp.einsum without precision — reference paths "
+                        "pin precision (jax.lax.Precision.HIGHEST) so the "
+                        "oracle's accumulation is not backend-dependent"))
+                if fn_name in ("jnp.array", "jnp.asarray") \
+                        and len(node.args) < 2 and "dtype" not in kws \
+                        and not _waived(f, node.lineno):
+                    findings.append(Finding(
+                        RULE, f.rel, node.lineno,
+                        f"dtype-less {fn_name}() — the inferred dtype is "
+                        "a silent policy decision; pass one explicitly"))
+            if isinstance(node, ast.Constant) \
+                    and isinstance(node.value, float) \
+                    and abs(node.value) >= _SENTINEL_MIN \
+                    and node.lineno not in sentinel_lines \
+                    and not _waived(f, node.lineno):
+                findings.append(Finding(
+                    RULE, f.rel, node.lineno,
+                    f"inline mask sentinel {node.value!r} — name it as a "
+                    "module-level constant (it is a dtype commitment: "
+                    "overflows f16, saturates bf16)"))
+
+        for fn in _toplevel_functions(f.tree):
+            exp_line = None
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Call):
+                    name = dotted_name(node.func)
+                    leaf = name.rsplit(".", 1)[-1]
+                    if leaf in _EXPISH and not _waived(f, node.lineno):
+                        exp_line = exp_line or node.lineno
+            if exp_line is not None and not _has_f32_anchor(fn):
+                findings.append(Finding(
+                    RULE, f.rel, exp_line,
+                    f"{fn.name}() computes exp/softmax without a visible "
+                    "float32 anchor — cast inputs (or init carries) in "
+                    "f32, or waive a contract-guaranteed-f32 path with "
+                    "# dtype-ok"))
+    return findings

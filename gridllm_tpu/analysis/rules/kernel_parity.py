@@ -1,0 +1,314 @@
+"""kernel-parity: every Pallas kernel is registered, referenced, tested,
+and documented — both ways (gridcheck v3, ISSUE 14).
+
+``ops/kernels.py``'s ``KERNELS`` tuple is the parity surface: each kernel
+declared once with its jnp reference, dispatch-counter label, tolerance,
+and owning differential test. Drift this rule catches statically:
+
+1. A ``pl.pallas_call`` site in ``ops/`` inside a function the registry
+   doesn't know — a kernel with no declared oracle, no tolerance, and no
+   test obligation.
+2. A registered kernel whose entry function is missing from
+   ``ops/pallas_kernels.py`` (or no longer contains a ``pallas_call``) —
+   a stale registry row claiming coverage that no longer exists.
+3. Dispatch-label drift: the union of registry labels and
+   ``EXTRA_DISPATCH_LABELS`` must equal the set of literal
+   ``record_kernel_path(...)`` labels in ``ops/`` exactly, both ways
+   (a non-literal label defeats the audit and is flagged too).
+4. A registered reference function (``module:fn``) that does not exist
+   in the named ``ops/`` module.
+5. A registered differential test (``tests/file.py::test_name``) whose
+   file or test function does not exist — the kernel's oracle claim is
+   untested.
+6. The README "Kernels" table and the registry agree both ways,
+   including the reference / dispatch-label / tolerance cells (the
+   config-discipline treatment, applied to the kernel surface).
+
+Fixture repos without an ``ops/kernels.py`` module skip everything
+except the unregistered-``pallas_call`` check against the imported
+registry.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from gridllm_tpu.analysis.core import (
+    Finding,
+    Repo,
+    SourceFile,
+    ancestors,
+    dotted_name,
+    rule,
+    str_const,
+)
+
+RULE = "kernel-parity"
+REGISTRY_MODULE = "gridllm_tpu/ops/kernels.py"
+KERNELS_MODULE = "gridllm_tpu/ops/pallas_kernels.py"
+OPS_PREFIX = "gridllm_tpu/ops/"
+_ROW_NAME = re.compile(r"^`([a-z_]+)`$")
+_ROW_TOL = re.compile(r"^`([0-9.e+-]+) / ([0-9.e+-]+)`$")
+
+
+def _parse_registry(repo: Repo):
+    """(kernels, extra_labels, line_of) parsed from the ANALYZED tree's
+    ops/kernels.py — ``--root`` on another checkout validates THAT
+    checkout's registry. kernels: name -> {field: value}; None when the
+    module is absent (fixture repos)."""
+    f = repo.file(REGISTRY_MODULE)
+    if f is None:
+        return None, None, {}
+    kernels: dict[str, dict[str, object]] = {}
+    lines: dict[str, int] = {}
+    extra: set[str] = set()
+    for node in f.walk():
+        if isinstance(node, ast.Call) \
+                and dotted_name(node.func).endswith("KernelSpec"):
+            fields: dict[str, object] = {}
+            for kw in node.keywords:
+                if isinstance(kw.value, ast.Constant):
+                    fields[kw.arg] = kw.value.value
+                elif isinstance(kw.value, ast.UnaryOp) \
+                        and isinstance(kw.value.op, ast.USub) \
+                        and isinstance(kw.value.operand, ast.Constant):
+                    fields[kw.arg] = -kw.value.operand.value  # type: ignore
+            name = fields.get("name")
+            if isinstance(name, str):
+                kernels[name] = fields
+                lines[name] = node.lineno
+        targets = (node.targets if isinstance(node, ast.Assign)
+                   else [node.target] if isinstance(node, ast.AnnAssign)
+                   else [])
+        if any(isinstance(t, ast.Name) and t.id == "EXTRA_DISPATCH_LABELS"
+               for t in targets) and isinstance(node.value, ast.Dict):
+            for k in node.value.keys:
+                val = str_const(k)
+                if val is not None:
+                    extra.add(val)
+    return kernels, extra, lines
+
+
+def _enclosing_toplevel_fn(node: ast.AST) -> ast.AST | None:
+    """The outermost (module-level) function containing `node`."""
+    fn = None
+    for anc in ancestors(node):
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            fn = anc
+    return fn
+
+
+def _pallas_call_sites(f: SourceFile) -> list[tuple[str | None, int]]:
+    """(enclosing module-level function name, line) for every
+    ``pl.pallas_call(...)`` call in the file."""
+    out: list[tuple[str | None, int]] = []
+    for node in f.walk():
+        if isinstance(node, ast.Call) \
+                and dotted_name(node.func).endswith("pallas_call"):
+            fn = _enclosing_toplevel_fn(node)
+            out.append((fn.name if fn is not None else None, node.lineno))
+    return out
+
+
+@rule(RULE, "every pl.pallas_call belongs to a KERNELS-registry entry; "
+            "registry <-> dispatch labels <-> README Kernels table agree "
+            "both ways; each kernel's reference fn and differential test "
+            "exist")
+def check(repo: Repo) -> list[Finding]:
+    findings: list[Finding] = []
+    kernels, extra_labels, reg_lines = _parse_registry(repo)
+    if kernels is None:
+        # fixture fallback: check pallas_call sites against the imported
+        # registry (the fixture's source of truth)
+        from gridllm_tpu.ops.kernels import kernel_names
+
+        known = set(kernel_names())
+        for f in repo.files:
+            if not f.rel.startswith(OPS_PREFIX):
+                continue
+            for fn_name, line in _pallas_call_sites(f):
+                if fn_name not in known:
+                    findings.append(Finding(
+                        RULE, f.rel, line,
+                        f"pl.pallas_call inside {fn_name or '<module>'}() "
+                        "which is not a registered kernel (ops/kernels.py "
+                        "KERNELS)"))
+        return findings
+
+    # 1. every pallas_call site belongs to a registered kernel entry fn
+    kernel_fns_with_call: set[str] = set()
+    for f in repo.files:
+        if not f.rel.startswith(OPS_PREFIX) or f.rel == REGISTRY_MODULE:
+            continue
+        for fn_name, line in _pallas_call_sites(f):
+            if fn_name in kernels and f.rel == KERNELS_MODULE:
+                kernel_fns_with_call.add(fn_name)
+                continue
+            findings.append(Finding(
+                RULE, f.rel, line,
+                f"pl.pallas_call inside {fn_name or '<module>'}() which "
+                "is not a registered kernel — declare it in "
+                "ops/kernels.py KERNELS (reference, dispatch label, "
+                "tolerance, owning test)"))
+
+    # 2. registered kernels actually exist and still launch Pallas
+    kfile = repo.file(KERNELS_MODULE)
+    toplevel_fns = set()
+    if kfile is not None and kfile.tree is not None:
+        toplevel_fns = {n.name for n in kfile.tree.body
+                        if isinstance(n, ast.FunctionDef)}
+    for name, line in sorted(reg_lines.items()):
+        if name not in toplevel_fns:
+            findings.append(Finding(
+                RULE, REGISTRY_MODULE, line,
+                f"registered kernel {name!r} has no function in "
+                f"{KERNELS_MODULE}"))
+        elif name not in kernel_fns_with_call:
+            findings.append(Finding(
+                RULE, REGISTRY_MODULE, line,
+                f"registered kernel {name!r} contains no pl.pallas_call "
+                "— stale registry row (or the kernel silently became a "
+                "jnp function)"))
+
+    # 3. dispatch labels: registry union EXTRA == record_kernel_path
+    # literals in ops/, both ways
+    declared = {str(k["dispatch"]) for k in kernels.values()
+                if "dispatch" in k} | set(extra_labels or ())
+    recorded: dict[str, tuple[str, int]] = {}
+    for f in repo.files:
+        if not f.rel.startswith(OPS_PREFIX):
+            continue
+        for node in f.walk():
+            if isinstance(node, ast.Call) \
+                    and dotted_name(node.func).endswith("record_kernel_path") \
+                    and node.args:
+                lab = str_const(node.args[0])
+                if lab is None:
+                    findings.append(Finding(
+                        RULE, f.rel, node.lineno,
+                        "record_kernel_path() needs a literal op label "
+                        "for static parity auditing"))
+                    continue
+                recorded.setdefault(lab, (f.rel, node.lineno))
+                if lab not in declared:
+                    findings.append(Finding(
+                        RULE, f.rel, node.lineno,
+                        f"dispatch label {lab!r} is not declared in "
+                        "ops/kernels.py (KERNELS dispatch or "
+                        "EXTRA_DISPATCH_LABELS)"))
+    for lab in sorted(declared - set(recorded)):
+        findings.append(Finding(
+            RULE, REGISTRY_MODULE, 0,
+            f"declared dispatch label {lab!r} is never recorded by "
+            "record_kernel_path() in ops/ — dead registry entry, the "
+            "dashboard cell it promises stays empty"))
+
+    # 4 + 5. reference functions and differential tests exist
+    fn_defs: dict[str, set[str]] = {}
+    for f in repo.files:
+        if f.tree is None:
+            continue
+        fn_defs[f.rel] = {
+            n.name for n in ast.walk(f.tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+    for name, fields in sorted(kernels.items()):
+        line = reg_lines.get(name, 0)
+        ref = fields.get("reference")
+        if isinstance(ref, str) and ":" in ref:
+            mod, _, fn = ref.partition(":")
+            rel = f"{OPS_PREFIX}{mod}.py"
+            if fn not in fn_defs.get(rel, set()):
+                findings.append(Finding(
+                    RULE, REGISTRY_MODULE, line,
+                    f"kernel {name!r}: reference {ref!r} does not resolve "
+                    f"to a function in {rel}"))
+        else:
+            findings.append(Finding(
+                RULE, REGISTRY_MODULE, line,
+                f"kernel {name!r}: reference must be a literal "
+                "'module:function' under ops/"))
+        test = fields.get("test")
+        if isinstance(test, str) and "::" in test:
+            trel, _, tfn = test.partition("::")
+            if trel not in fn_defs:
+                findings.append(Finding(
+                    RULE, REGISTRY_MODULE, line,
+                    f"kernel {name!r}: test file {trel!r} does not exist"))
+            elif tfn not in fn_defs[trel]:
+                findings.append(Finding(
+                    RULE, REGISTRY_MODULE, line,
+                    f"kernel {name!r}: differential test {tfn!r} not "
+                    f"found in {trel} — the oracle claim is untested"))
+        else:
+            findings.append(Finding(
+                RULE, REGISTRY_MODULE, line,
+                f"kernel {name!r}: test must be a literal "
+                "'tests/file.py::test_name'"))
+
+    findings.extend(_check_readme(repo, kernels))
+    return findings
+
+
+def _check_readme(repo: Repo, kernels: dict) -> list[Finding]:
+    findings: list[Finding] = []
+    readme = repo.read_text("README.md")
+    if readme is None:
+        return [Finding(RULE, "README.md", 0, "README.md missing")]
+    documented: dict[str, tuple[list[str], int]] = {}
+    in_section = False
+    for i, line in enumerate(readme.splitlines(), 1):
+        if line.startswith("#"):
+            in_section = line.lstrip("#").strip().lower() == "kernels"
+            continue
+        if not in_section or not line.lstrip().startswith("|"):
+            continue
+        cells = [c.strip() for c in line.strip().strip("|").split("|")]
+        if not cells:
+            continue
+        m = _ROW_NAME.fullmatch(cells[0])
+        if m is not None:
+            documented.setdefault(m.group(1), (cells, i))
+    if not documented:
+        return [Finding(
+            RULE, "README.md", 0,
+            "README has no Kernels table (| `kernel` | `reference` | "
+            "`dispatch label` | `rtol / atol` | `test` |) documenting "
+            "the KERNELS registry")]
+    for name, (cells, i) in sorted(documented.items()):
+        if name not in kernels:
+            findings.append(Finding(
+                RULE, "README.md", i,
+                f"README documents kernel {name!r}, which is not "
+                "registered in ops/kernels.py KERNELS"))
+            continue
+        fields = kernels[name]
+        want = {
+            1: str(fields.get("reference", "")).partition(":")[2],
+            2: str(fields.get("dispatch", "")),
+            4: str(fields.get("test", "")),
+        }
+        for idx, expect in want.items():
+            got = cells[idx].strip("`") if len(cells) > idx else ""
+            if got != expect:
+                findings.append(Finding(
+                    RULE, "README.md", i,
+                    f"Kernels table row {name!r}: column {idx + 1} says "
+                    f"{got!r} but the registry says {expect!r}"))
+        if len(cells) > 3:
+            m = _ROW_TOL.fullmatch(cells[3])
+            reg_tol = (fields.get("rtol"), fields.get("atol"))
+            if m is None or (float(m.group(1)), float(m.group(2))) != (
+                    float(reg_tol[0] or 0), float(reg_tol[1] or 0)):
+                findings.append(Finding(
+                    RULE, "README.md", i,
+                    f"Kernels table row {name!r}: tolerance cell "
+                    f"{cells[3]!r} does not match the registry "
+                    f"(`{reg_tol[0]} / {reg_tol[1]}`)"))
+    for name in sorted(kernels):
+        if name not in documented:
+            findings.append(Finding(
+                RULE, "README.md", 0,
+                f"registered kernel {name!r} missing from the README "
+                "Kernels table"))
+    return findings

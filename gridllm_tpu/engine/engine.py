@@ -1629,7 +1629,8 @@ class InferenceEngine:
         (fetch+ingest wall over the block's step count)."""
         gen, out, blk, t_disp = self._inflight.popleft()
         t0 = time.perf_counter()
-        raw = np.asarray(jax.device_get(out))
+        # the ONE declared block-fetch sync point (host-sync-discipline)
+        raw = np.asarray(jax.device_get(out))  # sync-ok
         self._observe_device_step(t_disp, blk)
         self._ingest_block(gen, raw)
         _STEP_DURATION.observe(
@@ -1692,8 +1693,10 @@ class InferenceEngine:
         self._dispatch_verify(drafts, dlen)
         gen, (block, n_emit), _blk, t_disp = self._inflight.popleft()
         t0 = time.perf_counter()
-        raw = np.asarray(jax.device_get(block))
-        n_np = np.asarray(jax.device_get(n_emit))
+        # the spec path's declared fetch: serial by construction (drafts
+        # depend on this step's tokens), so the sync is the design
+        raw = np.asarray(jax.device_get(block))  # sync-ok
+        n_np = np.asarray(jax.device_get(n_emit))  # sync-ok
         self._observe_device_step(t_disp, 1)
         self._ingest_spec(gen, raw, n_np, dlen)
         _STEP_DURATION.observe(time.perf_counter() - t0, model=self.cfg.name)

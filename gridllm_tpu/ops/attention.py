@@ -23,6 +23,7 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 
+from gridllm_tpu.analysis import numcheck
 from gridllm_tpu.utils.config import env_bool
 from gridllm_tpu.ops.kvcache import (
     QuantPages,
@@ -37,7 +38,7 @@ from gridllm_tpu.ops.kvcache import (
 __all__ = [
     "attention_prefill", "paged_attention_decode", "attention_prefix_chunk",
     "paged_attention_verify", "ragged_paged_attention",
-    "ragged_attention_enabled",
+    "ragged_paged_attention_ref", "ragged_attention_enabled",
     "attention_prefill_ref", "paged_attention_decode_ref",
     "_env_mode", "_pallas_mode",  # re-export: policy lives in ops/kvcache.py
 ]
@@ -155,8 +156,23 @@ def attention_prefill(
     kernel = partial(
         _prefill_kernel, interpret=interpret, softcap=float(logit_softcap)
     )
+
+    def _shadow(out):
+        # numerics sanitizer (analysis/numcheck.py): padding rows are
+        # unspecified kernel output — compare the valid region only, the
+        # same contract the differential tests apply
+        if not numcheck.active():
+            return out
+        return numcheck.shadow(
+            "attention_prefill", out,
+            lambda: attention_prefill_ref(
+                q, k, v, seq_lens, logit_softcap=logit_softcap,
+                window=window),
+            valid=jnp.arange(q.shape[1])[None, :] < seq_lens[:, None],
+        )
+
     if mode == "direct":
-        return kernel(q, k, v, seq_lens, window)
+        return _shadow(kernel(q, k, v, seq_lens, window))
     from jax.sharding import PartitionSpec as P
 
     # window always travels as a scalar operand — the kernels read it from
@@ -165,7 +181,7 @@ def attention_prefill(
     sm = _shard_map_kernel(
         mesh, kernel, in_specs=(hs, hs, hs, P(None), P()), out_specs=hs,
     )
-    return sm(q, k, v, seq_lens, jnp.asarray(window, jnp.int32))
+    return _shadow(sm(q, k, v, seq_lens, jnp.asarray(window, jnp.int32)))
 
 
 def paged_attention_decode(
@@ -226,10 +242,35 @@ def paged_attention_decode(
             pallas_kernels.paged_decode, page_size=page_size,
             interpret=interpret, softcap=float(logit_softcap),
         )
+
+        def _shadow(out):
+            if not numcheck.active():
+                return out
+
+            def ref():
+                kp, vp = k_pages, v_pages
+                if kp.ndim == 5:
+                    li = jnp.int32(0) if layer is None else layer
+                    kp = jax.lax.dynamic_index_in_dim(kp, li,
+                                                      keepdims=False)
+                    vp = jax.lax.dynamic_index_in_dim(vp, li,
+                                                      keepdims=False)
+                return paged_attention_decode_ref(
+                    q, kp, vp, page_table, lengths, page_size,
+                    k_cur=k_cur, v_cur=v_cur,
+                    logit_softcap=logit_softcap, window=window)
+
+            # without the current-token merge a length-0 slot is garbage
+            # by contract (callers mask on active); with it, even a fresh
+            # slot's single-column softmax is specified output
+            return numcheck.shadow(
+                "attention_decode", out, ref,
+                valid=None if k_cur is not None else lengths > 0)
+
         if mode == "direct":
-            return kernel(q, k_pages, v_pages, page_table, lengths,
-                          k_cur=k_cur, v_cur=v_cur, layer=layer,
-                          window=window)
+            return _shadow(kernel(q, k_pages, v_pages, page_table, lengths,
+                                  k_cur=k_cur, v_cur=v_cur, layer=layer,
+                                  window=window))
         from jax.sharding import PartitionSpec as P
 
         pool = P(*((None,) * (k_pages.ndim - 2)), ax, None)
@@ -254,7 +295,7 @@ def paged_attention_decode(
         specs += [opt[n][1] for n in names]
         sm = _shard_map_kernel(mesh, sm_body, in_specs=tuple(specs),
                                out_specs=hs)
-        return sm(*args)
+        return _shadow(sm(*args))
     record_kernel_path("attention_decode", False)
     if k_pages.ndim == 5:  # fallback: materialize the layer slice
         li = jnp.int32(0) if layer is None else layer
@@ -341,10 +382,24 @@ def attention_prefix_chunk(
             pallas_kernels.prefix_chunk, page_size=page_size,
             interpret=interpret, softcap=float(logit_softcap),
         )
+
+        def _shadow(out):
+            if not numcheck.active():
+                return out
+            return numcheck.shadow(
+                "attention_prefix_chunk", out,
+                lambda: _prefix_chunk_ref(
+                    q, k_pages, v_pages, table_row, start, total_len,
+                    page_size, k_cur=k_cur, v_cur=v_cur, layer=layer,
+                    logit_softcap=logit_softcap, window=window),
+                # rows past the chunk's valid length are bucket padding
+                valid=jnp.arange(q.shape[1])[None, :] < total_len - start,
+            )
+
         if mode == "direct":
-            return kernel(q, k_pages, v_pages, table_row, start, total_len,
-                          k_cur=k_cur, v_cur=v_cur, layer=layer,
-                          window=window)
+            return _shadow(kernel(q, k_pages, v_pages, table_row, start,
+                                  total_len, k_cur=k_cur, v_cur=v_cur,
+                                  layer=layer, window=window))
         from jax.sharding import PartitionSpec as P
 
         pool = P(*((None,) * (k_pages.ndim - 2)), ax, None)
@@ -370,7 +425,7 @@ def attention_prefix_chunk(
         specs += [opt[n][1] for n in names]
         sm = _shard_map_kernel(mesh, sm_body, in_specs=tuple(specs),
                                out_specs=hs)
-        return sm(*args)
+        return _shadow(sm(*args))
     record_kernel_path("attention_prefix_chunk", False)
     return _prefix_chunk_ref(
         q, k_pages, v_pages, table_row, start, total_len, page_size,
@@ -704,6 +759,33 @@ def ragged_paged_attention(
         from gridllm_tpu.ops import pallas_kernels
 
         record_kernel_path("attention_ragged", True)
+
+        def _shadow(outs):
+            # numerics sanitizer: shadow the whole launch against the
+            # region-by-region jnp reference (QuantPages pools dequantize
+            # through gather_kv/take inside the refs, so the int8 dequant
+            # epilogue is compared against the jnp quant path)
+            if not numcheck.active():
+                return outs
+            vc = vg = None
+            if q_chunk is not None:
+                vc = (jnp.arange(q_chunk.shape[1])[None, :]
+                      < chunk_total - chunk_start)
+            if q_group is not None:
+                vg = group_lengths > 0
+            return numcheck.shadow(
+                "attention_ragged", outs,
+                lambda: ragged_paged_attention_ref(
+                    k_pages, v_pages, page_size,
+                    q_chunk=q_chunk, chunk_row=chunk_row,
+                    chunk_start=chunk_start, chunk_total=chunk_total,
+                    k_chunk=k_chunk, v_chunk=v_chunk, q_group=q_group,
+                    page_table=page_table, group_lengths=group_lengths,
+                    k_group=k_group, v_group=v_group, layer=layer,
+                    logit_softcap=logit_softcap, window=window),
+                valid=(vc, vg),
+            )
+
         if quant:
             # dequant epilogue (ISSUE 11): the kernel DMAs the int8 page
             # AND its [ps] scale row, multiplying after the load in the
@@ -717,7 +799,7 @@ def ragged_paged_attention(
                 pallas_kernels.ragged_attention, page_size=page_size,
                 interpret=interpret, softcap=float(logit_softcap),
             )
-            return kernel(
+            return _shadow(kernel(
                 kd, vd,
                 q_chunk=q_chunk, chunk_row=chunk_row,
                 chunk_start=chunk_start, chunk_total=chunk_total,
@@ -726,7 +808,7 @@ def ragged_paged_attention(
                 group_lengths=group_lengths, k_group=k_group,
                 v_group=v_group, layer=layer, window=window,
                 k_scale=ksc, v_scale=vsc,
-            )
+            ))
         kp = k_pages if k_pages.ndim == 5 else k_pages[None]
         vp = v_pages if v_pages.ndim == 5 else v_pages[None]
         kernel = partial(
@@ -734,7 +816,7 @@ def ragged_paged_attention(
             interpret=interpret, softcap=float(logit_softcap),
         )
         if mode == "direct":
-            return kernel(
+            return _shadow(kernel(
                 kp, vp,
                 q_chunk=q_chunk, chunk_row=chunk_row,
                 chunk_start=chunk_start, chunk_total=chunk_total,
@@ -742,7 +824,7 @@ def ragged_paged_attention(
                 q_group=q_group, page_table=page_table,
                 group_lengths=group_lengths, k_group=k_group,
                 v_group=v_group, layer=layer, window=window,
-            )
+            ))
         from jax.sharding import PartitionSpec as P
 
         pool = P(None, None, None, ax, None)
@@ -783,12 +865,47 @@ def ragged_paged_attention(
         )
         outs = sm(kp, vp, *(opt[n][0] for n in names))
         it = iter(outs)
-        return (
+        return _shadow((
             next(it) if q_chunk is not None else None,
             next(it) if q_group is not None else None,
-        )
+        ))
 
     record_kernel_path("attention_ragged", False)
+    return ragged_paged_attention_ref(
+        k_pages, v_pages, page_size,
+        q_chunk=q_chunk, chunk_row=chunk_row, chunk_start=chunk_start,
+        chunk_total=chunk_total, k_chunk=k_chunk, v_chunk=v_chunk,
+        q_group=q_group, page_table=page_table,
+        group_lengths=group_lengths, k_group=k_group, v_group=v_group,
+        layer=layer, logit_softcap=logit_softcap, window=window,
+    )
+
+
+def ragged_paged_attention_ref(
+    k_pages: jnp.ndarray,
+    v_pages: jnp.ndarray,
+    page_size: int,
+    q_chunk: jnp.ndarray | None = None,
+    chunk_row: jnp.ndarray | None = None,
+    chunk_start: jnp.ndarray | None = None,
+    chunk_total: jnp.ndarray | None = None,
+    k_chunk: jnp.ndarray | None = None,
+    v_chunk: jnp.ndarray | None = None,
+    q_group: jnp.ndarray | None = None,
+    page_table: jnp.ndarray | None = None,
+    group_lengths: jnp.ndarray | None = None,
+    k_group: jnp.ndarray | None = None,
+    v_group: jnp.ndarray | None = None,
+    layer: jnp.ndarray | None = None,
+    logit_softcap: float = 0.0,
+    window: jnp.ndarray | int = 0,
+) -> tuple[jnp.ndarray | None, jnp.ndarray | None]:
+    """jnp reference for the unified ragged launch — the per-region
+    legacy references composed VERBATIM (the fallback leg of
+    ragged_paged_attention, and the oracle the KERNELS registry and the
+    numerics sanitizer hold the ragged kernel to). Greedy streams stay
+    bit-identical ragged-on vs ragged-off on the jnp path because each
+    region delegates to the exact legacy reference."""
     out_chunk = out_group = None
     if q_chunk is not None:
         out_chunk = _prefix_chunk_ref(

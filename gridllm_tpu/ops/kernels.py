@@ -1,0 +1,148 @@
+"""KERNELS: the typed Pallas-kernel registry (gridcheck v3, ISSUE 14).
+
+Every Pallas kernel in ``ops/pallas_kernels.py`` is declared here ONCE —
+with the jnp reference that is its numerical oracle, the
+``gridllm_kernel_dispatch_total`` label its dispatcher records under, the
+tolerance its differential test (and the runtime numerics sanitizer,
+``analysis/numcheck.py``) holds it to, and the named test that owns the
+kernel-vs-reference differential. The ``kernel-parity`` analyzer rule
+cross-checks all of it both ways: an unregistered ``pl.pallas_call``
+site, a registered kernel whose reference or test went missing, a
+dispatch label the registry doesn't know (or vice versa), and drift in
+the README "Kernels" table are each a ``--strict`` failure.
+
+This mirrors the ``ENV_VARS`` (utils/config.py) and ``CHANNELS``
+(bus/base.py) pattern: pure data, importable without jax, parsed from
+the AST by the rule so ``--root`` on another checkout validates THAT
+checkout's registry.
+
+Tolerances are the BF16-input bound (the loosest dtype the serving path
+feeds the kernels); f32 differential tests pass far inside it. The two
+KV-write kernels are data movement, not math — their oracle is the
+scatter form and the bound is exact (0); the numerics sanitizer covers
+them with the NaN/Inf tripwire instead of value shadowing.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass(frozen=True)
+class KernelSpec:
+    """One Pallas kernel's parity contract."""
+
+    name: str         # public entry fn in ops/pallas_kernels.py
+    reference: str    # "module:function" jnp oracle under gridllm_tpu/ops/
+    dispatch: str     # gridllm_kernel_dispatch_total op label
+    rtol: float       # differential-test / numcheck relative tolerance
+    atol: float       # ... absolute tolerance
+    test: str         # "tests/file.py::test_name" owning differential test
+    description: str
+
+
+KERNELS: tuple[KernelSpec, ...] = (
+    KernelSpec(
+        name="flash_prefill",
+        reference="attention:attention_prefill_ref",
+        dispatch="attention_prefill",
+        rtol=3e-2, atol=3e-2,
+        test="tests/test_pallas.py::test_flash_prefill_matches_ref",
+        description="causal GQA flash attention over one prompt chunk, "
+                    "K/V VMEM-resident per kv head",
+    ),
+    KernelSpec(
+        name="flash_prefill_streamed",
+        reference="attention:attention_prefill_ref",
+        dispatch="attention_prefill",
+        rtol=3e-2, atol=3e-2,
+        test="tests/test_pallas.py::test_flash_prefill_streamed_matches_ref",
+        description="flash prefill past the VMEM budget: K/V blocks "
+                    "stream from HBM as a grid dimension",
+    ),
+    KernelSpec(
+        name="paged_decode",
+        reference="attention:paged_attention_decode_ref",
+        dispatch="attention_decode",
+        rtol=3e-2, atol=3e-2,
+        test="tests/test_pallas.py::test_paged_decode_matches_ref",
+        description="one-token decode attention against the HBM page "
+                    "pool, double-buffered page DMA",
+    ),
+    KernelSpec(
+        name="prefix_chunk",
+        reference="attention:_prefix_chunk_ref",
+        dispatch="attention_prefix_chunk",
+        rtol=3e-2, atol=3e-2,
+        test="tests/test_pallas.py::test_prefix_chunk_kernel_matches_jnp",
+        description="chunked-prefill attention: prefix pages streamed "
+                    "from HBM, the chunk's own K/V resident",
+    ),
+    KernelSpec(
+        name="ragged_attention",
+        reference="attention:ragged_paged_attention_ref",
+        dispatch="attention_ragged",
+        rtol=3e-2, atol=3e-2,
+        test="tests/test_ragged_attention.py::"
+             "test_ragged_kernel_mixed_batch_matches_ref",
+        description="unified ragged paged attention: one launch serving "
+                    "chunked prefill, decode, and spec-verify tiles "
+                    "(int8 pools via the dequant epilogue)",
+    ),
+    KernelSpec(
+        name="paged_write_decode",
+        reference="kvcache:write_decode",
+        dispatch="write_decode",
+        rtol=0.0, atol=0.0,
+        test="tests/test_pallas.py::test_paged_write_decode_matches_scatter",
+        description="in-place per-row KV pool write (decode / flattened "
+                    "spec-verify rows), DMA instead of XLA scatter",
+    ),
+    KernelSpec(
+        name="paged_write_chunk",
+        reference="kvcache:write_prefill",
+        dispatch="write_prefill",
+        rtol=0.0, atol=0.0,
+        test="tests/test_pallas.py::"
+             "test_paged_write_chunk_matches_scatter_valid_region",
+        description="in-place whole-page KV pool write for one slot's "
+                    "prefill chunk, all layers",
+    ),
+)
+
+# Dispatch labels with NO kernel of their own: jnp-only dispatchers whose
+# kernel leg routes through another registered kernel (verify loops over
+# prefix_chunk per slot; write_multi flattens onto paged_write_decode).
+# The kernel-parity rule requires the union of KERNELS dispatch labels
+# and this table to equal the set of record_kernel_path(...) literals in
+# ops/ exactly, both ways.
+EXTRA_DISPATCH_LABELS: dict[str, str] = {
+    "attention_verify": "per-slot loop over the prefix_chunk kernel "
+                        "(a fused tree-verify kernel can replace it "
+                        "without touching callers)",
+    "write_multi": "multi-token append flattened onto paged_write_decode",
+}
+
+
+def kernel_names() -> tuple[str, ...]:
+    return tuple(k.name for k in KERNELS)
+
+
+def dispatch_labels() -> frozenset[str]:
+    """Every legal gridllm_kernel_dispatch_total op label."""
+    return frozenset(k.dispatch for k in KERNELS) | frozenset(
+        EXTRA_DISPATCH_LABELS)
+
+
+def by_dispatch(label: str) -> tuple[KernelSpec, ...]:
+    return tuple(k for k in KERNELS if k.dispatch == label)
+
+
+def tolerance(label: str) -> tuple[float, float]:
+    """(rtol, atol) the numerics sanitizer applies to a dispatch label —
+    the loosest bound among the kernels sharing it (they share a
+    reference when they share a label)."""
+    specs = by_dispatch(label)
+    if not specs:
+        raise KeyError(f"unknown kernel dispatch label {label!r}")
+    return (max(k.rtol for k in specs), max(k.atol for k in specs))

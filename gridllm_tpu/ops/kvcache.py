@@ -30,6 +30,7 @@ from typing import Any
 import jax
 import jax.numpy as jnp
 
+from gridllm_tpu.analysis import numcheck
 from gridllm_tpu.obs import default_registry
 from gridllm_tpu.utils.config import env_str
 
@@ -151,11 +152,18 @@ def kernel_mesh_axis(mesh, kvh: int, h: int | None = None):
 def _shard_map_kernel(mesh, body, in_specs, out_specs):
     """jax.shard_map for a kernel body: full-manual (all axes), with vma
     checking off — pallas_call can't annotate how outputs vary across
-    mesh axes, and the bodies here have no collectives to get wrong."""
-    return jax.shard_map(
-        body, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-        check_vma=False,
-    )
+    mesh axes, and the bodies here have no collectives to get wrong.
+    Resolves whichever spelling this jax ships: the stable ``jax
+    .shard_map`` (``check_vma``) or the older experimental one
+    (``check_rep``)."""
+    sm = getattr(jax, "shard_map", None)
+    if sm is not None:
+        return sm(body, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_vma=False)
+    from jax.experimental.shard_map import shard_map
+
+    return shard_map(body, mesh=mesh, in_specs=in_specs,
+                     out_specs=out_specs, check_rep=False)
 
 
 def flat_lanes_ok(kvh: int, d: int) -> bool:
@@ -468,6 +476,9 @@ def write_decode_all(
     next to attention — and Mosaic's int8 sublane tiling on sub-lane-row
     DMA destinations is unproven on real hardware).
     """
+    # numerics sanitizer: a NaN/Inf KV row poisons every later read of
+    # its page — trip at the write boundary, not in a garbled stream
+    numcheck.check_finite("kv.write", k_new, v_new)
     if isinstance(k_pages, QuantPages):
         k_new, v_new = _pad_new_lanes(k_pages, k_new, v_new)
         s = jnp.arange(page_table.shape[0], dtype=jnp.int32)
@@ -555,6 +566,7 @@ def write_multi_all(
     int8 pools (QuantPages): the flattened rows quantize per row and the
     scales scatter alongside, exactly like write_decode_all.
     """
+    numcheck.check_finite("kv.write", k_new, v_new)
     if isinstance(k_pages, QuantPages):
         k_new, v_new = _pad_new_lanes(k_pages, k_new, v_new)
         n_layers, s, t = k_new.shape[:3]
@@ -664,6 +676,7 @@ def write_prefill_all(
     int8 pools (QuantPages): per-row quantize + scale scatter, like
     write_decode_all (scatter path — see the rationale there).
     """
+    numcheck.check_finite("kv.write", k_new, v_new)
     if isinstance(k_pages, QuantPages):
         k_new, v_new = _pad_new_lanes(k_pages, k_new, v_new)
         t = jnp.arange(k_new.shape[1], dtype=jnp.int32)

@@ -42,6 +42,19 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# jax renamed TPUCompilerParams -> CompilerParams across the versions this
+# repo supports; resolve whichever this jaxlib ships
+_COMPILER_PARAMS = getattr(pltpu, "CompilerParams", None) or getattr(
+    pltpu, "TPUCompilerParams")
+
+# masking sentinel for the online-softmax paths (same value as
+# ops/attention.py's _NEG_INF): large enough that exp(x - m) underflows
+# to exactly 0 for masked columns, small enough to stay finite in f32 —
+# every kernel computes logits in f32, so the value is a deliberate
+# dtype commitment (it would overflow f16; dtype-discipline keeps it
+# named so the policy is auditable here, once)
+_NEG_INF = -1e30
+
 
 # ---------------------------------------------------------------------------
 # flash prefill
@@ -91,7 +104,7 @@ def _flash_prefill_kernel(
         mask = (dist >= 0) & (k_pos < seq_len) & (
             (window <= 0) | (dist < window)
         )
-        logits = jnp.where(mask, logits, -1e30)
+        logits = jnp.where(mask, logits, _NEG_INF)
 
         m_new = jnp.maximum(m, logits.max(axis=1, keepdims=True))
         alpha = jnp.exp(m - m_new)
@@ -103,7 +116,7 @@ def _flash_prefill_kernel(
         )
         return m_new, l_new, acc_new
 
-    m0 = jnp.full((bq * g, 1), -1e30, jnp.float32)
+    m0 = jnp.full((bq * g, 1), _NEG_INF, jnp.float32)
     l0 = jnp.zeros((bq * g, 1), jnp.float32)
     acc0 = jnp.zeros((bq * g, d), jnp.float32)
     _, l, acc = jax.lax.fori_loop(kb0, nk, body, (m0, l0, acc0))
@@ -202,7 +215,7 @@ def _flash_prefill_stream_kernel(
 
     @pl.when(kb == 0)
     def _():
-        m_scr[...] = jnp.full_like(m_scr, -1e30)
+        m_scr[...] = jnp.full_like(m_scr, _NEG_INF)
         l_scr[...] = jnp.zeros_like(l_scr)
         acc_scr[...] = jnp.zeros_like(acc_scr)
 
@@ -232,7 +245,7 @@ def _flash_prefill_stream_kernel(
         mask = (dist >= 0) & (k_pos < seq_len) & (
             (window <= 0) | (dist < window)
         )
-        logits = jnp.where(mask, logits, -1e30)
+        logits = jnp.where(mask, logits, _NEG_INF)
 
         m, l, acc = m_scr[...], l_scr[...], acc_scr[...]
         m_new = jnp.maximum(m, logits.max(axis=1, keepdims=True))
@@ -300,7 +313,7 @@ def flash_prefill_streamed(
                 pltpu.VMEM((bq * g, d), jnp.float32),
             ],
             interpret=interpret,
-            compiler_params=pltpu.CompilerParams(
+            compiler_params=_COMPILER_PARAMS(
                 dimension_semantics=("parallel", "parallel", "arbitrary"),
             ),
         )(jnp.stack([ln, wn]).reshape(1, 2), qb.reshape(t, kvh, g, d),
@@ -415,7 +428,7 @@ def _paged_decode_kernel(
         valid = (pos < length) & (
             (window <= 0) | (qpos - pos < window)
         )
-        logits = jnp.where(valid, logits, -1e30)
+        logits = jnp.where(valid, logits, _NEG_INF)
 
         m_new = jnp.maximum(m, logits.max(axis=2, keepdims=True))
         alpha = jnp.exp(m - m_new)
@@ -431,7 +444,7 @@ def _paged_decode_kernel(
         ])
         return m_new, l_new, acc_new
 
-    m0 = jnp.full((kvh, g, 1), -1e30, jnp.float32)
+    m0 = jnp.full((kvh, g, 1), _NEG_INF, jnp.float32)
     l0 = jnp.zeros((kvh, g, 1), jnp.float32)
     acc0 = jnp.zeros((kvh, g, d), jnp.float32)
     if merge_cur:
@@ -648,7 +661,7 @@ def _prefix_chunk_kernel(
         valid = (pos < start) & (
             (window <= 0) | (q_abs - pos < window)
         )
-        logits = jnp.where(valid, logits, -1e30)
+        logits = jnp.where(valid, logits, _NEG_INF)
 
         m_new = jnp.maximum(m, logits.max(axis=2, keepdims=True))
         alpha = jnp.exp(m - m_new)
@@ -664,7 +677,7 @@ def _prefix_chunk_kernel(
         ])
         return m_new, l_new, acc_new
 
-    m0 = jnp.full((kvh, bq * g, 1), -1e30, jnp.float32)
+    m0 = jnp.full((kvh, bq * g, 1), _NEG_INF, jnp.float32)
     l0 = jnp.zeros((kvh, bq * g, 1), jnp.float32)
     acc0 = jnp.zeros((kvh, bq * g, d), jnp.float32)
     m, l, acc = jax.lax.fori_loop(p0, n_pref, pref_body, (m0, l0, acc0))
@@ -698,7 +711,7 @@ def _prefix_chunk_kernel(
         valid = (dist >= 0) & (start + krel < total) & (
             (window <= 0) | (dist < window)
         )
-        logits = jnp.where(valid, logits, -1e30)
+        logits = jnp.where(valid, logits, _NEG_INF)
 
         m_new = jnp.maximum(m, logits.max(axis=2, keepdims=True))
         alpha = jnp.exp(m - m_new)
@@ -968,7 +981,7 @@ def _ragged_attn_kernel(
             valid = (pos < ctx_limit) & (
                 (window <= 0) | (q_abs[None, :, None] - pos < window)
             )
-            logits = jnp.where(valid, logits, -1e30)
+            logits = jnp.where(valid, logits, _NEG_INF)
 
             m_new = jnp.maximum(m, logits.max(axis=2, keepdims=True))
             alpha = jnp.exp(m - m_new)
@@ -997,7 +1010,7 @@ def _ragged_attn_kernel(
         q_rel = i * bq + jax.lax.broadcasted_iota(jnp.int32, (r,), 0) // g
         q_abs = start + q_rel
 
-        m0 = jnp.full((kvh, r, 1), -1e30, jnp.float32)
+        m0 = jnp.full((kvh, r, 1), _NEG_INF, jnp.float32)
         l0 = jnp.zeros((kvh, r, 1), jnp.float32)
         acc0 = jnp.zeros((kvh, r, dp), jnp.float32)
         m, l, acc = attend_pages(
@@ -1033,7 +1046,7 @@ def _ragged_attn_kernel(
             valid = (dist >= 0) & (start + krel < total) & (
                 (window <= 0) | (dist < window)
             )
-            logits = jnp.where(valid, logits, -1e30)
+            logits = jnp.where(valid, logits, _NEG_INF)
 
             m_new = jnp.maximum(m, logits.max(axis=2, keepdims=True))
             alpha = jnp.exp(m - m_new)
@@ -1065,7 +1078,7 @@ def _ragged_attn_kernel(
         tok = jax.lax.broadcasted_iota(jnp.int32, (r,), 0) // g
         q_abs = length + tok
 
-        m0 = jnp.full((kvh, r, 1), -1e30, jnp.float32)
+        m0 = jnp.full((kvh, r, 1), _NEG_INF, jnp.float32)
         l0 = jnp.zeros((kvh, r, 1), jnp.float32)
         acc0 = jnp.zeros((kvh, r, dp), jnp.float32)
         m, l, acc = attend_pages(
@@ -1092,7 +1105,7 @@ def _ragged_attn_kernel(
         col = jax.lax.broadcasted_iota(jnp.int32, (kvh, r, td), 2)
         dist = tok[None, :, None] - col
         valid = (dist >= 0) & ((window <= 0) | (dist < window))
-        logits = jnp.where(valid, logits, -1e30)
+        logits = jnp.where(valid, logits, _NEG_INF)
         m_new = jnp.maximum(m, logits.max(axis=2, keepdims=True))
         alpha = jnp.exp(m - m_new)
         prob = jnp.exp(logits - m_new)

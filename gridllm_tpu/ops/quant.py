@@ -59,11 +59,11 @@ class QuantizedTensor:
 def quantize_array(w, contract_axis: int = -2) -> QuantizedTensor:
     """Per-out-channel symmetric int8 over the contracted axis (default:
     second-to-last, matching the [in, out] / [L, in, out] weight layout)."""
-    w = jnp.asarray(w)
-    amax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=contract_axis)
+    w = jnp.asarray(w, jnp.float32)
+    amax = jnp.max(jnp.abs(w), axis=contract_axis)
     scale = jnp.maximum(amax / 127.0, 1e-12)
     q = jnp.clip(
-        jnp.round(w.astype(jnp.float32) / jnp.expand_dims(scale, contract_axis)),
+        jnp.round(w / jnp.expand_dims(scale, contract_axis)),
         -127, 127,
     ).astype(jnp.int8)
     return QuantizedTensor(q=q, scale=scale.astype(jnp.float32))

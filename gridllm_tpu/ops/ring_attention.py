@@ -19,11 +19,11 @@ K/V would need — the property that makes million-token contexts feasible
 
 from __future__ import annotations
 
-from functools import partial
-
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
+
+from gridllm_tpu.ops.kvcache import _shard_map_kernel
 
 _NEG_INF = -1e30
 
@@ -36,6 +36,11 @@ def _chunk_attention(q, k, v, q_start, k_start, seq_lens, carry):
     carry: (m [B,C,KVH,G,1], l [B,C,KVH,G,1], acc [B,C,KVH,G,D]).
     """
     m, l, acc = carry
+    # fp32 by the caller's contract (q pre-scaled, carries f32); the casts
+    # are no-ops there and enforce the policy for any other caller
+    q = q.astype(jnp.float32)
+    k = k.astype(jnp.float32)
+    v = v.astype(jnp.float32)
     c = q.shape[1]
     logits = jnp.einsum(
         "btkgd,bskd->btkgs", q, k, precision=jax.lax.Precision.HIGHEST
@@ -123,9 +128,11 @@ def ring_attention(
         out = acc / jnp.maximum(l, 1e-30)
         return out.reshape(b, c, kvh_l * g, d).astype(q_loc.dtype)
 
-    shard = partial(
-        jax.shard_map,
-        mesh=mesh,
+    # routed through the version-resolving wrapper (jax.shard_map/check_vma
+    # vs experimental shard_map/check_rep — ppermute's value motion defeats
+    # the replication check either way)
+    sm = _shard_map_kernel(
+        mesh, local,
         in_specs=(
             P(None, "sp", head_ax),
             P(None, "sp", head_ax),
@@ -133,6 +140,5 @@ def ring_attention(
             P(),
         ),
         out_specs=P(None, "sp", head_ax),
-        check_vma=False,  # ppermute's value motion defeats the rep check
     )
-    return shard(local)(q, k, v, seq_lens.astype(jnp.int32))
+    return sm(q, k, v, seq_lens.astype(jnp.int32))

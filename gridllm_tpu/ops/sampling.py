@@ -20,6 +20,8 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 
+from gridllm_tpu.analysis import numcheck
+
 # Sampling operates on the static top-K logits (full-vocab sort per step is
 # MXU-hostile); mass outside the top 128 is negligible for every supported
 # sampler setting (top_k clamps at TOPK — was 64 in round 3, lifted per
@@ -82,6 +84,9 @@ def _sampler_dists(
     accept/reject kernel) both build on this so the verified target
     distribution is EXACTLY the one the plain decode path samples from."""
     logits = logits.astype(jnp.float32)
+    # numerics sanitizer (GRIDLLM_SANITIZE=1): a NaN/Inf logit here is the
+    # first host-observable symptom of a diverged kernel upstream
+    numcheck.check_finite("sampler.logits", logits)
 
     if token_counts is not None:
         pen = params.repeat_penalty[:, None]
@@ -197,6 +202,9 @@ def spec_accept(
     — the last emitted token per slot, the next block's input; counts;
     window; wlen; params with step advanced)."""
     s, k1, _ = logits.shape
+    # verify logits arrive f32 by contract; the cast is a no-op there and
+    # pins the rejection-sampling math to f32 for any other caller
+    logits = logits.astype(jnp.float32)
     topk = min(TOPK, logits.shape[-1])
     greedy_mode = params.temperature <= 0.0
     # draft checked at scan step j is candidates[:, j+1]; the last step

@@ -58,6 +58,24 @@ from gridllm_tpu.ops.layers import rms_norm
 Params = dict
 
 
+def _pp_shard_map(mesh, in_specs, out_specs):
+    """Decorator for the pp token-passing programs: manual over {"pp"}
+    only, tp/ep/sp/dp stay AUTO (GSPMD). Resolves whichever shard_map
+    this jax ships — the stable ``jax.shard_map`` (``axis_names`` +
+    ``check_vma``) or the older experimental one (``auto`` = the
+    non-manual axes, ``check_rep``)."""
+    sm = getattr(jax, "shard_map", None)
+    if sm is not None:
+        return partial(sm, mesh=mesh, axis_names={"pp"},
+                       in_specs=in_specs, out_specs=out_specs,
+                       check_vma=False)
+    from jax.experimental.shard_map import shard_map
+
+    return partial(shard_map, mesh=mesh, in_specs=in_specs,
+                   out_specs=out_specs, check_rep=False,
+                   auto=frozenset(mesh.axis_names) - {"pp"})
+
+
 def pp_size(mesh) -> int:
     return int(mesh.shape.get("pp", 1)) if mesh is not None else 1
 
@@ -154,13 +172,10 @@ def decode_step(
     )
     microbatched = s % pp == 0 and s >= pp
 
-    @partial(
-        jax.shard_map,
-        mesh=mesh,
-        axis_names={"pp"},
+    @_pp_shard_map(
+        mesh,
         in_specs=(_stage_specs(params), P(), P("pp"), P("pp"), P(), P(), P()),
         out_specs=(P(), P("pp"), P("pp")),
-        check_vma=False,
     )
     def run(params, tokens, k_pool, v_pool, page_table, positions, active):
         x = params["embed"][tokens]  # [S, E] — every stage embeds
@@ -187,13 +202,10 @@ def decode_step(
         logits = llama._unembed(cfg, params, x)
         return logits, k_pool, v_pool
 
-    @partial(
-        jax.shard_map,
-        mesh=mesh,
-        axis_names={"pp"},
+    @_pp_shard_map(
+        mesh,
         in_specs=(_stage_specs(params), P(), P("pp"), P("pp"), P(), P(), P()),
         out_specs=(P(), P("pp"), P("pp")),
-        check_vma=False,
     )
     def run_mb(params, tokens, k_pool, v_pool, page_table, positions,
                active):
@@ -292,16 +304,13 @@ def prefill(
         raise ValueError("pp prefill has no sp/ring-attention variant")
     pp = pp_size(mesh)
 
-    @partial(
-        jax.shard_map,
-        mesh=mesh,
-        axis_names={"pp"},
+    @_pp_shard_map(
+        mesh,
         in_specs=(
             _stage_specs(params), P(), P(),
             P("pp"), P("pp"), P(), P(),
         ),
         out_specs=(P(), P("pp"), P("pp")),
-        check_vma=False,
     )
     def run(params, tokens, embeds_or_tokens, k_pool, v_pool, length,
             table_row):
@@ -355,15 +364,12 @@ def prefill_chunk(
     """PP chunked prefill — same contract as llama.prefill_chunk."""
     pp = pp_size(mesh)
 
-    @partial(
-        jax.shard_map,
-        mesh=mesh,
-        axis_names={"pp"},
+    @_pp_shard_map(
+        mesh,
         in_specs=(
             _stage_specs(params), P(), P(), P("pp"), P("pp"), P(), P(), P(),
         ),
         out_specs=(P(), P("pp"), P("pp")),
-        check_vma=False,
     )
     def run(params, tokens, embeds_or_tokens, k_pool, v_pool, start,
             length, table_row):

@@ -376,6 +376,14 @@ register_env("GRIDLLM_SANITIZE", "0",
              "Runtime lock-discipline sanitizer: instrument Lock/RLock "
              "acquires, build the lock-order graph, fail tests on cycles "
              "or unlocked allocator mutation.")
+register_env("GRIDLLM_NUMCHECK_SAMPLE", "0.05",
+             "Numerics sanitizer (on the GRIDLLM_SANITIZE switch): "
+             "fraction of kernel dispatches shadow-executed against their "
+             "jnp reference at the KERNELS-registry tolerance (1.0 = every "
+             "dispatch; CI numcheck-smoke forces 1.0).")
+register_env("GRIDLLM_NUMCHECK_SEED", "0",
+             "Seed for the numerics sanitizer's per-op sampling streams; "
+             "decisions are a pure function of (seed, op, trace #).")
 
 
 def _env(name: str, default: Any) -> Any:

@@ -55,7 +55,7 @@ import pytest  # noqa: E402
 # The shared-state sanitizer (ISSUE 13) rides the same switch: scheduler/
 # registry/allocator register their hot state for cross-thread
 # unguarded-write tracking, judged at session end alongside the graph.
-from gridllm_tpu.analysis import lockcheck, statecheck  # noqa: E402
+from gridllm_tpu.analysis import lockcheck, numcheck, statecheck  # noqa: E402
 
 if lockcheck.enabled():
     lockcheck.install()
@@ -86,6 +86,24 @@ def pytest_sessionfinish(session, exitstatus):
     print(f"GRIDLLM_SANITIZE: shared-state writes clean "
           f"({state['observed_attrs']} tracked attrs, "
           f"{state['tracked_objects']} live objects)")
+    # numerics sanitizer (gridcheck v3): shadowed kernel dispatches must
+    # stay inside the KERNELS-registry tolerances and tripwired arrays
+    # finite — same exit-3 contract as the two checks above
+    num = numcheck.report()
+    if not num["ok"]:
+        lines = "\n  ".join(
+            f"{v['op']}: {v['kind']} " + (
+                f"excess {v['excess']:.3e} (max err {v['max_err']:.3e}, "
+                f"rtol={v['rtol']} atol={v['atol']})"
+                if v["kind"] == "tolerance"
+                else f"{v['bad_elements']} non-finite elements")
+            for v in num["violations"])
+        print(f"\nGRIDLLM_SANITIZE: kernel numerics violation(s):\n  {lines}")
+        pytest.exit("numerics violation detected by the sanitizer",
+                    returncode=3)
+    print(f"GRIDLLM_SANITIZE: kernel numerics clean "
+          f"({num['shadowed_dispatches']} shadowed dispatches, "
+          f"{num['finite_checks']} finite tripwires)")
 
 
 @pytest.fixture

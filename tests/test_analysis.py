@@ -626,6 +626,297 @@ def test_new_rules_cli_rule_filtering(tmp_path):
                      "fault-coverage"}, payload["findings"]
 
 
+# -- kernel-parity (gridcheck v3) -------------------------------------------
+
+# a self-consistent fixture kernel surface: registry + kernel module +
+# reference + test + README table; individual tests then break one leg
+_FIXTURE_KERNEL_REGISTRY = (
+    "KERNELS = (\n"
+    "    KernelSpec(\n"
+    "        name='my_kernel', reference='attention:my_ref',\n"
+    "        dispatch='my_op', rtol=1e-2, atol=1e-2,\n"
+    "        test='tests/test_my.py::test_my_kernel_matches_ref',\n"
+    "        description='fixture'),\n"
+    ")\n"
+    "EXTRA_DISPATCH_LABELS = {}\n"
+)
+_FIXTURE_KERNEL_FILES = {
+    "gridllm_tpu/ops/kernels.py": _FIXTURE_KERNEL_REGISTRY,
+    "gridllm_tpu/ops/pallas_kernels.py": (
+        "from jax.experimental import pallas as pl\n"
+        "def my_kernel(x):\n"
+        "    return pl.pallas_call(None)(x)\n"
+    ),
+    "gridllm_tpu/ops/attention.py": (
+        "from gridllm_tpu.ops.kvcache import record_kernel_path\n"
+        "def my_ref(x):\n"
+        "    return x\n"
+        "def dispatch(x):\n"
+        "    record_kernel_path('my_op', True)\n"
+        "    return x\n"
+    ),
+    "tests/test_my.py": (
+        "def test_my_kernel_matches_ref():\n"
+        "    pass\n"
+    ),
+}
+_FIXTURE_KERNEL_README = (
+    "\n## Kernels\n\n"
+    "| Kernel | Reference | Dispatch | Tolerance | Test |\n"
+    "|---|---|---|---|---|\n"
+    "| `my_kernel` | `my_ref` | `my_op` | `1e-2 / 1e-2` | "
+    "`tests/test_my.py::test_my_kernel_matches_ref` |\n"
+)
+
+
+def _kernel_repo(tmp_path, **overrides):
+    files = {**_FIXTURE_KERNEL_FILES,
+             "README.md": _full_env_table() + _FIXTURE_KERNEL_README}
+    files.update(overrides)
+    return make_repo(tmp_path, files)
+
+
+def test_kernel_parity_clean_fixture(tmp_path):
+    root = _kernel_repo(tmp_path)
+    assert findings_for(root, "kernel-parity") == []
+
+
+def test_kernel_parity_fires_on_unregistered_pallas_call(tmp_path):
+    # fallback direction (no fixture registry): the imported KERNELS is
+    # the source of truth and the stray pallas_call is flagged
+    root = make_repo(tmp_path, {"gridllm_tpu/ops/rogue.py": (
+        "from jax.experimental import pallas as pl\n"
+        "def rogue_kernel(x):\n"
+        "    return pl.pallas_call(None)(x)\n"
+    )})
+    msgs = [f.message for f in findings_for(root, "kernel-parity")]
+    assert any("rogue_kernel" in m and "not a registered kernel" in m
+               for m in msgs), msgs
+
+
+def test_kernel_parity_fires_on_unregistered_call_with_registry(tmp_path):
+    root = _kernel_repo(tmp_path, **{
+        "gridllm_tpu/ops/pallas_kernels.py":
+            _FIXTURE_KERNEL_FILES["gridllm_tpu/ops/pallas_kernels.py"] + (
+                "def stray(x):\n"
+                "    return pl.pallas_call(None)(x)\n"),
+    })
+    msgs = [f.message for f in findings_for(root, "kernel-parity")]
+    assert any("stray" in m and "not a registered kernel" in m
+               for m in msgs), msgs
+
+
+def test_kernel_parity_fires_on_stale_registry_row(tmp_path):
+    # registered kernel whose entry fn lost its pallas_call (and one
+    # that does not exist at all)
+    root = _kernel_repo(tmp_path, **{
+        "gridllm_tpu/ops/pallas_kernels.py": (
+            "def my_kernel(x):\n"
+            "    return x\n"),
+    })
+    msgs = [f.message for f in findings_for(root, "kernel-parity")]
+    assert any("no pl.pallas_call" in m for m in msgs), msgs
+
+
+def test_kernel_parity_fires_on_missing_reference_and_test(tmp_path):
+    root = _kernel_repo(tmp_path, **{
+        "gridllm_tpu/ops/attention.py": (
+            "from gridllm_tpu.ops.kvcache import record_kernel_path\n"
+            "def dispatch(x):\n"
+            "    record_kernel_path('my_op', True)\n"
+            "    return x\n"),
+        "tests/test_my.py": "def test_something_else():\n    pass\n",
+    })
+    msgs = [f.message for f in findings_for(root, "kernel-parity")]
+    assert any("does not resolve to a function" in m for m in msgs), msgs
+    assert any("not found in tests/test_my.py" in m for m in msgs), msgs
+
+
+def test_kernel_parity_fires_on_dispatch_label_drift_both_ways(tmp_path):
+    # recorded label the registry doesn't know + declared label nobody
+    # records
+    root = _kernel_repo(tmp_path, **{
+        "gridllm_tpu/ops/attention.py": (
+            "from gridllm_tpu.ops.kvcache import record_kernel_path\n"
+            "def my_ref(x):\n"
+            "    return x\n"
+            "def dispatch(x):\n"
+            "    record_kernel_path('mystery_op', True)\n"
+            "    return x\n"),
+    })
+    msgs = [f.message for f in findings_for(root, "kernel-parity")]
+    assert any("'mystery_op' is not declared" in m for m in msgs), msgs
+    assert any("'my_op' is never recorded" in m for m in msgs), msgs
+
+
+def test_kernel_parity_fires_on_readme_drift_both_ways(tmp_path):
+    phantom = (
+        "\n## Kernels\n\n"
+        "| Kernel | Reference | Dispatch | Tolerance | Test |\n"
+        "|---|---|---|---|---|\n"
+        "| `ghost_kernel` | `x` | `y` | `1 / 1` | `t` |\n"
+    )
+    root = _kernel_repo(tmp_path,
+                        **{"README.md": _full_env_table() + phantom})
+    msgs = [f.message for f in findings_for(root, "kernel-parity")]
+    assert any("ghost_kernel" in m and "not registered" in m
+               for m in msgs), msgs
+    assert any("'my_kernel' missing from the README" in m
+               for m in msgs), msgs
+
+
+def test_kernel_parity_fires_on_readme_cell_drift(tmp_path):
+    wrong_tol = _FIXTURE_KERNEL_README.replace("`1e-2 / 1e-2`",
+                                               "`5e-1 / 5e-1`")
+    root = _kernel_repo(tmp_path,
+                        **{"README.md": _full_env_table() + wrong_tol})
+    msgs = [f.message for f in findings_for(root, "kernel-parity")]
+    assert any("tolerance cell" in m for m in msgs), msgs
+    # the Differential-test column is part of the contract too
+    wrong_test = _FIXTURE_KERNEL_README.replace(
+        "`tests/test_my.py::test_my_kernel_matches_ref`",
+        "`tests/test_my.py::test_totally_wrong_name`")
+    root2 = _kernel_repo(tmp_path / "t2",
+                         **{"README.md": _full_env_table() + wrong_test})
+    msgs2 = [f.message for f in findings_for(root2, "kernel-parity")]
+    assert any("column 5" in m and "test_totally_wrong_name" in m
+               for m in msgs2), msgs2
+
+
+# -- dtype-discipline (gridcheck v3) ----------------------------------------
+
+def test_dtype_discipline_fires_on_dtype_less_construction(tmp_path):
+    root = make_repo(tmp_path, {"gridllm_tpu/ops/mod.py": (
+        "import jax.numpy as jnp\n"
+        "X = jnp.asarray([1, 2])\n"
+        "Y = jnp.array([1.0])\n"
+        "Z = jnp.asarray([3], jnp.int32)\n"
+    )})
+    msgs = [f.message for f in findings_for(root, "dtype-discipline")]
+    assert sum("dtype-less" in m for m in msgs) == 2, msgs
+
+
+def test_dtype_discipline_fires_on_unpinned_accumulation(tmp_path):
+    root = make_repo(tmp_path, {"gridllm_tpu/ops/mod.py": (
+        "import jax\nimport jax.numpy as jnp\n"
+        "def f(a, b):\n"
+        "    x = jax.lax.dot_general(a, b, (((1,), (0,)), ((), ())))\n"
+        "    y = jnp.einsum('ij,jk->ik', a, b)\n"
+        "    return x + y\n"
+    )})
+    msgs = [f.message for f in findings_for(root, "dtype-discipline")]
+    assert any("dot_general without preferred_element_type" in m
+               for m in msgs), msgs
+    assert any("einsum without precision" in m for m in msgs), msgs
+
+
+def test_dtype_discipline_fires_on_unanchored_softmax(tmp_path):
+    root = make_repo(tmp_path, {"gridllm_tpu/ops/mod.py": (
+        "import jax.numpy as jnp\n"
+        "def bad(x):\n"
+        "    return jnp.exp(x - x.max())\n"
+        "def good(x):\n"
+        "    return jnp.exp(x.astype(jnp.float32))\n"
+    )})
+    msgs = [f.message for f in findings_for(root, "dtype-discipline")]
+    assert any("bad() computes exp/softmax" in m for m in msgs), msgs
+    assert not any("good()" in m for m in msgs), msgs
+
+
+def test_dtype_discipline_fires_on_inline_sentinel(tmp_path):
+    root = make_repo(tmp_path, {"gridllm_tpu/ops/mod.py": (
+        "import jax.numpy as jnp\n"
+        "NEG = -1e30\n"
+        "ANN: float = -1e30\n"  # annotated module constant: also allowed
+        "def f(x, mask):\n"
+        "    return jnp.where(mask, x, -1e30)\n"
+    )})
+    findings = findings_for(root, "dtype-discipline")
+    assert len(findings) == 1 and "inline mask sentinel" in \
+        findings[0].message, findings
+    assert findings[0].line == 5
+
+
+def test_dtype_discipline_fires_on_unpaired_quantpages_data(tmp_path):
+    root = make_repo(tmp_path, {"gridllm_tpu/ops/mod.py": (
+        "from gridllm_tpu.ops.kvcache import QuantPages\n"
+        "def bad(p):\n"
+        "    if isinstance(p, QuantPages):\n"
+        "        return p.data\n"
+        "    return p\n"
+        "def good(p):\n"
+        "    if isinstance(p, QuantPages):\n"
+        "        return p.data, p.scale\n"
+        "    return p\n"
+    )})
+    msgs = [f.message for f in findings_for(root, "dtype-discipline")]
+    assert any("bad() consumes QuantPages p.data" in m for m in msgs), msgs
+    assert not any("good()" in m for m in msgs), msgs
+
+
+def test_dtype_discipline_waiver(tmp_path):
+    root = make_repo(tmp_path, {"gridllm_tpu/ops/mod.py": (
+        "import jax.numpy as jnp\n"
+        "X = jnp.asarray([1, 2])  # dtype-ok\n"
+    )})
+    assert findings_for(root, "dtype-discipline") == []
+
+
+# -- host-sync-discipline (gridcheck v3) ------------------------------------
+
+_FIXTURE_ENGINE_LOOPS = (
+    "import numpy as np\n"
+    "import jax\n"
+    "class Engine:\n"
+    "    def _ingest_block(self, out):\n"
+    "        raw = np.asarray(jax.device_get(out))\n"
+    "        return raw\n"
+    "    def _dispatch_block(self, k):\n"
+    "        return int(self.tokens[0])\n"
+    "    def _fetch_oldest(self):\n"
+    "        return np.asarray(self.x)  # sync-ok\n"
+    "    def helper(self):\n"
+    "        return self.y.item()\n"
+)
+
+
+def test_host_sync_fires_inside_loop_functions(tmp_path):
+    root = make_repo(tmp_path,
+                     {"gridllm_tpu/engine/engine.py": _FIXTURE_ENGINE_LOOPS})
+    findings = findings_for(root, "host-sync-discipline")
+    msgs = [f.message for f in findings]
+    assert any("_ingest_block" in m and "np.asarray" in m for m in msgs), msgs
+    assert any("_ingest_block" in m and "device_get" in m for m in msgs), msgs
+    assert any("_dispatch_block" in m and "int()" in m for m in msgs), msgs
+    # the declared sync point and the out-of-scope helper are exempt
+    assert not any("inside _fetch_oldest()" in m for m in msgs), msgs
+    assert not any("helper" in m for m in msgs), msgs
+
+
+def test_host_sync_flags_stale_waiver(tmp_path):
+    root = make_repo(tmp_path, {"gridllm_tpu/engine/engine.py": (
+        "class Engine:\n"
+        "    def _ingest_block(self, out):\n"
+        "        x = 1  # sync-ok\n"
+        "        return x\n"
+    )})
+    msgs = [f.message for f in findings_for(root, "host-sync-discipline")]
+    assert any("stale waiver" in m for m in msgs), msgs
+
+
+def test_host_sync_item_and_block_until_ready(tmp_path):
+    root = make_repo(tmp_path, {"gridllm_tpu/engine/engine.py": (
+        "class Engine:\n"
+        "    def step(self):\n"
+        "        v = self.out.item()\n"
+        "        self.out.block_until_ready()\n"
+        "        return v\n"
+    )})
+    msgs = [f.message for f in findings_for(root, "host-sync-discipline")]
+    assert any(".item()" in m for m in msgs), msgs
+    assert any("block_until_ready" in m for m in msgs), msgs
+
+
 # -- helpers ----------------------------------------------------------------
 
 def test_expand_braces():
@@ -647,11 +938,17 @@ def test_readme_table_metrics_parses_rows_only():
 # -- the actual gate --------------------------------------------------------
 
 def test_self_run_is_clean():
-    """Zero findings over this repo: the invariant set the analyzer
-    encodes HOLDS, and stays held — any regression fails here (and in
-    the tier-1 static-analysis CI job) with a file:line reason."""
+    """Zero findings from exactly 12 registered rules over this repo:
+    the invariant set the analyzer encodes HOLDS, and stays held — any
+    regression fails here (and in the tier-1 static-analysis CI job)
+    with a file:line reason. The rule-count pin makes a silently
+    dropped rule module a failure too, not a quieter analyzer."""
+    from gridllm_tpu.analysis import RULES, load_rules
+
     findings = run(REPO_ROOT)
     assert findings == [], "\n" + "\n".join(f.render() for f in findings)
+    load_rules()
+    assert len(RULES) == 12, sorted(RULES)
 
 
 def test_cli_exit_codes_and_json(tmp_path):
