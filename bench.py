@@ -1137,6 +1137,223 @@ async def run_disagg_bench(model: str, n_requests: int, n_tokens: int,
     }
 
 
+async def run_fleet_bench(model: str, n_requests: int, n_tokens: int,
+                          max_slots: int, prompt_len: int) -> dict:
+    """Scaled-control-plane A/B (ISSUE 15): the same mixed stream load
+    served by (a) the single-box control plane — one in-process
+    scheduler+gateway — and (b) a 2-gateway/2-shard control plane
+    (GatewaySubmitter replicas publishing over ctrl:submit to
+    SchedulerShard partition owners) on the same bus, one unified worker
+    per arm. The headline: control-plane overhead under fan-out — tok/s
+    and p50 TTFT through the scaled plane vs the local one — plus the
+    shard dispatch split and lease transitions proving both partitions
+    actually carried load. Measured at the submit boundary so both arms
+    pay identical harness overhead."""
+
+    from gridllm_tpu.bus.memory import InMemoryBus
+    from gridllm_tpu.controlplane.client import GatewaySubmitter
+    from gridllm_tpu.controlplane.partition import shard_of
+    from gridllm_tpu.controlplane.shard import (
+        SchedulerShard,
+        wait_for_ownership,
+    )
+    from gridllm_tpu.engine import EngineConfig, InferenceEngine
+    from gridllm_tpu.scheduler import JobScheduler, WorkerRegistry
+    from gridllm_tpu.utils.config import (
+        ControlPlaneConfig,
+        SchedulerConfig,
+        WorkerConfig,
+    )
+    from gridllm_tpu.utils.types import InferenceRequest
+    from gridllm_tpu.worker.main import resolve_checkpoint
+    from gridllm_tpu.worker.service import WorkerService
+
+    ckpt, tok = resolve_checkpoint(
+        env_raw("GRIDLLM_CHECKPOINT_DIR"), model
+    )
+    tiny = model.startswith("tiny")
+
+    def make_engine() -> InferenceEngine:
+        return InferenceEngine(EngineConfig(
+            model=model,
+            checkpoint_path=ckpt,
+            tokenizer=tok,
+            max_slots=max_slots,
+            page_size=64,
+            num_pages=max(384, max_slots * 64),
+            max_pages_per_slot=8 if tiny else 48,
+            prefill_buckets=(64, 256, 1024),
+        ))
+
+    prompt = ("the quick brown fox jumps over the lazy dog; "
+              * (prompt_len // 10 + 1))[:max(prompt_len, 40)]
+    num_shards = 2
+
+    def id_for_shard(tag: str, i: int, idx: int) -> str:
+        # deterministic spread: both partitions must carry real load or
+        # the scaled arm silently degrades to a 1-shard measurement
+        while True:
+            jid = f"bench-{tag}{i}-{uuid.uuid4().hex[:6]}"
+            if shard_of(jid, num_shards) == idx:
+                return jid
+
+    async def run_arm(scaled: bool) -> dict:
+        bus = InMemoryBus()
+        await bus.connect()
+        cfg = SchedulerConfig()
+        shards: list[SchedulerShard] = []
+        registries: list[WorkerRegistry] = []
+        submitters: list = []
+        local_sched: JobScheduler | None = None
+        if scaled:
+            for i in range(num_shards):
+                reg = WorkerRegistry(bus, cfg)
+                sh = SchedulerShard(
+                    bus, reg, cfg,
+                    ControlPlaneConfig(num_shards=num_shards, shard_id=i,
+                                       lease_ttl_ms=2000,
+                                       renew_interval_ms=300),
+                    member_id=f"bench-shard-{i}", settle_s=0.01)
+                await reg.initialize()
+                await sh.start()
+                registries.append(reg)
+                shards.append(sh)
+            assert await wait_for_ownership(shards, num_shards)
+            for i in range(2):
+                reg = WorkerRegistry(bus, cfg, observer=True)
+                gw = GatewaySubmitter(bus, reg, cfg,
+                                      member_id=f"bench-gw-{i}")
+                await reg.initialize()
+                await gw.initialize()
+                registries.append(reg)
+                submitters.append(gw)
+        else:
+            reg = WorkerRegistry(bus, cfg)
+            local_sched = JobScheduler(bus, reg, cfg)
+            await reg.initialize()
+            await local_sched.initialize()
+            registries.append(reg)
+            submitters.append(local_sched)
+        svc = WorkerService(bus, {model: make_engine()},
+                            WorkerConfig(worker_id="bench-fleet-w0",
+                                         heartbeat_interval_ms=250),
+                            stream_flush_ms=5)
+        await svc.start()
+        await asyncio.sleep(0.4)  # registrations land on every registry
+        try:
+            tokens_out = [0]
+
+            async def one(i: int, jid: str, ttfts: list,
+                          itls: list | None) -> None:
+                sub = submitters[i % len(submitters)]
+                t0 = time.perf_counter()
+                marks: list[float] = []
+
+                async def on_chunk(_c) -> None:
+                    marks.append(time.perf_counter())
+
+                req = InferenceRequest(
+                    id=jid, model=model, prompt=f"[{i}] {prompt}",
+                    stream=True,
+                    options={"temperature": 0, "seed": i,
+                             "num_predict": n_tokens},
+                    metadata={"requestType": "inference"})
+                res = await sub.submit_streaming_job(req, on_chunk,
+                                                     timeout_ms=240_000)
+                assert res.success, res.error
+                n = int(res.response.eval_count or 0)
+                tokens_out[0] += n
+                if marks:
+                    ttfts.append(marks[0] - t0)
+                    if itls is not None and n > 1:
+                        itls.append((marks[-1] - marks[0]) / (n - 1) * 1000)
+
+            for w in range(2):  # warmup compiles; spread over partitions
+                await one(w, id_for_shard("W", w, w % num_shards), [],
+                          None)
+            tokens_out[0] = 0
+
+            ttfts: list[float] = []
+            itls: list[float] = []
+            jids = [id_for_shard("R", i, i % num_shards)
+                    for i in range(n_requests)]
+            t0 = time.perf_counter()
+            await asyncio.gather(*(one(i, jid, ttfts, itls)
+                                   for i, jid in enumerate(jids)))
+            wall = time.perf_counter() - t0
+            steady = sum(
+                p["steadyRecompiles"]
+                for p in svc.engines[model].perf.state().values())
+            arm = {
+                "plane": "2x2" if scaled else "1x1",
+                "tok_s": tokens_out[0] / wall,
+                "tokens": tokens_out[0],
+                "wall_s": wall,
+                "p50_ttft_ms": (statistics.median(ttfts) * 1000
+                                if ttfts else None),
+                "p95_ttft_ms": (None if _p95(ttfts) is None
+                                else _p95(ttfts) * 1000),
+                "p50_itl_ms": (statistics.median(itls)
+                               if itls else None),
+                "recompiles_steady": steady,
+            }
+            if scaled:
+                arm["shard_dispatched"] = [
+                    int(sh.scheduler._jobs_total.value(event="dispatched"))
+                    for sh in shards]
+                arm["lease_transitions"] = {
+                    ev: int(sum(sh.lease._transitions.value(event=ev)
+                                for sh in shards))
+                    for ev in ("acquired", "adopted", "deposed",
+                               "expired")}
+                arm["fenced_ops"] = int(sum(
+                    sh.scheduler._shard_fenced.value(op=op)
+                    for sh in shards
+                    for op in ("assign", "timeout", "orphan", "failure",
+                               "cancel", "drain", "preempt")))
+            return arm
+        finally:
+            try:
+                await svc.stop(announce=False)
+            except Exception:  # noqa: BLE001
+                pass
+            for gw in (s for s in submitters if s is not local_sched):
+                try:
+                    await gw.shutdown()
+                except Exception:  # noqa: BLE001
+                    pass
+            for sh in shards:
+                try:
+                    await sh.stop()
+                except Exception:  # noqa: BLE001
+                    pass
+            try:
+                if local_sched is not None:
+                    await local_sched.shutdown()
+                for reg in registries:
+                    await reg.shutdown()
+                await bus.disconnect()
+            except Exception:  # noqa: BLE001
+                pass
+
+    local = await run_arm(scaled=False)
+    scaled = await run_arm(scaled=True)
+    return {
+        # headline = the scaled plane (what --compare gates); the local
+        # arm rides in the payload for the A/B read
+        "tok_s": scaled["tok_s"],
+        "tokens": scaled["tokens"],
+        "wall_s": local["wall_s"] + scaled["wall_s"],
+        "p50_ttft_ms": scaled["p50_ttft_ms"],
+        "p95_ttft_ms": scaled["p95_ttft_ms"],
+        "p50_itl_ms": scaled["p50_itl_ms"],
+        "fleet": {"local": local, "scaled": scaled},
+        "perf": _perf_sidecar(),
+        "weights": "real-checkpoint" if ckpt
+        else "random-weights synthetic",
+    }
+
+
 async def run_embed_bench(model: str, n_requests: int,
                           batch: int = 64, rounds: int = 8) -> dict:
     """Embeddings QPS through the full stack (BASELINE config #5):
@@ -1357,6 +1574,12 @@ def main() -> int:
                          "split fleet with KV-page migration; reports both "
                          "arms' decode ITL and prefill TTFT plus migration "
                          "bytes/latency (ISSUE 7)")
+    ap.add_argument("--fleet", action="store_true",
+                    help="scaled-control-plane A/B: the same stream load "
+                         "through the single-box scheduler vs a "
+                         "2-gateway/2-shard control plane on one bus; "
+                         "reports both arms' tok/s and p50 TTFT plus the "
+                         "shard dispatch split (ISSUE 15)")
     ap.add_argument("--tiny", action="store_true",
                     help="tiny-llama CPU smoke test")
     ap.add_argument("--profile", metavar="DIR", default=None,
@@ -1394,6 +1617,14 @@ def main() -> int:
                         or args.mixed):
         ap.error("--disagg is its own generate scenario; drop "
                  "--embed/--shared-prefix/--spec/--mixed")
+    if args.fleet and (args.embed or args.shared_prefix or args.spec
+                       or args.mixed or args.disagg or args.long_context):
+        ap.error("--fleet is its own generate scenario; drop "
+                 "--embed/--shared-prefix/--spec/--mixed/--disagg/"
+                 "--long-context")
+    if args.fleet:
+        # both partitions must carry at least one measured stream each
+        args.requests = max(args.requests, 2)
     if args.disagg:
         # at least one stream per class, same clamp rationale as --mixed
         args.requests = max(args.requests, 2)
@@ -1436,6 +1667,8 @@ def main() -> int:
         args.tokens = min(args.tokens,
                           48 if (args.spec or args.mixed or args.disagg)
                           else 16)
+        if args.fleet:
+            args.tokens = min(args.tokens, 16)
         args.prompt_len = 20
         # the shared prefix must still span several KV pages (64-token
         # pages, byte tokenizer) or there is nothing to cache
@@ -1522,6 +1755,19 @@ def main() -> int:
                 f"split-fleet output tokens/sec via scheduler submit "
                 f"({args.model}, disaggregated prefill/decode A/B with "
                 f"KV-page migration, {args.requests} streams, "
+                f"{r['weights']})"
+            )
+        elif args.fleet:
+            r = asyncio.run(run_fleet_bench(
+                args.model, args.requests, args.tokens, args.slots,
+                args.prompt_len,
+            ))
+            baseline = A100_OLLAMA_TOK_S.get(args.model, 0.0)
+            value, unit = r["tok_s"], "tok/s"
+            metric_name = (
+                f"scaled-control-plane output tokens/sec via gateway-"
+                f"replica submit ({args.model}, 2 gateways / 2 scheduler "
+                f"shards vs single-box, {args.requests} streams, "
                 f"{r['weights']})"
             )
         elif args.mixed:
@@ -1688,6 +1934,16 @@ def main() -> int:
             payload["p50_ttft_ms"] = round(r["p50_ttft_ms"], 1)
         payload["disagg"] = r["disagg"]
         payload["tokens"] = r["tokens"]
+    elif args.fleet:
+        # the control-plane headline: the scaled plane's TTFT/tok_s vs
+        # the single-box arm (control-plane overhead under fan-out), and
+        # the shard dispatch split proving both partitions carried load
+        if r.get("p50_ttft_ms") is not None:
+            payload["p50_ttft_ms"] = round(r["p50_ttft_ms"], 1)
+        if r.get("p50_itl_ms") is not None:
+            payload["p50_itl_ms"] = round(r["p50_itl_ms"], 2)
+        payload["fleet"] = r["fleet"]
+        payload["tokens"] = r["tokens"]
     elif args.mixed:
         # the mixed-workload headline: the decode arm's ITL must survive
         # concurrent long prefills (single-launch mixed steps), and the
@@ -1729,7 +1985,8 @@ def main() -> int:
                 else "long-context" if args.long_context
                 else "spec" if args.spec
                 else "mixed" if args.mixed
-                else "disagg" if args.disagg else "generate")
+                else "disagg" if args.disagg
+                else "fleet" if args.fleet else "generate")
     record = build_record(scenario, args, payload, r)
     regressions: list = []
     if args.compare:

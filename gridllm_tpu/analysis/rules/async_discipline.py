@@ -41,6 +41,7 @@ SUBSYSTEMS = (
     "gridllm_tpu/worker/",
     "gridllm_tpu/bus/",
     "gridllm_tpu/transfer/",
+    "gridllm_tpu/controlplane/",
 )
 
 _BLOCKING_CALLS = {

@@ -251,6 +251,37 @@ register_channel(
     description="Suspend-to-host preemption report; the victim requeues "
                 "behind the higher-priority work.")
 register_channel(
+    "ctrl:submit", pattern="ctrl:submit", payload="keys",
+    keys=("request", "submitter"), durable=True,
+    publishers=("gridllm_tpu/controlplane/client.py",),
+    subscribers=("gridllm_tpu/controlplane/shard.py",),
+    helper="CH_CTRL_SUBMIT",
+    description="Gateway-replica job submission fan-out (ISSUE 15): "
+                "every scheduler shard consumes it and the one owning "
+                "shard_of(job id) enqueues; durable so a submission "
+                "published while a shard's subscriber reconnects "
+                "replays instead of vanishing.")
+register_channel(
+    "ctrl:cancel", pattern="ctrl:cancel", payload="keys",
+    keys=("jobId", "reason", "submitter"), durable=True,
+    publishers=("gridllm_tpu/controlplane/client.py",),
+    subscribers=("gridllm_tpu/controlplane/shard.py",),
+    helper="CH_CTRL_CANCEL",
+    description="Gateway-replica cancellation relay: the owning shard "
+                "runs its local cancel path (queued, retrying, or "
+                "active).")
+register_channel(
+    "ctrl:status", pattern="ctrl:status", payload="keys",
+    keys=("member", "role", "ts", "shards", "leases", "stats", "slo",
+          "queued", "active", "hangs"),
+    publishers=("gridllm_tpu/controlplane/status.py",),
+    subscribers=("gridllm_tpu/controlplane/status.py",),
+    helper="CH_CTRL_STATUS",
+    description="Periodic control-plane member status envelopes; the "
+                "gateway replicas' FleetView aggregates them into one "
+                "fleet-wide /metrics + /admin/slo + /health view "
+                "(best-effort, re-published every interval).")
+register_channel(
     "trace", pattern="trace:{request_id}", payload="keys",
     keys=("requestId", "workerId", "spans"),
     publishers=("gridllm_tpu/worker/service.py",),
@@ -290,6 +321,9 @@ CH_JOB_SNAPSHOT = "job:snapshot"
 CH_JOB_HANDOFF = "job:handoff"
 CH_JOB_DRAIN = "job:drain"
 CH_JOB_PREEMPTED = "job:preempted"
+CH_CTRL_SUBMIT = "ctrl:submit"
+CH_CTRL_CANCEL = "ctrl:cancel"
+CH_CTRL_STATUS = "ctrl:status"
 
 
 def worker_job_channel(worker_id: str) -> str:

@@ -34,14 +34,18 @@ log = get_logger("gateway.app")
 
 
 def create_app(bus: MessageBus, registry: WorkerRegistry, scheduler: JobScheduler,
-               config: Config | None = None) -> web.Application:
+               config: Config | None = None, fleet=None) -> web.Application:
+    """``fleet`` (ISSUE 15): a FleetView on scaled-control-plane gateway
+    replicas — the admin/health surfaces then answer fleet-wide."""
     config = config or load_config()
     version = gridllm_tpu.__version__
     app = web.Application(
         # metrics outermost: it must observe the FINAL status, including
         # error-middleware translations and 429s from the rate limiter
         middlewares=[obs_routes.metrics_middleware(scheduler),
-                     error_middleware, rate_limit_middleware(config.gateway)],
+                     error_middleware,
+                     rate_limit_middleware(config.gateway, bus=bus,
+                                           metrics=scheduler.metrics)],
         client_max_size=config.gateway.max_body_bytes,
     )
     app[APP_ENV] = config.env
@@ -76,8 +80,9 @@ def create_app(bus: MessageBus, registry: WorkerRegistry, scheduler: JobSchedule
     app.add_routes(openai_routes.build_routes(registry, scheduler, timeout_ms,
                                               admin=admin))
     app.add_routes(inference_routes.build_routes(registry, scheduler))
-    app.add_routes(health_routes.build_routes(bus, registry, scheduler, version))
-    app.add_routes(obs_routes.build_routes(scheduler))
+    app.add_routes(health_routes.build_routes(bus, registry, scheduler,
+                                              version, fleet=fleet))
+    app.add_routes(obs_routes.build_routes(scheduler, fleet=fleet))
 
     async def root(request: web.Request) -> web.Response:
         """Root summary (reference: server/src/index.ts:86-109)."""
@@ -101,7 +106,19 @@ def create_app(bus: MessageBus, registry: WorkerRegistry, scheduler: JobSchedule
 
 
 class GatewayServer:
-    """Full server lifecycle: bus + registry + scheduler + HTTP."""
+    """Full server lifecycle: bus + registry + scheduler + HTTP.
+
+    Control-plane modes (ISSUE 15, ``GRIDLLM_CONTROLPLANE``):
+
+    - ``local`` (default): the scheduler lives in this process — the
+      single-box layout, bit-identical to the pre-ISSUE-15 server.
+    - ``gateway``: this process is one of N stateless replicas. The
+      scheduler is a GatewaySubmitter (submissions fan out to the
+      scheduler shards on ``ctrl:submit``; results/streams arrive on
+      the durable per-job channels), the registry runs in observer mode
+      (shards own the worker-death verdicts), and a FleetView +
+      StatusPublisher serve the fleet-wide admin/health surface.
+    """
 
     def __init__(self, config: Config | None = None, bus: MessageBus | None = None):
         self.config = config or load_config()
@@ -114,11 +131,36 @@ class GatewayServer:
                                      password=self.config.bus.password,
                                      db=self.config.bus.db,
                                      endpoints=self.config.bus.endpoints)
-        self.registry = WorkerRegistry(self.bus, self.config.scheduler)
-        self.scheduler = JobScheduler(self.bus, self.registry, self.config.scheduler,
-                                      slo_config=self.config.obs.slo,
-                                      watchdog_config=self.config.obs.watchdog)
-        self.app = create_app(self.bus, self.registry, self.scheduler, self.config)
+        cp = self.config.controlplane
+        self.fleet = None
+        self._status_pub = None
+        if cp.mode == "gateway":
+            from gridllm_tpu.controlplane.client import GatewaySubmitter
+            from gridllm_tpu.controlplane.status import (
+                FleetView,
+                StatusPublisher,
+            )
+
+            self.registry = WorkerRegistry(self.bus, self.config.scheduler,
+                                           observer=True)
+            self.scheduler = GatewaySubmitter(
+                self.bus, self.registry, self.config.scheduler,
+                slo_config=self.config.obs.slo,
+                member_id=cp.member_id)
+            self.fleet = FleetView(
+                self.bus, self.scheduler.metrics,
+                stale_after_ms=3 * cp.status_interval_ms)
+            self._status_pub = StatusPublisher(
+                self.bus, self.scheduler, "gateway",
+                self.scheduler.member_id, cp.status_interval_ms)
+        else:
+            self.registry = WorkerRegistry(self.bus, self.config.scheduler)
+            self.scheduler = JobScheduler(
+                self.bus, self.registry, self.config.scheduler,
+                slo_config=self.config.obs.slo,
+                watchdog_config=self.config.obs.watchdog)
+        self.app = create_app(self.bus, self.registry, self.scheduler,
+                              self.config, fleet=self.fleet)
         self._runner: web.AppRunner | None = None
         self._status_task: asyncio.Task | None = None
         self._wire_events()
@@ -142,6 +184,10 @@ class GatewayServer:
         await self.bus.connect()
         await self.registry.initialize()
         await self.scheduler.initialize()
+        if self.fleet is not None:
+            await self.fleet.start()
+        if self._status_pub is not None:
+            await self._status_pub.start()
         self._runner = web.AppRunner(self.app)
         await self._runner.setup()
         site = web.TCPSite(self._runner, self.config.gateway.host,
@@ -167,6 +213,10 @@ class GatewayServer:
         if self._runner is not None:
             await self._runner.cleanup()
             self._runner = None
+        if self._status_pub is not None:
+            await self._status_pub.stop()
+        if self.fleet is not None:
+            await self.fleet.stop()
         await self.scheduler.shutdown()
         await self.registry.shutdown()
         await self.bus.disconnect()

@@ -93,7 +93,14 @@ def metrics_middleware(scheduler: JobScheduler):
     return middleware
 
 
-def build_routes(scheduler: JobScheduler) -> list[web.RouteDef]:
+def build_routes(scheduler: JobScheduler,
+                 fleet=None) -> list[web.RouteDef]:
+    """``fleet`` (controlplane/status.py FleetView, ISSUE 15) is present
+    on scaled-control-plane gateway replicas: /admin/slo and /admin/dump
+    then attach the fleet-wide aggregation — keyed by member/shard
+    identity, never silently summed — so any replica answers for the
+    whole control plane. /metrics serves the same view through the
+    FleetView's collector gauges (gridllm_shard_*)."""
 
     async def metrics(request: web.Request) -> web.Response:
         text = render_registries(scheduler.metrics, default_registry())
@@ -115,10 +122,24 @@ def build_routes(scheduler: JobScheduler) -> list[web.RouteDef]:
         })
 
     async def slo(request: web.Request) -> web.Response:
-        return web.json_response(scheduler.slo.snapshot())
+        snap = scheduler.slo.snapshot()
+        # shard identity label (ISSUE 15 satellite): the snapshot always
+        # says WHOSE judgments these are, so sharded deployments cannot
+        # silently aggregate per-member numbers into one unlabeled view
+        snap["shard"] = scheduler.identity()
+        if fleet is not None:
+            snap["fleet"] = fleet.merged_slo()
+        return web.json_response(snap)
 
     async def dump(request: web.Request) -> web.Response:
-        return web.json_response(build_dump(scheduler, reason="on_demand"))
+        artifact = build_dump(scheduler, reason="on_demand")
+        if fleet is not None:
+            artifact["controlPlane"] = {
+                "member": scheduler.identity(),
+                "members": fleet.members(),
+                "stats": fleet.merged_stats(),
+            }
+        return web.json_response(artifact)
 
     async def memory(request: web.Request) -> web.Response:
         from gridllm_tpu.obs import memory_snapshot

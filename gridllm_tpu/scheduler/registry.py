@@ -52,10 +52,19 @@ WORKERS_KEY = "workers"
 
 
 class WorkerRegistry(EventEmitter):
-    def __init__(self, bus: MessageBus, config: SchedulerConfig | None = None):
+    def __init__(self, bus: MessageBus, config: SchedulerConfig | None = None,
+                 observer: bool = False):
         super().__init__()
         self.bus = bus
         self.config = config or SchedulerConfig()
+        # Observer mode (ISSUE 15): a stateless gateway replica consumes
+        # the heartbeat/registration fan-out for routing and health views
+        # but issues NO death verdicts — the cleanup sweep and TTL probe
+        # stay off, so only scheduler shards (which own the orphan
+        # machinery for their partitions) remove silent workers. Explicit
+        # announcements (unregistered/disconnected) still apply: they are
+        # the worker's own word, not a liveness judgment.
+        self.observer = observer
         self.workers: dict[str, WorkerInfo] = {}
         self._subs: list[Subscription] = []
         self._tasks: list[asyncio.Task] = []
@@ -118,9 +127,20 @@ class WorkerRegistry(EventEmitter):
         ]:
             self._subs.append(await self.bus.subscribe(channel, handler))
         await self._load_existing_workers()
-        self._tasks.append(asyncio.create_task(self._cleanup_loop()))
-        self._tasks.append(asyncio.create_task(self._connection_monitor_loop()))
-        log.info("worker registry initialized", workers=len(self.workers))
+        if not self.observer:
+            self._tasks.append(asyncio.create_task(self._cleanup_loop()))
+            self._tasks.append(
+                asyncio.create_task(self._connection_monitor_loop()))
+        else:
+            # observers still age out silently-dead workers LOCALLY —
+            # the shards' authoritative removals are not broadcast, so
+            # without this a gateway replica's /health/workers would
+            # list a SIGKILLed worker forever. Local prune only: no bus
+            # hdel, no removal verdict, just this process's view.
+            self._tasks.append(
+                asyncio.create_task(self._observer_prune_loop()))
+        log.info("worker registry initialized", workers=len(self.workers),
+                 observer=self.observer)
 
     async def shutdown(self) -> None:
         self._running = False
@@ -299,6 +319,28 @@ class WorkerRegistry(EventEmitter):
                     log.worker("worker heartbeat timed out", worker_id,
                                silent_s=round(now - info.lastHeartbeat, 1))
                     await self.remove_worker(worker_id, reason="heartbeat_timeout")
+
+    async def _observer_prune_loop(self) -> None:
+        """Observer-mode staleness prune (ISSUE 15): drop workers whose
+        heartbeats stopped from THIS process's table only. The bus hash
+        and the death verdict (orphan machinery, removal metrics) belong
+        to the scheduler shards; the same partition-aware liveness hold
+        applies — a deaf bus session must not read as a fleet die-off."""
+        interval = self.config.worker_cleanup_interval_ms / 1000
+        timeout_s = self.config.worker_heartbeat_timeout_ms / 1000
+        while self._running:
+            await asyncio.sleep(interval)
+            if self._liveness_suspended():
+                continue
+            now = time.time()
+            for worker_id, info in list(self.workers.items()):
+                if now - info.lastHeartbeat > timeout_s:
+                    self.workers.pop(worker_id, None)
+                    log.worker("stale worker pruned from observer view",
+                               worker_id,
+                               silent_s=round(now - info.lastHeartbeat, 1))
+                    self.emit("worker_removed", worker_id, info,
+                              "observer_stale")
 
     async def _connection_monitor_loop(self) -> None:
         """Quick-disconnect detection: any worker silent beyond the

@@ -84,6 +84,18 @@ JOB_QUEUE_KEY = "job_queue"
 _TIER_RANK = {"high": 0, "medium": 1, "low": 2}
 
 
+def shard_queue_key(shard_idx: int) -> str:
+    """Bus hash holding one shard's queued-job records (ISSUE 15). The
+    unsharded scheduler keeps the legacy ``job_queue`` key, so a 1-shard
+    control plane and the single-box layout share crash-recovery state."""
+    return f"{JOB_QUEUE_KEY}:{shard_idx}"
+
+
+def shard_active_key(shard_idx: int) -> str:
+    """Bus hash holding one shard's active-assignment records (ISSUE 15)."""
+    return f"{ACTIVE_JOBS_KEY}:{shard_idx}"
+
+
 class JobTimeoutError(TimeoutError):
     pass
 
@@ -109,11 +121,20 @@ class JobScheduler(EventEmitter):
                  config: SchedulerConfig | None = None,
                  metrics: MetricsRegistry | None = None,
                  slo_config: SLOConfig | None = None,
-                 watchdog_config: WatchdogConfig | None = None):
+                 watchdog_config: WatchdogConfig | None = None,
+                 shard: Any | None = None):
         super().__init__()
         self.bus = bus
         self.registry = registry
         self.config = config or SchedulerConfig()
+        # Scaled control plane (ISSUE 15): an optional ShardContext
+        # (controlplane/partition.py, duck-typed to keep this module
+        # import-free of controlplane/) restricting this scheduler to a
+        # leased partition of the job-id space. None = the single-box
+        # layout: this scheduler owns every job, is never fenced, and
+        # persists under the legacy bus keys — behavior is bit-identical
+        # to the pre-ISSUE-15 scheduler.
+        self.shard = shard
         self.job_queue: list[_QueuedJob] = []
         self.active_jobs: dict[str, JobAssignment] = {}
         self._timeout_handles: dict[str, asyncio.TimerHandle] = {}
@@ -186,6 +207,15 @@ class JobScheduler(EventEmitter):
         # re-emits nothing the client already saw (exactly-once).
         self._resume_snap: dict[str, dict[str, Any]] = {}
         self._stream_chars: dict[str, int] = {}
+        # Sharded control plane (ISSUE 15): recently-terminal job ids
+        # (completions seen on the global channel — owned or not — plus
+        # local failures/timeouts/cancels/sheds), bounded. A partition
+        # can be owner-less for up to a lease TTL; a job that resolves
+        # inside that window would otherwise be replayed as "active"
+        # from the durable record at adoption, and the queue-hash
+        # reconcile needs the same memory to tell a parked-submit ghost
+        # from genuinely pending work.
+        self._recent_done: dict[str, float] = {}
         # Preemption-based priority (ISSUE 11): victim jobId → request
         # time of an in-flight suspend-to-host ask. One preemption in
         # flight fleet-wide (a burst must not suspend the whole fleet);
@@ -198,6 +228,30 @@ class JobScheduler(EventEmitter):
             "a resume watermark; drain_handoff = live migration moved the "
             "assignment; drain_requeued = drained job went back to the "
             "queue with its snapshot).",
+            ("event",),
+        )
+        # Lease fencing (ISSUE 15): mutating operations a deposed or
+        # partitioned shard REFUSED because its ownership lease was no
+        # longer provably valid — nonzero here during a failover is the
+        # fencing machinery working; nonzero in steady state means lease
+        # renewals are not keeping up with the TTL.
+        self._shard_fenced = self.metrics.counter(
+            "gridllm_shard_fenced_ops_total",
+            "Mutating scheduler operations refused because the shard's "
+            "ownership lease was lost or stale, by operation "
+            "(assign/timeout/orphan/failure/cancel/drain/preempt).",
+            ("op",),
+        )
+        self._ctrl_submits = self.metrics.counter(
+            "gridllm_ctrl_submits_total",
+            "Control-plane submission fan-out events (ISSUE 15): "
+            "published (gateway replica → ctrl:submit), accepted (owning "
+            "shard enqueued), ignored (park of a non-owned submit "
+            "failed), parked (non-owned submit written straight to its "
+            "partition's durable queue record), reconciled (the owner's "
+            "sweep found a durable queued record it never saw — a "
+            "parked submit from an owner-less or missed-delivery "
+            "window — and enqueued it).",
             ("event",),
         )
         # fleet-wide retry budget (token bucket, retries/min): a degraded
@@ -271,35 +325,177 @@ class JobScheduler(EventEmitter):
     async def _load_existing_jobs(self) -> None:
         """Crash recovery from the bus (reference: JobScheduler.ts:82-126).
         Queued jobs reload in sequence order; active jobs whose assignment
-        outlived the server restart are orphan-requeued immediately."""
-        stored_queue = await self.bus.hgetall(JOB_QUEUE_KEY)
+        outlived the server restart are orphan-requeued immediately. A
+        sharded scheduler (ISSUE 15) loads only the partitions it holds
+        leases for — adopted partitions replay later via adopt_shard."""
+        if self.shard is None:
+            await self._load_jobs_from(JOB_QUEUE_KEY, ACTIVE_JOBS_KEY)
+            return
+        for idx in self.shard.held():
+            await self._load_jobs_from(shard_queue_key(idx),
+                                       shard_active_key(idx))
+
+    async def _load_jobs_from(self, qkey: str, akey: str) -> dict[str, int]:
+        """Replay one (queue hash, active hash) pair into local state —
+        the shared body of boot-time crash recovery and shard adoption.
+        Actives load FIRST so a stale queued record of a job that is
+        actually running (e.g. an orphaned-partition park that raced the
+        previous owner's dispatch) is recognized and dropped instead of
+        re-dispatching a live job."""
+        stored_active = await self.bus.hgetall(akey)
+        n_active = 0
+        for job_id, raw in stored_active.items():
+            if job_id in self.active_jobs:
+                continue
+            if job_id in self._recent_done:
+                # resolved while the partition was owner-less (ISSUE 15):
+                # the worker's completion landed on the global channel
+                # with no owner to account it — the durable record is
+                # stale, not a live assignment
+                await self.bus.hdel(akey, job_id)
+                self._jobs_total.inc(event="completed")
+                log.job("adopted job already resolved; record dropped",
+                        job_id)
+                continue
+            try:
+                assignment = JobAssignment.model_validate_json(raw)
+            except Exception:
+                await self.bus.hdel(akey, job_id)
+                continue
+            age_ms = (time.time() - assignment.assignedAt) * 1000
+            if age_ms > assignment.timeout:
+                await self.bus.hdel(akey, job_id)
+                continue
+            self.active_jobs[job_id] = assignment
+            self._arm_timeout(assignment, remaining_ms=assignment.timeout - age_ms)
+            n_active += 1
+
+        stored_queue = await self.bus.hgetall(qkey)
         entries = []
         for job_id, raw in stored_queue.items():
+            if job_id in self.active_jobs or job_id in self._recent_done:
+                # the job is live (or already resolved) — the queued
+                # record is a stale duplicate, not pending work
+                await self.bus.hdel(qkey, job_id)
+                continue
             try:
                 rec = json.loads(raw)
                 req = InferenceRequest.model_validate(rec["request"])
                 entries.append(_QueuedJob(req, int(rec.get("seq", 0))))
             except Exception:
-                await self.bus.hdel(JOB_QUEUE_KEY, job_id)
+                await self.bus.hdel(qkey, job_id)
         entries.sort(key=_QueuedJob.sort_key)
-        self.job_queue = entries
+        # merge (adoption joins a live queue): dedupe by id, keep sorted
+        have = {qj.request.id for qj in self.job_queue}
+        entries = [e for e in entries if e.request.id not in have]
+        self.job_queue = sorted(self.job_queue + entries,
+                                key=_QueuedJob.sort_key)
         if entries:
-            self._seq = max(0, max(e.seq for e in entries)) + 1
-            self._front_seq = min(0, min(e.seq for e in entries))
+            self._seq = max(self._seq,
+                            max(0, max(e.seq for e in entries)) + 1)
+            self._front_seq = min(self._front_seq,
+                                  min(0, min(e.seq for e in entries)))
+        return {"queued": len(entries), "active": n_active}
 
-        stored_active = await self.bus.hgetall(ACTIVE_JOBS_KEY)
-        for job_id, raw in stored_active.items():
-            try:
-                assignment = JobAssignment.model_validate_json(raw)
-            except Exception:
-                await self.bus.hdel(ACTIVE_JOBS_KEY, job_id)
+    # -- shard ownership & lease fencing (ISSUE 15) --------------------------
+    def _owns(self, job_id: str) -> bool:
+        """Whether this scheduler's partition set covers the job. The
+        unsharded scheduler owns everything; a sharded one consumes the
+        global lifecycle channels (completed/failed/snapshot/handoff/
+        drain/preempted) but acts only on jobs in its leased shards."""
+        return self.shard is None or self.shard.owns(job_id)
+
+    def _fence(self, op: str, job_id: str) -> bool:
+        """Lease fence on every MUTATING path: True = proceed. A shard
+        whose ownership lease for the job's partition is lost or stale
+        (renewals not landing within the TTL) must refuse to assign,
+        requeue, time out, or cancel — the partition's new owner replays
+        the durable job state and owns those decisions now. Refusals are
+        counted so a fencing storm is visible."""
+        if self.shard is None or self.shard.fenced_job(job_id):
+            return True
+        self._shard_fenced.inc(op=op)
+        log.warning("shard lease lost/stale; mutating op refused",
+                    op=op, job_id=job_id)
+        return False
+
+    def _qkey(self, job_id: str) -> str:
+        """Bus hash key holding this job's queued record."""
+        if self.shard is None:
+            return JOB_QUEUE_KEY
+        return shard_queue_key(self.shard.shard_for(job_id))
+
+    def _akey(self, job_id: str) -> str:
+        """Bus hash key holding this job's active-assignment record."""
+        if self.shard is None:
+            return ACTIVE_JOBS_KEY
+        return shard_active_key(self.shard.shard_for(job_id))
+
+    def identity(self) -> dict[str, Any]:
+        """Control-plane identity stamped into get_stats()/admin views so
+        per-member numbers are never silently aggregated without their
+        origin (ISSUE 15 satellite: health and scrapes agree per shard)."""
+        if self.shard is None:
+            return {"role": "local", "member": "local", "shards": [0],
+                    "numShards": 1}
+        return self.shard.identity()
+
+    async def adopt_shard(self, shard_idx: int) -> dict[str, int]:
+        """Failover adoption (ISSUE 15): after this member acquired the
+        lease for a dead shard's partition (epoch bump), replay that
+        shard's durable job state from the bus — queued records rejoin
+        the local queue, live assignments are installed with their
+        REMAINING timeout (the worker kept decoding through the shard
+        death; its stream flows straight to the gateway replicas, so
+        adoption is bookkeeping, not a restart). Jobs whose assignment
+        outlived its timeout are dropped exactly as in crash recovery."""
+        loaded = await self._load_jobs_from(
+            shard_queue_key(shard_idx), shard_active_key(shard_idx))
+        self.flightrec.record("scheduler", "shard_adopted",
+                              shard=shard_idx, member=self.identity().get(
+                                  "member"), **loaded)
+        log.info("shard partition adopted", shard=shard_idx, **loaded)
+        self.request_dispatch()
+        return loaded
+
+    def release_shard(self, shard_idx: int) -> dict[str, int]:
+        """Deposition cleanup (ISSUE 15): drop every locally held job of
+        a partition whose lease this member lost — WITHOUT touching the
+        bus-persisted records (the new owner replays them) and without
+        publishing cancellations or failures (the jobs are alive and now
+        someone else's). Timers are disarmed so a deposed shard can never
+        fire a timeout for a job it no longer owns."""
+        if self.shard is None:
+            return {"queued": 0, "active": 0}
+        dropped_q = 0
+        keep: list[_QueuedJob] = []
+        for qj in self.job_queue:
+            if self.shard.shard_for(qj.request.id) == shard_idx:
+                dropped_q += 1
+                self._end_queue_span(qj.request.id, released=True)
+            else:
+                keep.append(qj)
+        self.job_queue = keep
+        dropped_a = 0
+        for job_id in list(self.active_jobs):
+            if self.shard.shard_for(job_id) != shard_idx:
                 continue
-            age_ms = (time.time() - assignment.assignedAt) * 1000
-            if age_ms > assignment.timeout:
-                await self.bus.hdel(ACTIVE_JOBS_KEY, job_id)
-                continue
-            self.active_jobs[job_id] = assignment
-            self._arm_timeout(assignment, remaining_ms=assignment.timeout - age_ms)
+            self.active_jobs.pop(job_id, None)
+            dropped_a += 1
+            for handles in (self._timeout_handles, self._retry_handles):
+                h = handles.pop(job_id, None)
+                if h is not None:
+                    h.cancel()
+            self._migrations.pop(job_id, None)
+            self._drop_resume_state(job_id)
+            self._stream_progress.pop(job_id, None)
+            self._preempting.pop(job_id, None)
+        self.flightrec.record("scheduler", "shard_released",
+                              shard=shard_idx, queued=dropped_q,
+                              active=dropped_a)
+        log.warning("shard partition released (lease lost)",
+                    shard=shard_idx, queued=dropped_q, active=dropped_a)
+        return {"queued": dropped_q, "active": dropped_a}
 
     # -- observability ------------------------------------------------------
     def _collect_gauges(self) -> None:
@@ -340,6 +536,13 @@ class JobScheduler(EventEmitter):
         ``requeue=True`` (the retry ladder) skips the ``queued`` counter so
         requeues are counted only by their own event (retried/nacked/
         orphaned) and ``queued`` balances against terminal events."""
+        if self.shard is not None and not self.shard.owns(request.id):
+            # safety net (ISSUE 15): a retry timer that fired after this
+            # member lost the job's partition lease must not resurrect
+            # the job here — its new owner replays the durable state
+            log.warning("add_job for unowned partition dropped",
+                        job_id=request.id)
+            return request.id
         # per-class request deadline (ISSUE 9), stamped ONCE at first
         # submission so retries/orphans measure from the original submit
         md = request.metadata
@@ -561,6 +764,8 @@ class JobScheduler(EventEmitter):
         """Cancel a queued, retrying, or active job (reference:
         JobScheduler.ts:874-908). The cancelled-set guards the race where a
         dispatch pass already snapshotted the queued job."""
+        if not self._fence("cancel", job_id):
+            return False
         self._cancelled[job_id] = time.time()
         self._migrations.pop(job_id, None)
 
@@ -569,6 +774,7 @@ class JobScheduler(EventEmitter):
             # path — count it as a timeout, not a user cancellation
             event = "timeout" if reason == "timeout" else "cancelled"
             self._jobs_total.inc(event=event)
+            self._mark_done(job_id)
             self._drop_resume_state(job_id)
             self.flightrec.record("scheduler", event, job=job_id,
                                   reason=reason)
@@ -584,7 +790,7 @@ class JobScheduler(EventEmitter):
         for i, qj in enumerate(self.job_queue):
             if qj.request.id == job_id:
                 self.job_queue.pop(i)
-                await self.bus.hdel(JOB_QUEUE_KEY, job_id)
+                await self.bus.hdel(self._qkey(job_id), job_id)
                 account()
                 log.job("queued job cancelled", job_id, reason=reason)
                 return True
@@ -628,6 +834,11 @@ class JobScheduler(EventEmitter):
         failed = int(jt.value(event="failed"))
         timed_out = int(jt.value(event="timeout"))
         return {
+            # shard identity (ISSUE 15 satellite): with a sharded control
+            # plane these numbers are PER-PARTITION — any aggregation
+            # must key by this block instead of silently summing unlabeled
+            # snapshots from different members
+            "shard": self.identity(),
             "queuedJobs": len(self.job_queue),
             "activeJobs": len(self.active_jobs),
             "totalJobsProcessed": completed,
@@ -677,7 +888,7 @@ class JobScheduler(EventEmitter):
             for qj in sorted(list(self.job_queue), key=_QueuedJob.sort_key):
                 if qj.request.id in self._cancelled:
                     assigned_ids.add(qj.request.id)  # drop from queue below
-                    await self.bus.hdel(JOB_QUEUE_KEY, qj.request.id)
+                    await self.bus.hdel(self._qkey(qj.request.id), qj.request.id)
                     self._end_queue_span(qj.request.id, cancelled=True)
                     continue
                 md = qj.request.metadata or {}
@@ -695,7 +906,7 @@ class JobScheduler(EventEmitter):
                     # instead of occupying the queue (ISSUE 9); the
                     # gateway maps the failure to HTTP 504
                     assigned_ids.add(qj.request.id)
-                    await self.bus.hdel(JOB_QUEUE_KEY, qj.request.id)
+                    await self.bus.hdel(self._qkey(qj.request.id), qj.request.id)
                     await self._shed_deadline(qj.request)
                     continue
                 worker, disagg = self._plan_placement(qj.request)
@@ -850,6 +1061,12 @@ class JobScheduler(EventEmitter):
         silent_s = time.time() - fresh.lastHeartbeat
         if silent_s * 1000 > self.config.worker_heartbeat_timeout_ms:
             return False
+        if not self._fence("assign", qj.request.id):
+            # the double-assign gate (ISSUE 15): a deposed or partitioned
+            # shard must NEVER publish an assignment — the partition's
+            # new owner replays this job from the durable queue record
+            # and assigns it itself
+            return False
 
         request = qj.request
         if disagg is not None:
@@ -870,8 +1087,9 @@ class JobScheduler(EventEmitter):
             request=request, timeout=timeout_ms,
         )
         self.active_jobs[request.id] = assignment
-        await self.bus.hset(ACTIVE_JOBS_KEY, request.id, assignment.model_dump_json())
-        await self.bus.hdel(JOB_QUEUE_KEY, request.id)
+        await self.bus.hset(self._akey(request.id), request.id,
+                            assignment.model_dump_json())
+        await self.bus.hdel(self._qkey(request.id), request.id)
         await self.registry.mark_worker_busy(worker.workerId)
         await self.bus.publish(
             worker_job_channel(worker.workerId),
@@ -904,6 +1122,13 @@ class JobScheduler(EventEmitter):
         try:
             result = JobResult.model_validate_json(raw)
         except Exception:
+            return
+        self._mark_done(result.jobId)
+        if not self._owns(result.jobId):
+            # sharded control plane (ISSUE 15): lifecycle channels fan
+            # out to every shard; only the partition owner accounts the
+            # job (a non-owner counting "duplicate execution" here would
+            # multiply every completion by M-1 shards)
             return
         if result.jobId not in self.active_jobs:
             # stale/duplicate completion — but in the race window where the
@@ -944,6 +1169,9 @@ class JobScheduler(EventEmitter):
         try:
             result = JobResult.model_validate_json(raw)
         except Exception:
+            return
+        if not self._owns(result.jobId) \
+                or not self._fence("failure", result.jobId):
             return
         assignment = self.active_jobs.get(result.jobId)
         if assignment is None:
@@ -1018,6 +1246,7 @@ class JobScheduler(EventEmitter):
             self._retry_handles[result.jobId] = loop.call_later(delay_s, do_retry)
         else:
             self._jobs_total.inc(event="failed")
+            self._mark_done(result.jobId)
             self._drop_resume_state(result.jobId)
             self.flightrec.record("scheduler", "failed", job=result.jobId,
                                   worker=result.workerId,
@@ -1030,6 +1259,12 @@ class JobScheduler(EventEmitter):
 
     async def _handle_job_timeout(self, job_id: str) -> None:
         """Server-side job timeout (reference: JobScheduler.ts:516-551)."""
+        if not self._fence("timeout", job_id):
+            # deposed shard (ISSUE 15): the partition's new owner re-armed
+            # this job's timeout from the durable assignment — firing it
+            # here would publish a cancellation + failure for a job that
+            # is alive and someone else's
+            return
         # claim the assignment synchronously BEFORE any await: the
         # waiter-side cancel_job(reason="timeout") can interleave during a
         # bus suspension and this timeout must be accounted exactly once
@@ -1037,6 +1272,7 @@ class JobScheduler(EventEmitter):
         if assignment is None:
             return  # already completed/cancelled — benign
         self._migrations.pop(job_id, None)
+        self._mark_done(job_id)
         self._drop_resume_state(job_id)
         self._jobs_total.inc(event="timeout")
         self.flightrec.record("scheduler", "timeout", job=job_id,
@@ -1073,6 +1309,8 @@ class JobScheduler(EventEmitter):
             data = json.loads(raw)
             job_id = data["jobId"]
         except Exception:
+            return
+        if not self._owns(job_id):
             return
         from_worker = str(data.get("fromWorker") or "")
         mig = self._migrations.get(job_id)
@@ -1147,7 +1385,7 @@ class JobScheduler(EventEmitter):
             timeout=assignment.timeout,
         )
         self.active_jobs[job_id] = handoff
-        await self.bus.hset(ACTIVE_JOBS_KEY, job_id,
+        await self.bus.hset(self._akey(job_id), job_id,
                             handoff.model_dump_json())
         await self.registry.mark_worker_busy(to_worker)
         await self.bus.publish(
@@ -1177,7 +1415,7 @@ class JobScheduler(EventEmitter):
         for i, qj in enumerate(self.job_queue):
             if qj.request.id == job_id:
                 self.job_queue.pop(i)
-                await self.bus.hdel(JOB_QUEUE_KEY, job_id)
+                await self.bus.hdel(self._qkey(job_id), job_id)
                 dropped = True
                 break
         if dropped:
@@ -1224,8 +1462,19 @@ class JobScheduler(EventEmitter):
             job_id = data["jobId"]
         except Exception:
             return
+        if not self._owns(job_id):
+            return
         if job_id in self.active_jobs and isinstance(data.get("tokens"), list):
             self._merge_snapshot(job_id, data)
+            if self.shard is not None:
+                # sharded mode (ISSUE 15): stream frames flow worker →
+                # gateway replicas, so the snapshot cadence is the only
+                # per-job sign of life a shard sees — feed it to the
+                # watchdog's progress map or every healthy long decode
+                # would read as a dispatch/prefill hang
+                now = time.time()
+                first = self._stream_progress.get(job_id, (now, now))[0]
+                self._stream_progress[job_id] = (first, now)
 
     def _stamp_resume(self, request: InferenceRequest) -> bool:
         """Attach the job's resume watermark to its metadata before a
@@ -1251,6 +1500,16 @@ class JobScheduler(EventEmitter):
         self._resume_snap.pop(job_id, None)
         self._stream_chars.pop(job_id, None)
 
+    def _mark_done(self, job_id: str) -> None:
+        """Record a terminal outcome for the sharded-mode resolved-job
+        memory (adoption replay + queue-hash reconcile read it). No-op
+        in local mode — nothing consults it there."""
+        if self.shard is None:
+            return
+        self._recent_done[job_id] = time.time()
+        while len(self._recent_done) > 1024:
+            self._recent_done.pop(next(iter(self._recent_done)))
+
     async def _on_drain(self, _ch: str, raw: str) -> None:
         """``job:drain`` from a draining worker that suspended an active
         decode. migrated=True with a live target → move the assignment
@@ -1261,6 +1520,8 @@ class JobScheduler(EventEmitter):
             data = json.loads(raw)
             job_id = data["jobId"]
         except Exception:
+            return
+        if not self._owns(job_id) or not self._fence("drain", job_id):
             return
         from_worker = str(data.get("fromWorker") or "")
         assignment = self.active_jobs.get(job_id)
@@ -1291,7 +1552,7 @@ class JobScheduler(EventEmitter):
                 timeout=assignment.timeout,
             )
             self.active_jobs[job_id] = handoff
-            await self.bus.hset(ACTIVE_JOBS_KEY, job_id,
+            await self.bus.hset(self._akey(job_id), job_id,
                                 handoff.model_dump_json())
             await self.registry.mark_worker_busy(to_worker)
             await self.bus.publish(
@@ -1346,6 +1607,8 @@ class JobScheduler(EventEmitter):
         if cfg_ms <= 0:
             return
         req = qj.request
+        if not self._fence("preempt", req.id):
+            return
         if (now - qj.enqueued_at) * 1000 < cfg_ms:
             return
         # prune stale asks (victim resolved meanwhile / worker never
@@ -1407,6 +1670,8 @@ class JobScheduler(EventEmitter):
             job_id = data["jobId"]
         except Exception:
             return
+        if not self._owns(job_id) or not self._fence("preempt", job_id):
+            return
         from_worker = str(data.get("fromWorker") or "")
         self._preempting.pop(job_id, None)
         assignment = self.active_jobs.get(job_id)
@@ -1459,6 +1724,7 @@ class JobScheduler(EventEmitter):
         gets a non-retryable ``deadline_exceeded`` result (gateway → 504)
         and the queue slot frees immediately."""
         job_id = request.id
+        self._mark_done(job_id)
         self._jobs_total.inc(event="deadline_exceeded")
         self.flightrec.record("scheduler", "deadline_exceeded", job=job_id,
                               model=request.model)
@@ -1521,6 +1787,8 @@ class JobScheduler(EventEmitter):
         becomes ``migration_lost`` and the stale plan is stripped so the
         fresh placement replans from live registry state."""
         job_id = assignment.jobId
+        if not self._fence("orphan", job_id):
+            return
         mig = self._migrations.pop(job_id, None)
         if mig is not None:
             reason = "migration_lost"
@@ -1578,12 +1846,17 @@ class JobScheduler(EventEmitter):
 
     async def _sweep_loop(self) -> None:
         """Safety-net sweep (reference: the 1 s tick, JobScheduler.ts:128-135
-        — here only orphan detection + a dispatch fallback)."""
+        — here only orphan detection + a dispatch fallback, plus the
+        sharded queue-hash reconcile every few ticks)."""
         interval = self.config.sweep_interval_ms / 1000
+        tick = 0
         while self._running:
             await asyncio.sleep(interval)
+            tick += 1
             try:
                 await self._check_for_orphaned_jobs()
+                if self.shard is not None and tick % 5 == 0:
+                    await self._reconcile_shard_queues()
                 now = time.time()
                 for job_id, at in list(self._cancelled.items()):
                     if now - at > 60:
@@ -1592,6 +1865,46 @@ class JobScheduler(EventEmitter):
                     self.request_dispatch()
             except Exception as e:
                 log.error("sweep failed", error=str(e))
+
+    async def _reconcile_shard_queues(self) -> None:
+        """Sharded-mode repair + garbage collection (ISSUE 15): walk the
+        durable queue hash of every HELD partition and resolve records
+        this scheduler does not have locally. Two sources produce them:
+        non-owners park every submit they ignore (so an owner-less or
+        missed-delivery window cannot lose the job), and a park racing
+        past the owner's dispatch/cancel hdel leaves a ghost. Unknown
+        records of live/resolved jobs are ghosts — collected; genuinely
+        unknown requests are ADOPTED into the queue (the parked-submit
+        recovery path)."""
+        local = {qj.request.id for qj in self.job_queue}
+        picked = 0
+        for idx in self.shard.held():
+            if not self.shard.lease.fenced(idx):
+                continue  # stale lease: neither collect nor adopt
+            qkey = shard_queue_key(idx)
+            for job_id, raw in (await self.bus.hgetall(qkey)).items():
+                if job_id in local:
+                    continue
+                if job_id in self.active_jobs                         or job_id in self._recent_done                         or job_id in self._retry_handles                         or job_id in self._cancelled:
+                    # ghost of a dispatched/resolved/cancelled job
+                    await self.bus.hdel(qkey, job_id)
+                    continue
+                try:
+                    rec = json.loads(raw)
+                    req = InferenceRequest.model_validate(rec["request"])
+                except Exception:
+                    await self.bus.hdel(qkey, job_id)
+                    continue
+                qj = _QueuedJob(req, self._seq)
+                self._seq += 1
+                self.job_queue.append(qj)
+                self._begin_queue_span(req, reconciled=True)
+                self._ctrl_submits.inc(event="reconciled")
+                picked += 1
+                log.job("parked submission reconciled into queue", job_id,
+                        shard=idx)
+        if picked:
+            self.request_dispatch()
 
     async def _check_for_orphaned_jobs(self) -> None:
         """reference: JobScheduler.ts:219-257 — assignment older than the
@@ -1620,7 +1933,7 @@ class JobScheduler(EventEmitter):
     # -- internals ----------------------------------------------------------
     async def _persist_queued(self, qj: _QueuedJob) -> None:
         await self.bus.hset(
-            JOB_QUEUE_KEY, qj.request.id,
+            self._qkey(qj.request.id), qj.request.id,
             json.dumps({"seq": qj.seq, "request": qj.request.model_dump(mode="json")}),
         )
 
@@ -1630,7 +1943,7 @@ class JobScheduler(EventEmitter):
         the job synchronously before their first await pass it here so the
         worker is still released."""
         assignment = self.active_jobs.pop(job_id, None) or assignment
-        await self.bus.hdel(ACTIVE_JOBS_KEY, job_id)
+        await self.bus.hdel(self._akey(job_id), job_id)
         handle = self._timeout_handles.pop(job_id, None)
         if handle is not None:
             handle.cancel()

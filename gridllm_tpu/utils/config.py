@@ -368,6 +368,42 @@ register_env("GRIDLLM_FAULT_SEED", "0",
              "Seed for the per-site fault-injection RNGs; the decision "
              "sequence is a pure function of (seed, site, call #).")
 
+# scaled control plane (ISSUE 15): sharded schedulers + gateway replicas
+register_env("GRIDLLM_CONTROLPLANE", "local",
+             "Control-plane mode: local (scheduler in-process, the "
+             "default single-box layout) or gateway (stateless replica "
+             "that publishes submissions to scheduler shards over the "
+             "bus; run shards with python -m gridllm_tpu.controlplane).")
+register_env("GRIDLLM_CONTROLPLANE_ID", "",
+             "Stable member id of this control-plane process (gateway "
+             "replica or scheduler shard); empty = generated cp-<hex>.")
+register_env("GRIDLLM_SHARD_COUNT", "1",
+             "Scheduler shard count M: the job-id space is partitioned "
+             "deterministically over M shards (every member must agree).")
+register_env("GRIDLLM_SHARD_ID", "0",
+             "Home shard index of this scheduler-shard process (0..M-1);"
+             " the shard also adopts orphaned partitions whose lease "
+             "expires.")
+register_env("GRIDLLM_SHARD_LEASE_TTL_MS", "6000",
+             "Shard-ownership lease TTL (ms): a shard silent past this "
+             "is presumed dead and its partition is adopted (epoch "
+             "bump) by a surviving shard.")
+register_env("GRIDLLM_SHARD_RENEW_MS", "2000",
+             "Shard lease renew/sweep interval (ms); must be well under "
+             "the lease TTL.")
+register_env("GRIDLLM_SHARD_STATUS_MS", "2000",
+             "Control-plane status-envelope publish interval (ms) — "
+             "feeds the gateway replicas' fleet-wide /metrics, "
+             "/admin/slo, and /health/workers aggregation.")
+register_env("GRIDLLM_SHARD_HEALTH_PORT", "4100",
+             "HTTP port a scheduler-shard process serves /metrics, "
+             "/admin/slo, and /admin/dump on; 0 disables the listener.")
+register_env("GRIDLLM_RATELIMIT_SCOPE", "replica",
+             "Gateway rate-limit bucket scope: replica (per-process "
+             "buckets — N replicas multiply every limit by N) or fleet "
+             "(bucket state shared through the bus so the limit is "
+             "fleet-wide).")
+
 # static analysis / sanitizers (ISSUE 8)
 register_env("GRIDLLM_ENDPOINT", "http://localhost:4000",
              "Gateway endpoint the integration differential harness "
@@ -489,6 +525,13 @@ class GatewayConfig(BaseModel):
     rate_limit_window_ms: int = 900_000
     rate_limit_max_requests: int = 100
     rate_limit_enabled: bool = True
+    # Multi-replica rate limiting (ISSUE 15): "replica" keeps the
+    # original per-process fixed-window buckets — N gateway replicas
+    # therefore multiply every limit by N, which is the documented
+    # semantics of this scope. "fleet" shares bucket state through the
+    # bus (one read-modify-write per counted request) so the limit is
+    # fleet-wide regardless of which replica serves the request.
+    rate_limit_scope: Literal["replica", "fleet"] = "replica"
     default_request_timeout_ms: int = 300_000
     # Ollama-exact idle residency: unload a model when its keep_alive
     # window passes with no requests (Ollama defaults to 5m). OFF by
@@ -610,6 +653,29 @@ class WatchdogConfig(BaseModel):
     profile_on_hang_s: float = Field(0.0, ge=0)
 
 
+class ControlPlaneConfig(BaseModel):
+    """Horizontally scaled control plane (ISSUE 15): N stateless gateway
+    replicas in front of M scheduler shards, each owning a deterministic
+    partition of the job-id space via bus-backed leases fenced by epoch.
+
+    ``mode`` selects what THIS process is: ``local`` (default) keeps the
+    scheduler in the gateway process — exactly the pre-ISSUE-15 layout;
+    ``gateway`` runs a stateless replica that publishes submissions on
+    ``ctrl:submit`` and rebuilds streaming state from the durable
+    result/stream channels (any replica can serve any request). Shard
+    processes run ``python -m gridllm_tpu.controlplane`` and are
+    configured by ``shard_id``/``num_shards`` plus the lease timers."""
+
+    mode: Literal["local", "gateway"] = "local"
+    member_id: str = ""                # "" → generated cp-<hex>
+    num_shards: int = Field(1, ge=1)
+    shard_id: int = Field(0, ge=0)
+    lease_ttl_ms: int = Field(6_000, gt=0)
+    renew_interval_ms: int = Field(2_000, gt=0)
+    status_interval_ms: int = Field(2_000, gt=0)
+    shard_health_port: int = Field(4_100, ge=0)
+
+
 class ObsConfig(BaseModel):
     """Interpretation-layer observability (ISSUE 2): SLO engine, hang
     watchdog, flight recorder."""
@@ -628,6 +694,8 @@ class Config(BaseModel):
     worker: WorkerConfig = Field(default_factory=WorkerConfig)
     engine: EngineConfig = Field(default_factory=EngineConfig)
     obs: ObsConfig = Field(default_factory=ObsConfig)
+    controlplane: ControlPlaneConfig = Field(
+        default_factory=ControlPlaneConfig)
 
 
 def _slo_config_from_env() -> SLOConfig:
@@ -701,7 +769,18 @@ def load_config() -> Config:
                 rate_limit_window_ms=_env("RATE_LIMIT_WINDOW_MS", 900_000),
                 rate_limit_max_requests=_env("RATE_LIMIT_MAX_REQUESTS", 100),
                 rate_limit_enabled=_env("RATE_LIMIT_ENABLED", True),
+                rate_limit_scope=env_str("GRIDLLM_RATELIMIT_SCOPE"),
                 enforce_keep_alive=env_bool("GRIDLLM_ENFORCE_KEEP_ALIVE"),
+            ),
+            controlplane=ControlPlaneConfig(
+                mode=env_str("GRIDLLM_CONTROLPLANE"),
+                member_id=env_str("GRIDLLM_CONTROLPLANE_ID"),
+                num_shards=env_int("GRIDLLM_SHARD_COUNT"),
+                shard_id=env_int("GRIDLLM_SHARD_ID"),
+                lease_ttl_ms=env_int("GRIDLLM_SHARD_LEASE_TTL_MS"),
+                renew_interval_ms=env_int("GRIDLLM_SHARD_RENEW_MS"),
+                status_interval_ms=env_int("GRIDLLM_SHARD_STATUS_MS"),
+                shard_health_port=env_int("GRIDLLM_SHARD_HEALTH_PORT"),
             ),
             worker=WorkerConfig(
                 worker_id=_env("WORKER_ID", f"worker-{uuid.uuid4().hex[:12]}"),

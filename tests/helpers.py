@@ -44,7 +44,7 @@ class FakeWorker:
                  reply: str = "canned response", delay_s: float = 0.0,
                  fail_times: int = 0, stream_tokens: list[str] | None = None,
                  fail_retryable: bool = True, nack_times: int = 0,
-                 layouts: list | None = None):
+                 layouts: list | None = None, stream_delay_s: float = 0.0):
         self.bus = bus
         self.worker_id = worker_id
         self.models = models
@@ -57,9 +57,15 @@ class FakeWorker:
         self.nack_times = nack_times
         self.layouts = layouts or []
         self.stream_tokens = stream_tokens
+        # inter-token pause for streamed replies: chaos tests kill control-
+        # plane components MID-decode, so the stream must span real time
+        self.stream_delay_s = stream_delay_s
         self.current_jobs = 0
         self.processed: list[str] = []
         self.cancelled: list[str] = []
+        # every job_assignment delivery, in order — the double-assignment
+        # detector for the control-plane chaos differentials (ISSUE 15)
+        self.assignments: list[str] = []
         self._subs = []
         self._hb_task: asyncio.Task | None = None
         self._running = False
@@ -132,6 +138,7 @@ class FakeWorker:
         if msg.get("type") != "job_assignment":
             return
         assignment = JobAssignment.model_validate(msg["job"])
+        self.assignments.append(assignment.jobId)
         if self.nack_times > 0:
             self.nack_times -= 1
             result = JobResult(jobId=assignment.jobId, workerId=self.worker_id,
@@ -160,11 +167,16 @@ class FakeWorker:
                 await self.bus.publish("job:failed", result.model_dump_json())
                 return
             if self.stream_tokens is not None and assignment.request.stream:
+                offset = 0
                 for i, tok in enumerate(self.stream_tokens):
+                    if self.stream_delay_s and i:
+                        await asyncio.sleep(self.stream_delay_s)
                     await self.bus.publish(f"job:stream:{job_id}", StreamChunk(
                         id=job_id, model=assignment.request.model,
                         created_at=iso_now(), response=tok, done=False,
+                        offset=offset,
                     ).model_dump_json())
+                    offset += len(tok)
                 text = "".join(self.stream_tokens)
             else:
                 text = self.reply

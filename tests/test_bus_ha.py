@@ -100,7 +100,13 @@ def test_durable_classification_matches_legacy_patterns():
                       "kvx:")
     legacy_fixed = {"job:completed", "job:failed", "job:timeout",
                     "job:snapshot", "job:handoff", "job:drain",
-                    "job:preempted"}
+                    "job:preempted",
+                    # ISSUE 15: the control-plane submit/cancel channels
+                    # postdate the PR 10 list and are durable by design —
+                    # a submission published while a scheduler shard's
+                    # subscriber is mid-reconnect must replay, not vanish
+                    # (ctrl:status stays best-effort fire-and-forget)
+                    "ctrl:submit", "ctrl:cancel"}
 
     def legacy(ch: str) -> bool:
         if ch in legacy_fixed or ch.startswith(legacy_prefixes):

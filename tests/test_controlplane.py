@@ -1,0 +1,739 @@
+"""Scaled-control-plane tests (ISSUE 15): deterministic job→shard
+mapping, lease acquire/renew/fence units, stale-shard refusal on every
+mutating path, 2-gateway/2-shard in-process fleets, and the chaos
+differential — kill a scheduler shard mid-decode, the surviving shard
+adopts the lease (epoch bump) and replays the durable job state, and the
+client stream stays byte-identical with zero orphans and zero
+double-assignments (the PR 9-10 differential style, with the SCHEDULER
+as the component under fire instead of the worker or the broker)."""
+
+import asyncio
+import json
+import uuid
+
+from gridllm_tpu.bus import InMemoryBus
+from gridllm_tpu.controlplane.client import GatewaySubmitter
+from gridllm_tpu.controlplane.lease import LEASES_KEY, ShardLeaseManager
+from gridllm_tpu.controlplane.partition import ShardContext, shard_of
+from gridllm_tpu.controlplane.shard import SchedulerShard, wait_for_ownership
+from gridllm_tpu.controlplane.status import FleetView, StatusPublisher
+from gridllm_tpu.scheduler import WorkerRegistry
+from gridllm_tpu.scheduler.scheduler import (
+    JobScheduler,
+    shard_active_key,
+    shard_queue_key,
+)
+from gridllm_tpu.utils.config import ControlPlaneConfig, GatewayConfig
+from gridllm_tpu.utils.types import InferenceRequest, Priority, StreamChunk
+
+from .helpers import FakeWorker, fast_config
+
+
+def job_for_shard(idx: int, num_shards: int = 2) -> str:
+    """A fresh job id that deterministically maps to shard ``idx``."""
+    while True:
+        jid = f"job-{uuid.uuid4().hex[:10]}"
+        if shard_of(jid, num_shards) == idx:
+            return jid
+
+
+def req(job_id: str, model: str = "m1", **kw) -> InferenceRequest:
+    return InferenceRequest(id=job_id, model=model, prompt="hi",
+                            priority=Priority.medium, **kw)
+
+
+def cp_config(shard_id: int, num_shards: int = 2,
+              ttl_ms: int = 400, renew_ms: int = 80) -> ControlPlaneConfig:
+    return ControlPlaneConfig(
+        mode="gateway", num_shards=num_shards, shard_id=shard_id,
+        lease_ttl_ms=ttl_ms, renew_interval_ms=renew_ms,
+        status_interval_ms=100)
+
+
+async def make_fleet(bus, num_shards: int = 2, gateways: int = 2,
+                     ttl_ms: int = 400, renew_ms: int = 80):
+    """An in-process 2-gateway/M-shard control plane on one bus — each
+    member gets its own registry, exactly as in the per-process layout."""
+    shards = []
+    for i in range(num_shards):
+        reg = WorkerRegistry(bus, fast_config())
+        sh = SchedulerShard(
+            bus, reg, fast_config(), cp_config(i, num_shards, ttl_ms,
+                                               renew_ms),
+            member_id=f"shard-{i}", settle_s=0.01 + 0.005 * i)
+        await reg.initialize()
+        await sh.start()
+        shards.append(sh)
+    assert await wait_for_ownership(shards, num_shards, timeout_s=5.0)
+    gws = []
+    for i in range(gateways):
+        reg = WorkerRegistry(bus, fast_config(), observer=True)
+        gw = GatewaySubmitter(bus, reg, fast_config(),
+                              member_id=f"gw-{i}")
+        await reg.initialize()
+        await gw.initialize()
+        gws.append(gw)
+    return shards, gws
+
+
+async def stop_fleet(shards, gws, *workers):
+    for w in workers:
+        await w.stop(announce=False)
+    for gw in gws:
+        await gw.shutdown()
+        await gw.registry.shutdown()
+    for sh in shards:
+        await sh.stop()
+        await sh.registry.shutdown()
+
+
+# -- deterministic partition mapping ----------------------------------------
+
+def test_shard_of_deterministic():
+    # content-hash stability: the exact mapping is part of the protocol
+    # (members of one fleet, and adoption replays, must always agree)
+    assert shard_of("job-abc", 2) == shard_of("job-abc", 2)
+    assert shard_of("job-abc", 1) == 0
+    for jid in ("a", "job-1", "job-ffffffff", "x" * 200):
+        assert 0 <= shard_of(jid, 3) < 3
+    # and it is not Python's seeded hash(): a fixed pin across processes
+    assert shard_of("job-pinned", 4) == 0
+
+
+def test_shard_of_spreads():
+    counts = [0, 0]
+    for i in range(256):
+        counts[shard_of(f"job-{i}", 2)] += 1
+    assert min(counts) > 64  # both partitions carry real load
+
+
+# -- lease acquire / renew / fence ------------------------------------------
+
+async def test_lease_acquire_and_renew():
+    bus = InMemoryBus(key_prefix="T:")
+    await bus.connect()
+    lm = ShardLeaseManager(bus, "m1", 2, home_shards=(0,),
+                           ttl_ms=400, renew_ms=60, settle_s=0.01)
+    await lm.start()
+    assert lm.holds(0) and lm.fenced(0)
+    rec = json.loads(await bus.hget(LEASES_KEY, "0"))
+    assert rec["owner"] == "m1" and rec["epoch"] == 1
+    # the sweep adopts the unowned second partition
+    await asyncio.sleep(0.3)
+    assert lm.holds(1)
+    await lm.stop()
+    assert await bus.hget(LEASES_KEY, "0") is None  # released
+    await bus.disconnect()
+
+
+async def test_lease_adoption_bumps_epoch_and_deposes():
+    bus = InMemoryBus(key_prefix="T:")
+    await bus.connect()
+    lost: list[tuple[int, str]] = []
+    a = ShardLeaseManager(bus, "a", 1, home_shards=(0,), ttl_ms=300,
+                          renew_ms=50, settle_s=0.01,
+                          on_lost=lambda i, r: lost.append((i, r)))
+    await a.start()
+    assert a.epochs() == {"0": 1}
+    # SIGKILL-style: a stops renewing but never releases
+    a.kill()
+    b = ShardLeaseManager(bus, "b", 1, home_shards=(), ttl_ms=300,
+                          renew_ms=50, settle_s=0.01)
+    await b.start()
+    await asyncio.sleep(0.6)  # a's record ages past the TTL; b adopts
+    assert b.holds(0) and b.epochs() == {"0": 2}
+    # a resurrects: its next renewal sees the foreign epoch and deposes
+    await a._renew(0)
+    assert not a.holds(0) and lost == [(0, "deposed")]
+    assert not a.fenced(0)
+    await b.stop()
+    await bus.disconnect()
+
+
+async def test_lease_self_fences_without_renewals():
+    bus = InMemoryBus(key_prefix="T:")
+    await bus.connect()
+    lm = ShardLeaseManager(bus, "m1", 1, home_shards=(0,), ttl_ms=150,
+                           renew_ms=50, settle_s=0.01)
+    assert await lm.try_acquire(0, adopted=False)  # no loop started
+    assert lm.fenced(0)
+    await asyncio.sleep(0.2)
+    # renewals never ran: the member cannot prove ownership → fenced out
+    assert not lm.fenced(0) and lm.holds(0)
+    await lm.stop()
+    await bus.disconnect()
+
+
+# -- stale shard refused on every mutating path -----------------------------
+
+class _DeadLease:
+    """A lease view that answers 'held but stale' — the deposed-shard
+    limbo between losing the lease and noticing."""
+
+    def __init__(self, num_shards=1):
+        self.num = num_shards
+
+    def held_shards(self):
+        return list(range(self.num))
+
+    def held_epochs(self):
+        return {i: 1 for i in range(self.num)}
+
+    def holds(self, idx):
+        return True
+
+    def fenced(self, idx):
+        return False
+
+    def epochs(self):
+        return {str(i): 1 for i in range(self.num)}
+
+
+async def test_stale_shard_refuses_every_mutating_path():
+    bus = InMemoryBus(key_prefix="T:")
+    await bus.connect()
+    reg = WorkerRegistry(bus, fast_config())
+    ctx = ShardContext(1, "stale", _DeadLease())
+    sched = JobScheduler(bus, reg, fast_config(), shard=ctx)
+    await reg.initialize()
+    await sched.initialize()
+    w = FakeWorker(bus, "w1", ["m1"])
+    await w.start()
+    await bus.flush()
+
+    jid = job_for_shard(0, 1)
+    await sched.add_job(req(jid))
+    await bus.flush()
+    await asyncio.sleep(0.2)
+    # queued but never assigned: the fence refused the dispatch
+    assert sched.active_jobs == {} and len(sched.job_queue) == 1
+    assert w.assignments == []
+    fenced = sched._shard_fenced
+    assert fenced.value(op="assign") >= 1
+
+    # timeout / orphan / cancel / failure paths all refuse too
+    from gridllm_tpu.utils.types import JobAssignment, JobResult
+
+    assignment = JobAssignment(jobId=jid, workerId="w1",
+                               request=req(jid), timeout=5000)
+    sched.active_jobs[jid] = assignment
+    await sched._handle_job_timeout(jid)
+    assert jid in sched.active_jobs  # refused, not claimed
+    assert fenced.value(op="timeout") == 1
+    await sched._orphan_job(assignment, reason="test")
+    assert fenced.value(op="orphan") == 1
+    assert not await sched.cancel_job(jid)
+    assert fenced.value(op="cancel") == 1
+    fail = JobResult(jobId=jid, workerId="w1", success=False,
+                     error="boom", retryable=True)
+    await sched._on_job_failed("job:failed", fail.model_dump_json())
+    assert fenced.value(op="failure") == 1
+    assert jid in sched.active_jobs  # the failure path never touched it
+
+    sched.active_jobs.pop(jid, None)
+    await w.stop(announce=False)
+    await sched.shutdown()
+    await reg.shutdown()
+    await bus.disconnect()
+
+
+# -- fleet routing / remote submit ------------------------------------------
+
+async def test_fleet_submits_route_to_owning_shard():
+    bus = InMemoryBus(key_prefix="T:")
+    await bus.connect()
+    shards, gws = await make_fleet(bus)
+    w = FakeWorker(bus, "w1", ["m1"], max_concurrent=8)
+    await w.start()
+    await bus.flush()
+    await asyncio.sleep(0.2)  # registries ingest the registration
+
+    jobs = [job_for_shard(0), job_for_shard(0),
+            job_for_shard(1), job_for_shard(1)]
+    results = await asyncio.gather(*[
+        gws[i % 2].submit_and_wait(req(jid), timeout_ms=5000)
+        for i, jid in enumerate(jobs)])
+    assert all(r.success for r in results)
+    # exactly-once execution, and each shard dispatched ITS partition
+    assert sorted(w.processed) == sorted(jobs)
+    assert len(w.assignments) == 4
+    for sh, own_jobs in ((shards[0], jobs[:2]), (shards[1], jobs[2:])):
+        st = sh.scheduler.get_stats()
+        assert st["totalJobsProcessed"] == 2
+        assert st["shard"]["role"] == "shard"
+        accepted = sh.scheduler._ctrl_submits.value(event="accepted")
+        parked = sh.scheduler._ctrl_submits.value(event="parked")
+        # non-owned submits are PARKED (durable queue record for the
+        # partition's owner/adopter), never silently ignored
+        assert accepted == 2 and parked == 2
+        del own_jobs
+    await stop_fleet(shards, gws, w)
+    await bus.disconnect()
+
+
+async def test_remote_cancel_reaches_owning_shard():
+    bus = InMemoryBus(key_prefix="T:")
+    await bus.connect()
+    shards, gws = await make_fleet(bus)
+    w = FakeWorker(bus, "w1", ["m1"], delay_s=2.0)
+    await w.start()
+    await bus.flush()
+    await asyncio.sleep(0.2)
+
+    jid = job_for_shard(1)
+    task = asyncio.create_task(
+        gws[0].submit_and_wait(req(jid), timeout_ms=4000))
+    await asyncio.sleep(0.3)  # let it dispatch
+    assert jid in shards[1].scheduler.active_jobs
+    await gws[0].cancel_job(jid, reason="client_disconnect")
+    await bus.flush()
+    await asyncio.sleep(0.1)
+    assert jid not in shards[1].scheduler.active_jobs
+    assert w.cancelled == [jid]
+    task.cancel()
+    try:
+        await task
+    except (asyncio.CancelledError, Exception):  # noqa: BLE001
+        pass
+    await stop_fleet(shards, gws, w)
+    await bus.disconnect()
+
+
+# -- chaos differential: kill a scheduler shard mid-decode -------------------
+
+TOKENS = [f"tok{i} " for i in range(40)]
+
+
+async def _stream_run(gw, jid: str, kill_cb=None, kill_after_chunks=0):
+    chunks: list[str] = []
+
+    async def on_chunk(chunk: StreamChunk) -> None:
+        chunks.append(chunk.response or "")
+        if kill_cb is not None and len(chunks) == kill_after_chunks:
+            await kill_cb()
+
+    result = await gw.submit_streaming_job(req(jid, stream=True),
+                                           on_chunk, timeout_ms=20000)
+    return result, "".join(chunks)
+
+
+async def test_kill_shard_mid_decode_stream_byte_identical():
+    """THE acceptance gate: SIGKILL-style death of the owning scheduler
+    shard mid-decode with 2 gateways live. The surviving shard adopts the
+    lease (epoch 2) and replays the durable assignment; the worker and
+    the gateway never notice; the client stream is byte-identical to the
+    undisturbed run with zero orphans and zero double-assignments."""
+    # baseline: undisturbed fleet
+    bus = InMemoryBus(key_prefix="T:")
+    await bus.connect()
+    shards, gws = await make_fleet(bus)
+    w = FakeWorker(bus, "w-base", ["m1"], stream_tokens=list(TOKENS),
+                   stream_delay_s=0.02)
+    await w.start()
+    await bus.flush()
+    await asyncio.sleep(0.2)
+    jid = job_for_shard(0)
+    result, baseline = await _stream_run(gws[0], jid)
+    assert result.success and baseline == "".join(TOKENS)
+    await stop_fleet(shards, gws, w)
+    await bus.disconnect()
+
+    # chaos: same fleet shape, owning shard killed mid-stream
+    bus = InMemoryBus(key_prefix="T:")
+    await bus.connect()
+    shards, gws = await make_fleet(bus)
+    w = FakeWorker(bus, "w-chaos", ["m1"], stream_tokens=list(TOKENS),
+                   stream_delay_s=0.02)
+    await w.start()
+    await bus.flush()
+    await asyncio.sleep(0.2)
+    jid = job_for_shard(0)
+
+    async def kill_owner() -> None:
+        await shards[0].kill()
+
+    result, streamed = await _stream_run(gws[1], jid, kill_cb=kill_owner,
+                                         kill_after_chunks=5)
+    assert result.success
+    assert streamed == baseline  # byte-identical through the shard death
+
+    # the survivor adopted the partition with an epoch bump...
+    for _ in range(100):
+        if shards[1].lease.holds(0):
+            break
+        await asyncio.sleep(0.05)
+    assert shards[1].lease.holds(0)
+    assert shards[1].lease.epochs()["0"] == 2
+    # ... zero orphans, zero double-assignments, no duplicate work
+    assert len(w.assignments) == 1 and w.processed == [jid]
+    for sh in shards:
+        jt = sh.scheduler._jobs_total
+        assert jt.value(event="orphaned") == 0
+
+    # the control plane is fully live again: a second request on the
+    # adopted partition is served end to end through the OTHER gateway
+    jid2 = job_for_shard(0)
+    result2, streamed2 = await _stream_run(gws[0], jid2)
+    assert result2.success and streamed2 == "".join(TOKENS)
+    assert shards[1].scheduler.get_stats()["shard"]["shards"] == [0, 1]
+    await stop_fleet(shards, gws, w)
+    await bus.disconnect()
+
+
+async def test_adoption_replays_queued_jobs_from_bus():
+    """A job still QUEUED when its shard dies is replayed from the
+    durable queue record and dispatched by the adopter."""
+    bus = InMemoryBus(key_prefix="T:")
+    await bus.connect()
+    shards, gws = await make_fleet(bus)
+    # no worker yet: the job stays queued on its owning shard
+    jid = job_for_shard(0)
+    task = asyncio.create_task(
+        gws[0].submit_and_wait(req(jid), timeout_ms=15000))
+    await bus.flush()
+    await asyncio.sleep(0.2)
+    assert len(shards[0].scheduler.job_queue) == 1
+    assert await bus.hget(shard_queue_key(0), jid) is not None
+    await shards[0].kill()
+    # a worker arrives while the partition is orphaned; the adopter
+    # replays the queued record and dispatches
+    w = FakeWorker(bus, "w1", ["m1"])
+    await w.start()
+    result = await task
+    assert result.success
+    assert w.processed == [jid]
+    assert shards[1].lease.holds(0)
+    assert await bus.hget(shard_queue_key(0), jid) is None
+    await stop_fleet(shards, gws, w)
+    await bus.disconnect()
+
+
+async def test_adoption_drops_already_resolved_active_record():
+    """A job that COMPLETES while its partition is owner-less must not be
+    resurrected as a live assignment at adoption (the _recent_done
+    buffer) — its durable active record is stale, not a live job."""
+    bus = InMemoryBus(key_prefix="T:")
+    await bus.connect()
+    shards, gws = await make_fleet(bus)
+    w = FakeWorker(bus, "w1", ["m1"], stream_tokens=list(TOKENS),
+                   stream_delay_s=0.02)
+    await w.start()
+    await bus.flush()
+    await asyncio.sleep(0.2)
+    jid = job_for_shard(0)
+
+    async def kill_owner() -> None:
+        await shards[0].kill()
+
+    # kill LATE in the stream: the job completes before adoption lands
+    result, streamed = await _stream_run(gws[0], jid, kill_cb=kill_owner,
+                                         kill_after_chunks=36)
+    assert result.success and streamed == "".join(TOKENS)
+    for _ in range(100):
+        if shards[1].lease.holds(0):
+            break
+        await asyncio.sleep(0.05)
+    await asyncio.sleep(0.2)
+    # the adopter holds no ghost of the finished job
+    assert jid not in shards[1].scheduler.active_jobs
+    assert await bus.hget(shard_active_key(0), jid) is None
+    assert len(w.assignments) == 1
+    await stop_fleet(shards, gws, w)
+    await bus.disconnect()
+
+
+# -- aggregation view --------------------------------------------------------
+
+async def test_submit_during_ownerless_window_is_parked_and_recovered():
+    """A job submitted BETWEEN a shard's death and its lease expiring
+    (the window where the dead owner still looks alive) must not be
+    lost: the surviving non-owner parks it into the partition's durable
+    queue record and executes it after adopting the lease."""
+    bus = InMemoryBus(key_prefix="T:")
+    await bus.connect()
+    # a generous TTL: the submit must land INSIDE the owner-less window
+    # even under the sanitizer's instrumentation slowdown
+    shards, gws = await make_fleet(bus, ttl_ms=1500, renew_ms=100)
+    w = FakeWorker(bus, "w1", ["m1"])
+    await w.start()
+    await bus.flush()
+    await asyncio.sleep(0.2)
+
+    await shards[0].kill()  # lease record still live for ~1.5 s
+    jid = job_for_shard(0)
+    task = asyncio.create_task(
+        gws[0].submit_and_wait(req(jid), timeout_ms=15000))
+    await bus.flush()
+    # nobody owns the partition yet: the job lives ONLY as the parked
+    # durable record written by the surviving non-owner
+    assert jid not in [q.request.id for q in shards[1].scheduler.job_queue]
+    assert await bus.hget(shard_queue_key(0), jid) is not None
+    assert shards[1].scheduler._ctrl_submits.value(event="parked") >= 1
+    result = await task  # adopter replays the parked record
+    assert result.success and w.processed == [jid]
+    assert shards[1].lease.holds(0)
+    await stop_fleet(shards, gws, w)
+    await bus.disconnect()
+
+
+async def test_owner_reconciles_parked_record_it_never_saw():
+    """The owner's sweep adopts durable queued records it has no local
+    copy of (a park from a missed ctrl:submit delivery) — and collects
+    ghosts of already-resolved jobs instead of re-executing them."""
+    bus = InMemoryBus(key_prefix="T:")
+    await bus.connect()
+    shards, gws = await make_fleet(bus)
+    w = FakeWorker(bus, "w1", ["m1"])
+    await w.start()
+    await bus.flush()
+    await asyncio.sleep(0.2)
+
+    # simulate a parked submit the owner never received on ctrl:submit:
+    # write ONLY the durable record (what a non-owner's park leaves) and
+    # await the per-job result channel like a gateway waiter would
+    import json as _json
+
+    from gridllm_tpu.utils.types import JobResult
+
+    jid = job_for_shard(0)
+    fut: asyncio.Future = asyncio.get_running_loop().create_future()
+
+    async def on_result(_ch: str, raw: str) -> None:
+        if not fut.done():
+            fut.set_result(JobResult.model_validate_json(raw))
+
+    sub = await bus.subscribe(f"job:result:{jid}", on_result)
+    await bus.hset(shard_queue_key(0), jid, _json.dumps({
+        "seq": 10_000, "request": req(jid).model_dump(mode="json")}))
+    result = await asyncio.wait_for(fut, 15)  # ~500 ms reconcile tick
+    await sub.unsubscribe()
+    assert result.success and jid in w.processed
+    assert shards[0].scheduler._ctrl_submits.value(event="reconciled") == 1
+
+    # ghost of a resolved job: reconcile must collect, never re-execute
+    ghost = _json.dumps({"seq": 10_001,
+                         "request": req(jid).model_dump(mode="json")})
+    await bus.hset(shard_queue_key(0), jid, ghost)
+    await asyncio.sleep(0.8)
+    assert await bus.hget(shard_queue_key(0), jid) is None
+    assert w.processed.count(jid) == 1
+    await stop_fleet(shards, gws, w)
+    await bus.disconnect()
+
+
+async def test_observer_registry_prunes_silently_dead_worker():
+    """Gateway replicas hold no death verdicts, but their LOCAL worker
+    view must still age out a SIGKILLed worker (nothing broadcasts the
+    shards' removals) — /health/workers is documented as fleet-wide."""
+    bus = InMemoryBus(key_prefix="T:")
+    await bus.connect()
+    reg = WorkerRegistry(bus, fast_config(), observer=True)
+    await reg.initialize()
+    w = FakeWorker(bus, "w1", ["m1"], heartbeat_interval_s=0.1)
+    await w.start()
+    await bus.flush()
+    assert reg.get_worker("w1") is not None
+    await w.die()  # no unregister/disconnect announcement
+    await asyncio.sleep(1.2)  # heartbeat timeout 600 ms + prune tick
+    assert reg.get_worker("w1") is None
+    # the bus hash is untouched — removal authority stays with shards
+    assert await bus.hget("workers", "w1") is not None
+    await reg.shutdown()
+    await bus.disconnect()
+
+
+async def test_fleet_view_aggregates_per_member():
+    bus = InMemoryBus(key_prefix="T:")
+    await bus.connect()
+    shards, gws = await make_fleet(bus)
+    view = FleetView(bus, gws[0].metrics, stale_after_ms=1000)
+    await view.start()
+    pubs = [StatusPublisher(bus, sh.scheduler, "shard", sh.member_id,
+                            100, lease=sh.lease) for sh in shards]
+    pubs.append(StatusPublisher(bus, gws[0], "gateway",
+                                gws[0].member_id, 100))
+    for p in pubs:
+        await p.publish_once()
+    await bus.flush()
+
+    members = view.members()
+    assert set(members) == {"shard-0", "shard-1", gws[0].member_id}
+    assert members["shard-0"]["role"] == "shard"
+    merged = view.merged_stats()
+    assert merged["numShards"] == 2
+    # per-member stats keep their shard identity — nothing summed blind
+    assert merged["perMember"]["shard-1"]["shard"]["member"] == "shard-1"
+    slo = view.merged_slo()
+    assert set(slo) == set(members)
+    # collector exports per-shard gauges on the gateway registry
+    view._collect()
+    held = {s: view._held_gauge.value(shard=s) for s in ("0", "1")}
+    assert held == {"0": 1, "1": 1}
+    await view.stop()
+    await stop_fleet(shards, gws)
+    await bus.disconnect()
+
+
+async def test_fleet_view_flags_lost_lease():
+    bus = InMemoryBus(key_prefix="T:")
+    await bus.connect()
+    shards, gws = await make_fleet(bus)
+    view = FleetView(bus, gws[0].metrics, stale_after_ms=300)
+    await view.start()
+    p0 = StatusPublisher(bus, shards[0].scheduler, "shard",
+                         shards[0].member_id, 100, lease=shards[0].lease)
+    p1 = StatusPublisher(bus, shards[1].scheduler, "shard",
+                         shards[1].member_id, 100, lease=shards[1].lease)
+    await p0.publish_once()
+    await p1.publish_once()
+    await bus.flush()
+    view._collect()
+    assert view._held_gauge.value(shard="0") == 1
+    # shard 0 dies; its envelope goes stale; only shard 1 keeps publishing
+    await shards[0].kill()
+    await asyncio.sleep(0.4)
+    await p1.publish_once()
+    await bus.flush()
+    view._collect()
+    assert view._held_gauge.value(shard="0") == 0  # lease-lost → alert
+    assert view._held_gauge.value(shard="1") == 1
+    await view.stop()
+    await stop_fleet(shards, gws)
+    await bus.disconnect()
+
+
+# -- satellites --------------------------------------------------------------
+
+async def test_ratelimit_fleet_scope_shares_buckets():
+    """Two middleware instances (two replicas) over one bus: the fleet
+    scope counts BOTH replicas' requests against one bucket; the replica
+    scope keeps the documented per-process semantics."""
+    from aiohttp import web
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from gridllm_tpu.gateway.ratelimit import rate_limit_middleware
+    from gridllm_tpu.obs import MetricsRegistry
+
+    bus = InMemoryBus(key_prefix="T:")
+    await bus.connect()
+
+    async def make_app(scope: str, metrics):
+        cfg = GatewayConfig(rate_limit_window_ms=60_000,
+                            rate_limit_max_requests=4,
+                            rate_limit_scope=scope)
+        app = web.Application(
+            middlewares=[rate_limit_middleware(cfg, bus=bus,
+                                               metrics=metrics)])
+
+        async def ok(_r):
+            return web.json_response({"ok": True})
+
+        app.add_routes([web.get("/t", ok)])
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        return client
+
+    metrics = MetricsRegistry()
+    c1 = await make_app("fleet", metrics)
+    c2 = await make_app("fleet", metrics)
+    statuses = []
+    for i in range(6):
+        client = (c1, c2)[i % 2]
+        resp = await client.get("/t")
+        statuses.append(resp.status)
+    # 4 allowed FLEET-WIDE, the rest throttled regardless of replica
+    assert statuses.count(200) == 4 and statuses.count(429) == 2
+    rej = metrics.counter(
+        "gridllm_ratelimit_rejections_total",
+        "Requests throttled with HTTP 429, by bucket scope (replica "
+        "= per-process buckets, so N gateway replicas multiply the "
+        "configured limit by N; fleet = bus-shared buckets).",
+        ("scope",))
+    assert rej.value(scope="fleet") == 2
+    await c1.close()
+    await c2.close()
+
+    # replica scope: each process gets its own budget (documented N×)
+    m2 = MetricsRegistry()
+    r1 = await make_app("replica", m2)
+    r2 = await make_app("replica", m2)
+    statuses = []
+    for i in range(8):
+        client = (r1, r2)[i % 2]
+        resp = await client.get("/t")
+        statuses.append(resp.status)
+    assert statuses.count(200) == 8  # 4 per replica — none throttled
+    await r1.close()
+    await r2.close()
+    await bus.disconnect()
+
+
+async def test_gateway_replica_http_surface_end_to_end():
+    """The full replica wiring (create_app over a GatewaySubmitter +
+    FleetView): a real HTTP generate served through the shards, and the
+    fleet-wide /admin/slo + /health/workers views from the replica."""
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from gridllm_tpu.gateway.app import create_app
+    from gridllm_tpu.utils.config import Config
+
+    bus = InMemoryBus(key_prefix="GridLLM:")
+    await bus.connect()
+    shards, gws = await make_fleet(bus, gateways=1)
+    gw = gws[0]
+    view = FleetView(bus, gw.metrics, stale_after_ms=2000)
+    await view.start()
+    pubs = [StatusPublisher(bus, sh.scheduler, "shard", sh.member_id,
+                            100, lease=sh.lease) for sh in shards]
+    w = FakeWorker(bus, "w1", ["m1"])
+    await w.start()
+    await bus.flush()
+    await asyncio.sleep(0.2)
+
+    app = create_app(bus, gw.registry, gw, Config(), fleet=view)
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    try:
+        resp = await client.post("/ollama/api/generate", json={
+            "model": "m1", "prompt": "hello", "stream": False})
+        assert resp.status == 200
+        body = await resp.json()
+        assert body["response"] == "canned response"
+        assert len(w.processed) == 1
+
+        for p in pubs:
+            await p.publish_once()
+        await bus.flush()
+        slo = await (await client.get("/admin/slo")).json()
+        assert slo["shard"]["role"] == "gateway"
+        assert set(slo["fleet"]) == {"shard-0", "shard-1"}
+        workers = await (await client.get("/health/workers")).json()
+        cp = workers["controlPlane"]
+        assert cp["numShards"] == 2
+        assert set(cp["members"]) == {"shard-0", "shard-1"}
+        dump = await (await client.get("/admin/dump")).json()
+        assert set(dump["controlPlane"]["members"]) == {"shard-0",
+                                                        "shard-1"}
+        metrics_text = await (await client.get("/metrics")).text()
+        assert "gridllm_shard_lease_held" in metrics_text
+        assert "gridllm_ctrl_submits_total" in metrics_text
+    finally:
+        await client.close()
+        await view.stop()
+        await stop_fleet(shards, gws, w)
+        await bus.disconnect()
+
+
+async def test_stats_carry_shard_identity_in_local_mode():
+    bus = InMemoryBus(key_prefix="T:")
+    await bus.connect()
+    reg = WorkerRegistry(bus, fast_config())
+    sched = JobScheduler(bus, reg, fast_config())
+    await reg.initialize()
+    await sched.initialize()
+    st = sched.get_stats()
+    assert st["shard"] == {"role": "local", "member": "local",
+                           "shards": [0], "numShards": 1}
+    await sched.shutdown()
+    await reg.shutdown()
+    await bus.disconnect()
