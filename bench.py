@@ -293,6 +293,7 @@ async def _run_bench_inner(client_ctx, engine, model, n_requests, n_tokens,
     stages = {}
     slo_attainment = None
     goodput_tok_s = None
+    capacity = None
     if scheduler is not None:
         # worker-side spans publish on trace:{id} AFTER job:result resolves
         # the HTTP stream — drain the bus so the tail requests' prefill/
@@ -309,6 +310,14 @@ async def _run_bench_inner(client_ctx, engine, model, n_requests, n_tokens,
         slo_attainment = inter.get("attainment")
         if inter.get("goodputTokens") is not None:
             goodput_tok_s = inter["goodputTokens"] / wall
+        # usage + capacity (ISSUE 16): the shard's per-tenant token ledger
+        # and the per-model demand/headroom snapshot behind /admin/capacity
+        # — lets CI gate that the bench traffic was attributed (non-empty
+        # token totals) and that demand tracking saw the measured requests
+        capacity = {
+            "snapshot": scheduler.capacity.snapshot(),
+            "usage_tokens": scheduler.usage.token_totals(),
+        }
     p95 = _p95(ttfts)
     return {
         "tok_s": tokens_out[0] / wall,
@@ -320,6 +329,7 @@ async def _run_bench_inner(client_ctx, engine, model, n_requests, n_tokens,
         "stages": stages,
         "slo_attainment": slo_attainment,
         "goodput_tok_s": goodput_tok_s,
+        "capacity": capacity,
         "perf": _perf_sidecar(),
         "weights": "real-checkpoint" if ckpt else "random-weights synthetic",
     }
@@ -1967,6 +1977,11 @@ def main() -> int:
             payload["slo_attainment"] = round(r["slo_attainment"], 4)
         if r.get("goodput_tok_s") is not None:
             payload["goodput_tok_s"] = round(r["goodput_tok_s"], 2)
+        if r.get("capacity") is not None:
+            # per-model demand/headroom snapshot + per-tenant token ledger
+            # (ISSUE 16) — the capacity-smoke CI gate asserts the bench
+            # traffic was attributed and the demand tracker saw it
+            payload["capacity"] = r["capacity"]
     else:
         payload["texts"] = r["texts"]
     if fallback:

@@ -9,7 +9,10 @@ Policy, applied to every instrument:
   snake_case — the scrape namespace stays greppable);
 - no unbounded-cardinality label (per-request/job/trace ids, raw text):
   one bad label turns a scrape into a memory leak and kills the TSDB;
-- non-empty help text (the dashboard hover IS the documentation).
+- non-empty help text (the dashboard hover IS the documentation);
+- (static half only) ``tenant``-labeled instruments may be registered
+  only by the usage ledger (obs/usage.py), whose TenantLRU caps the
+  label's value space — see TENANT_LABEL_ALLOWED_FILES.
 
 The static half checks registration call sites (literal name/help/label
 args — a non-literal name is itself a finding, since nothing can audit
@@ -33,6 +36,14 @@ FORBIDDEN_LABELS = {
     "request_id", "requestid", "job_id", "jobid", "id", "trace_id",
     "traceid", "span_id", "prompt", "text", "user", "session",
 }
+
+# ISSUE 16: tenant-labeled series are allowed ONLY in the usage ledger,
+# where a TenantLRU bounds the label's cardinality at labeling time.
+# Anywhere else a `tenant` label is an unbounded-cardinality leak waiting
+# for the first adversarial client. This is a static-scan rule, NOT a
+# FORBIDDEN_LABELS entry: the runtime lint (lint_registry) runs against
+# live registries that legitimately contain the ledger's tenant series.
+TENANT_LABEL_ALLOWED_FILES = {"gridllm_tpu/obs/usage.py"}
 
 
 def lint_registry(registry, origin: str) -> list[str]:
@@ -83,6 +94,14 @@ def check(repo: Repo) -> list[Finding]:
                         RULE, r.file, r.line,
                         f"{r.name}: unbounded-cardinality label "
                         f"{label!r}"))
+                elif ("tenant" in label.lower()
+                        and r.file not in TENANT_LABEL_ALLOWED_FILES):
+                    findings.append(Finding(
+                        RULE, r.file, r.line,
+                        f"{r.name}: label {label!r} — tenant attribution "
+                        "belongs in obs/usage.py, where the TenantLRU "
+                        "bounds its cardinality; a tenant label anywhere "
+                        "else is an unbounded series leak"))
     # a static scan that sees nothing is itself broken
     if not findings and not collect_metric_registrations(repo):
         findings.append(Finding(

@@ -164,12 +164,13 @@ register_channel(
 register_channel(
     "worker:heartbeat", pattern="worker:heartbeat", payload="keys",
     keys=("workerId", "status", "currentJobs", "prefixKeys", "role",
-          "decodeSlotsFree", "httpAddr"),
+          "decodeSlotsFree", "httpAddr", "modelCapacity"),
     publishers=("gridllm_tpu/worker/service.py",),
     subscribers=("gridllm_tpu/scheduler/registry.py",),
     helper="CH_WORKER_HEARTBEAT",
     description="Periodic liveness + load + prefix-affinity keys + "
-                "disagg role/headroom/transfer address.")
+                "disagg role/headroom/transfer address + per-model "
+                "slot/KV-page headroom (ISSUE 16 capacity signals).")
 register_channel(
     "worker:status_update", pattern="worker:status_update", payload="keys",
     keys=("workerId", "status", "currentJobs"),

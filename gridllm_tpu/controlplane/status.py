@@ -23,7 +23,7 @@ import time
 from typing import Any
 
 from gridllm_tpu.bus.base import CH_CTRL_STATUS, MessageBus, Subscription
-from gridllm_tpu.obs import MetricsRegistry
+from gridllm_tpu.obs import MetricsRegistry, merge_capacity
 from gridllm_tpu.utils.logging import get_logger
 
 log = get_logger("controlplane.status")
@@ -76,6 +76,15 @@ class StatusPublisher:
             "leases": self._per_shard_counts(),
             "stats": sched.get_stats(),
             "slo": sched.slo.snapshot(),
+            # fleet economics (ISSUE 16): this member's per-model
+            # demand/headroom snapshot + its usage-ledger view; shards
+            # carry the authoritative demand (they own the queues)
+            "capacity": (sched.capacity.snapshot()
+                         if getattr(sched, "capacity", None) is not None
+                         else None),
+            "usage": (sched.usage.snapshot()
+                      if getattr(sched, "usage", None) is not None
+                      else None),
             "queued": len(sched.job_queue),
             "active": len(sched.active_jobs),
             "hangs": len(sched.watchdog.hangs),
@@ -250,3 +259,20 @@ class FleetView:
         return {
             member: {"role": env.get("role"), "slo": env.get("slo")}
             for member, env in self._live_members().items()}
+
+    def merged_capacity(self) -> dict[str, Any]:
+        """Fleet capacity (ISSUE 16): per-member snapshots (identity
+        preserved) plus the cross-shard merge — demand sums across shards
+        (they partition the job-id space), worker headroom does not
+        (every shard's registry observes the same workers), so the merge
+        rules live in obs.capacity.merge_capacity."""
+        members = self._live_members()
+        per_member = {
+            member: {"role": env.get("role"),
+                     "capacity": env.get("capacity")}
+            for member, env in members.items()}
+        fleet = merge_capacity(
+            env.get("capacity") or {}
+            for env in members.values() if env.get("role") == "shard")
+        return {"perMember": per_member, "fleet": fleet,
+                "numShards": self.num_shards()}

@@ -318,6 +318,11 @@ class GenerationResult:
     # request's verify steps (both 0 with speculation off)
     spec_proposed: int = 0
     spec_accepted: int = 0
+    # usage attribution (ISSUE 16): device-seconds this request's share of
+    # decode steps consumed, and KV page-occupancy (pages held × resident
+    # wall seconds) — the raw cost signals behind gridllm_usage_*
+    decode_device_s: float = 0.0
+    kv_page_s: float = 0.0
     retryable: bool = True  # meaningful when done_reason == "error"
     # when done_reason == "error": the failure message. `text` stays the
     # partial output actually generated, so a streaming client's concatenated
@@ -333,6 +338,7 @@ class _Slot:
         "cached_tokens", "spec_proposed", "spec_accepted", "export_only",
         "snapshot",
         "t_start", "t_prefill_ns", "t_first_decode", "t_last_ingest",
+        "t_admit_wall", "pages_held", "device_s",
     )
 
     def __init__(self, req: GenerationRequest, ids: list[int], capacity: int,
@@ -366,6 +372,10 @@ class _Slot:
         self.t_prefill_ns = 0
         self.t_first_decode = 0
         self.t_last_ingest = 0.0  # epoch seconds of last host-visible token
+        # usage attribution (ISSUE 16)
+        self.t_admit_wall = time.time()  # wall clock at admission
+        self.pages_held = 0              # KV pages allocated to this slot
+        self.device_s = 0.0              # accumulated decode device-second share
 
     def holdback(self) -> int:
         """Chars at the tail of `text` that could still become a stop
@@ -1234,6 +1244,7 @@ class InferenceEngine:
         # ordinary admissions, where prompt_len == len(ids) >= cached)
         st.cached_tokens = min(cached, st.prompt_len)
         row_list = self.alloc.table_row(slot)
+        st.pages_held = len(row_list)
         t0 = time.perf_counter_ns()
         with self.dispatch_lock:
             # emit AFTER the dispatch succeeds: a record for a program the
@@ -1524,6 +1535,13 @@ class InferenceEngine:
         now = time.perf_counter_ns()
         last_delta = st.text[st.emitted_len :]
         st.emitted_len = len(st.text)
+        # final page count (decode growth included) for page-occupancy
+        # attribution; the admission-time count is the floor
+        with self._alloc_lock:
+            try:
+                st.pages_held = max(st.pages_held, len(self.alloc.table_row(slot)))
+            except Exception:
+                pass
         res = GenerationResult(
             id=st.req.id,
             error=error,
@@ -1540,6 +1558,9 @@ class InferenceEngine:
             total_duration_ns=now - st.t_start,
             spec_proposed=st.spec_proposed,
             spec_accepted=st.spec_accepted,
+            decode_device_s=st.device_s,
+            kv_page_s=max(st.pages_held, 1)
+            * max(time.time() - st.t_admit_wall, 0.0),
         )
         with self.dispatch_lock:
             self.active = self.active.at[slot].set(False)
@@ -1830,6 +1851,13 @@ class InferenceEngine:
         else:
             dev = (now - t_disp) / max(k, 1)
         DEVICE_STEP_SECONDS.observe(dev, model=self.cfg.name)
+        # usage attribution (ISSUE 16): split the block's device time
+        # evenly across the slots that shared the batch (engine thread
+        # owns _slots — no lock needed)
+        if self._slots:
+            share = dev * max(k, 1) / len(self._slots)
+            for st in self._slots.values():
+                st.device_s += share
 
     # ------------------------------------------------------------- runner
 

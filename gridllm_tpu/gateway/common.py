@@ -9,12 +9,23 @@ from typing import Any, Awaitable, Callable
 from aiohttp import web
 
 from gridllm_tpu.gateway.errors import ApiError
+from gridllm_tpu.obs import resolve_tenant
 from gridllm_tpu.scheduler import JobScheduler
 from gridllm_tpu.scheduler.scheduler import JobTimeoutError
 from gridllm_tpu.utils.logging import get_logger
 from gridllm_tpu.utils.types import InferenceRequest, JobResult
 
 log = get_logger("gateway.common")
+
+
+def tenant_of(request: web.Request) -> str:
+    """Tenant id for usage attribution (ISSUE 16): the configured
+    GRIDLLM_TENANT_HEADER value, else a truncated hash of the
+    Authorization bearer, else 'anonymous'. Stamped into every
+    request's metadata at the gateway — the one ingress point — so
+    traces, flight-recorder events, and the shard usage ledger all
+    agree on who a request belongs to."""
+    return resolve_tenant(request.headers)
 
 
 def _truncate_part(v: Any, limit: int = 1024) -> Any:

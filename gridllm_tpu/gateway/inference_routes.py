@@ -14,7 +14,7 @@ import uuid
 
 from aiohttp import web
 
-from gridllm_tpu.gateway.common import prefix_key
+from gridllm_tpu.gateway.common import prefix_key, tenant_of
 from gridllm_tpu.gateway.common import submit as submit_job
 from gridllm_tpu.gateway.errors import ApiError
 from gridllm_tpu.scheduler import JobScheduler, WorkerRegistry
@@ -45,6 +45,7 @@ def build_routes(registry: WorkerRegistry, scheduler: JobScheduler) -> list[web.
             priority=Priority(priority),
             timeout=body.get("timeout") or 300_000,
             metadata={"endpoint": "/inference", "requestType": "inference",
+                      "tenant": tenant_of(request),
                       "prefixKey": prefix_key(model, str(prompt)[:512]),
                       "submittedAt": iso_now()},
         )

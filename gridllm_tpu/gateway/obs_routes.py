@@ -8,6 +8,10 @@
   timeline recorded by obs/tracer.py.
 - ``GET /admin/slo``: per-class SLO attainment, burn rates, and goodput
   from obs/slo.py — the same state the ``gridllm_slo_*`` gauges render.
+- ``GET /admin/capacity``: per-model demand/utilization/headroom and the
+  derived scale hint from obs/capacity.py (plus the per-tenant usage
+  ledger), fleet-merged across shards on scaled control planes — the
+  same state the ``gridllm_capacity_*`` gauges render.
 - ``GET /admin/dump``: the flight-recorder post-mortem artifact
   (obs/flightrec.py): event rings, active traces, SLO snapshot, registry
   and engine state, plus any retained auto dumps from hang/crash detection.
@@ -131,6 +135,18 @@ def build_routes(scheduler: JobScheduler,
             snap["fleet"] = fleet.merged_slo()
         return web.json_response(snap)
 
+    async def capacity(request: web.Request) -> web.Response:
+        # fleet capacity & demand (ISSUE 16): this member's per-model
+        # snapshot plus — on scaled control planes — the cross-shard
+        # merge, so any replica serves the same fleet-wide view the
+        # future autoscaler consumes
+        snap = scheduler.capacity.snapshot()
+        snap["shard"] = scheduler.identity()
+        snap["usage"] = scheduler.usage.snapshot()
+        if fleet is not None:
+            snap["fleet"] = fleet.merged_capacity()
+        return web.json_response(snap)
+
     async def dump(request: web.Request) -> web.Response:
         artifact = build_dump(scheduler, reason="on_demand")
         if fleet is not None:
@@ -155,6 +171,7 @@ def build_routes(scheduler: JobScheduler,
         web.get("/metrics", metrics),
         web.get("/admin/trace/{request_id}", trace),
         web.get("/admin/slo", slo),
+        web.get("/admin/capacity", capacity),
         web.get("/admin/dump", dump),
         web.get("/admin/memory", memory),
         web.post("/admin/profile", profile),

@@ -40,6 +40,7 @@ from gridllm_tpu.gateway.common import (
     prefix_key,
     response_dict,
     submit,
+    tenant_of,
 )
 from gridllm_tpu.gateway.errors import ApiError
 from gridllm_tpu.scheduler import JobScheduler, WorkerRegistry
@@ -180,6 +181,7 @@ def build_routes(registry: WorkerRegistry, scheduler: JobScheduler,
             metadata={
                 "ollamaEndpoint": "/api/generate",
                 "requestType": "inference",
+                "tenant": tenant_of(request),
                 "suffix": body.get("suffix"),
                 "think": body.get("think"),
                 "format": body.get("format"),
@@ -247,6 +249,7 @@ def build_routes(registry: WorkerRegistry, scheduler: JobScheduler,
             metadata={
                 "ollamaEndpoint": "/api/chat",
                 "requestType": "chat",   # fix: reference never set this (§2.8)
+                "tenant": tenant_of(request),
                 "think": body.get("think"),
                 "keep_alive": body.get("keep_alive"),
                 # system prompt + leading messages identify the reusable
@@ -328,7 +331,8 @@ def build_routes(registry: WorkerRegistry, scheduler: JobScheduler,
             options=body.get("options") or {},
             timeout=DEFAULT_TIMEOUT_MS,
             metadata={"ollamaEndpoint": "/api/embed",
-                      "requestType": "embedding", "submittedAt": iso_now()},
+                      "requestType": "embedding",
+                      "tenant": tenant_of(request), "submittedAt": iso_now()},
         )
         result = await submit(req, scheduler)
         d = response_dict(result)
@@ -352,7 +356,8 @@ def build_routes(registry: WorkerRegistry, scheduler: JobScheduler,
             options=body.get("options") or {},
             timeout=DEFAULT_TIMEOUT_MS,
             metadata={"ollamaEndpoint": "/api/embeddings",
-                      "requestType": "embedding", "submittedAt": iso_now()},
+                      "requestType": "embedding",
+                      "tenant": tenant_of(request), "submittedAt": iso_now()},
         )
         result = await submit(req, scheduler)
         d = response_dict(result)

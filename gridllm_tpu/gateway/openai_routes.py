@@ -31,6 +31,7 @@ from gridllm_tpu.gateway.common import (
     prefix_key,
     response_dict,
     submit,
+    tenant_of,
 )
 from gridllm_tpu.gateway.errors import OpenAIApiError
 from gridllm_tpu.scheduler import JobScheduler, WorkerRegistry
@@ -152,6 +153,7 @@ def build_routes(registry: WorkerRegistry, scheduler: JobScheduler,
             metadata={
                 "openaiEndpoint": "/v1/chat/completions",
                 "requestType": "chat",
+                "tenant": tenant_of(request),
                 "ollamaEndpoint": "/api/chat",
                 "originalRequest": {
                     "n": body.get("n"), "logprobs": body.get("logprobs"),
@@ -249,6 +251,7 @@ def build_routes(registry: WorkerRegistry, scheduler: JobScheduler,
             metadata={
                 "openaiEndpoint": "/v1/completions",
                 "requestType": "inference",
+                "tenant": tenant_of(request),
                 "ollamaEndpoint": "/api/generate",
                 "prefixKey": prefix_key(model, prompt[:512]),
                 "submittedAt": iso_now(),

@@ -8,11 +8,20 @@ burn rates, goodput), watchdog.py (per-phase hang detection), flightrec.py
 Performance introspection (ISSUE 4): perf.py (recompile tripwire,
 device-memory accounting, step-time decomposition instruments, on-demand
 jax.profiler capture).
+Fleet economics (ISSUE 16): usage.py (per-tenant/per-model cost
+attribution with an exactly-once engine/shard conservation ledger),
+capacity.py (per-model demand rates, headroom, and autoscaling hints
+behind /admin/capacity).
 
 Pure stdlib — no prometheus_client, no OpenTelemetry; perf.py imports
 jax lazily so control-plane processes stay light.
 """
 
+from gridllm_tpu.obs.capacity import (
+    DemandTracker,
+    aggregate_worker_capacity,
+    merge_capacity,
+)
 from gridllm_tpu.obs.flightrec import (
     FlightRecorder,
     build_dump,
@@ -49,6 +58,13 @@ from gridllm_tpu.obs.tracer import (
     trace_channel,
     trace_pattern,
 )
+from gridllm_tpu.obs.usage import (
+    TenantLRU,
+    UsageAccountant,
+    account_engine_usage,
+    build_usage,
+    resolve_tenant,
+)
 from gridllm_tpu.obs.watchdog import HangWatchdog
 
 __all__ = [
@@ -57,6 +73,7 @@ __all__ = [
     "SIZE_BUCKETS",
     "CaptureBusy",
     "Counter",
+    "DemandTracker",
     "FlightRecorder",
     "Gauge",
     "HangWatchdog",
@@ -67,17 +84,24 @@ __all__ = [
     "SLOEngine",
     "Span",
     "TRACE_CHANNEL_PREFIX",
+    "TenantLRU",
     "Tracer",
+    "UsageAccountant",
+    "account_engine_usage",
+    "aggregate_worker_capacity",
     "build_dump",
+    "build_usage",
     "classify_request",
     "default_flight_recorder",
     "default_profiler",
     "default_registry",
     "memory_snapshot",
+    "merge_capacity",
     "recompile_totals",
     "register_engine_probe",
     "register_memory_probe",
     "render_registries",
+    "resolve_tenant",
     "trace_channel",
     "trace_pattern",
     "unregister_engine_probe",

@@ -72,6 +72,9 @@ class SLOEngine:
         self.metrics = metrics or MetricsRegistry()
         self._lock = threading.Lock()
         self._classes: dict[str, _ClassState] = {}
+        # per-model breakdown (ISSUE 16): same outcome stream keyed by
+        # model, JSON-only (/admin/slo "models") — no extra gauge series
+        self._models: dict[str, _ClassState] = {}
         self._wasted: dict[str, int] = {}  # reason → tokens
         m = self.metrics
         self._requests_total = m.counter(
@@ -112,7 +115,7 @@ class SLOEngine:
     def record(self, slo_class: str, ok: bool = True,
                ttft_s: float | None = None, itl_s: float | None = None,
                e2e_s: float | None = None, tokens: int = 0,
-               now: float | None = None) -> bool:
+               now: float | None = None, model: str | None = None) -> bool:
         """Judge one resolved request. ``ok=False`` (failure/timeout) is an
         unconditional violation ("error"); otherwise each objective the
         class configures is checked against the measurement provided (a
@@ -143,6 +146,16 @@ class SLOEngine:
             for obj in violated:
                 st.violations[obj] = st.violations.get(obj, 0) + 1
             st.events.append((ts, within))
+            if model:
+                ms = self._models.setdefault(model, _ClassState())
+                ms.requests += 1
+                ms.tokens += tokens
+                if within:
+                    ms.within += 1
+                    ms.goodput_tokens += tokens
+                for obj in violated:
+                    ms.violations[obj] = ms.violations.get(obj, 0) + 1
+                ms.events.append((ts, within))
         self._requests_total.inc(slo_class=slo_class)
         self._tokens_total.inc(tokens, slo_class=slo_class)
         if within:
@@ -213,6 +226,7 @@ class SLOEngine:
         out_classes: dict[str, Any] = {}
         with self._lock:
             classes = dict(self._classes)
+            models = dict(self._models)
             wasted = dict(self._wasted)
             burns = {name: self._burn_rates_locked(st, self._target_of(name),
                                                    now)
@@ -239,6 +253,18 @@ class SLOEngine:
             "enabled": self.config.enabled,
             "windowsS": list(self.config.windows_s),
             "classes": out_classes,
+            "models": {
+                name: {
+                    "requests": ms.requests,
+                    "withinSlo": ms.within,
+                    "attainment": (round(ms.within / ms.requests, 6)
+                                   if ms.requests else None),
+                    "violations": dict(ms.violations),
+                    "tokens": ms.tokens,
+                    "goodputTokens": ms.goodput_tokens,
+                }
+                for name, ms in models.items()
+            },
             "goodput": {
                 "tokensTotal": total_tokens,
                 "tokensWithinSlo": good_tokens,

@@ -245,6 +245,23 @@ class WorkerRegistry(EventEmitter):
                 pass
         if data.get("httpAddr"):
             info.httpAddr = str(data["httpAddr"])
+        # Capacity signals (ISSUE 16): per-model slot/KV headroom for the
+        # demand tracker behind /admin/capacity; bounded (16 models, int
+        # values only) so a misbehaving worker cannot bloat the registry
+        mc = data.get("modelCapacity")
+        if isinstance(mc, dict):
+            bounded: dict[str, dict[str, int]] = {}
+            for model, caps in list(mc.items())[:16]:
+                if not isinstance(caps, dict):
+                    continue
+                try:
+                    bounded[str(model)] = {
+                        k: max(int(caps.get(k, 0)), 0)
+                        for k in ("slotsFree", "slotsTotal", "kvPagesFree")
+                    }
+                except (TypeError, ValueError):
+                    continue
+            info.modelCapacity = bounded
         # Persist so a restarted server doesn't see a stale lastHeartbeat and
         # evict live workers (reference hsets every beat too).
         await self.bus.hset(WORKERS_KEY, worker_id, info.model_dump_json())

@@ -55,10 +55,13 @@ from gridllm_tpu.bus.base import (
     worker_job_channel,
 )
 from gridllm_tpu.obs import (
+    DemandTracker,
     HangWatchdog,
     MetricsRegistry,
     SLOEngine,
     Tracer,
+    UsageAccountant,
+    aggregate_worker_capacity,
     classify_request,
     default_flight_recorder,
 )
@@ -265,6 +268,17 @@ class JobScheduler(EventEmitter):
         self.slo = SLOEngine(slo_config, self.metrics)
         self.watchdog = HangWatchdog(self, watchdog_config)
         self.flightrec = default_flight_recorder()
+        # fleet economics (ISSUE 16): per-tenant/per-model usage ledger
+        # (exactly-once, folded from result payloads by the OWNING
+        # shard) and the per-model demand/capacity model behind
+        # /admin/capacity — both on this scheduler's instance registry
+        self.usage = UsageAccountant(self.metrics)
+        self.capacity = DemandTracker(
+            self.metrics,
+            queue_depths=self._queue_depth_by_model,
+            worker_capacity=lambda: aggregate_worker_capacity(
+                self.registry.get_online_workers()),
+        )
         # jobId → (first stream frame ts, last stream frame ts): the only
         # pre-completion sign of life a worker gives the gateway; feeds
         # the watchdog's decode-stall detection
@@ -529,6 +543,14 @@ class JobScheduler(EventEmitter):
         if span is not None:
             self.tracer.end(span, **meta)
 
+    def _queue_depth_by_model(self) -> dict[str, int]:
+        """Live queued-job count per model (capacity snapshot input)."""
+        out: dict[str, int] = {}
+        for qj in list(self.job_queue):
+            m = qj.request.model
+            out[m] = out.get(m, 0) + 1
+        return out
+
     # -- public API ---------------------------------------------------------
     async def add_job(self, request: InferenceRequest,
                       requeue: bool = False) -> str:
@@ -556,6 +578,9 @@ class JobScheduler(EventEmitter):
         await self._persist_queued(qj)
         if not requeue:
             self._jobs_total.inc(event="queued")
+            # demand signal (ISSUE 16): first submissions only — a requeue
+            # is the same unit of demand still waiting, not new arrival
+            self.capacity.note_arrival(request.model)
         self._begin_queue_span(request)
         log.job("job queued", request.id, model=request.model,
                 priority=request.priority.value)
@@ -596,7 +621,8 @@ class JobScheduler(EventEmitter):
             # begin() directly before the try whose finally ends it — a
             # raise in between would leak the span open (span-pairing rule)
             root = self.tracer.begin(request.id, "gateway.request",
-                                     endpoint=endpoint, model=request.model)
+                                     endpoint=endpoint, model=request.model,
+                                     tenant=str(md.get("tenant") or ""))
             try:
                 for channel, handler in extra_subs or []:
                     subs.append(await self.bus.subscribe(channel, handler))
@@ -618,7 +644,8 @@ class JobScheduler(EventEmitter):
                 except asyncio.TimeoutError:
                     outcome = "timeout"
                     self.slo.record(slo_class, ok=False,
-                                    e2e_s=timeout_ms / 1000)
+                                    e2e_s=timeout_ms / 1000,
+                                    model=request.model)
                     # end the root BEFORE cancel_job's tracer.abort seals
                     # the timeline, so the outcome lands on the span
                     self.tracer.end(root, outcome=outcome)
@@ -652,6 +679,7 @@ class JobScheduler(EventEmitter):
             slo_class, ok=result.success,
             ttft_s=(ttft_ref[0] if ttft_ref else None),
             itl_s=itl_s, e2e_s=e2e_s, tokens=tokens,
+            model=request.model,
         )
 
     async def submit_and_wait(self, request: InferenceRequest,
@@ -1098,7 +1126,9 @@ class JobScheduler(EventEmitter):
         self._arm_timeout(assignment, remaining_ms=timeout_ms)
         self._jobs_total.inc(event="dispatched")
         self._assignments.inc(worker=worker.workerId)
-        self._queue_wait.observe(max(0.0, time.time() - qj.enqueued_at))
+        wait_s = max(0.0, time.time() - qj.enqueued_at)
+        self._queue_wait.observe(wait_s)
+        self.capacity.note_dispatch(request.model, wait_s)
         self._end_queue_span(request.id, worker=worker.workerId)
         self.tracer.event(request.id, "scheduler.dispatch",
                           worker=worker.workerId)
@@ -1140,6 +1170,14 @@ class JobScheduler(EventEmitter):
             if await self._drop_resolved(result.jobId):
                 self._jobs_total.inc(event="completed")
                 self._drop_resume_state(result.jobId)
+                # orphan-race completion still resolves the request — fold
+                # its usage exactly as the normal path would (conservation:
+                # every published usage payload is accounted once)
+                self.usage.account(result.usage, "completed")
+                if result.usage:
+                    self.capacity.note_completion(
+                        str(result.usage.get("model") or ""),
+                        result.processingTimeMs / 1000)
                 self.emit("job_completed", result)
                 self.request_dispatch()
             else:
@@ -1149,14 +1187,27 @@ class JobScheduler(EventEmitter):
                 # to surface)
                 wasted = int(getattr(result.response, "eval_count", 0) or 0)
                 self.slo.record_waste(wasted, reason="duplicate_execution")
+                # the engine really spent these tokens and counted them on
+                # its side of the ledger — account them under an explicit
+                # "duplicate" outcome so per-tenant sums stay conserved
+                self.usage.account(result.usage, "duplicate")
                 self.flightrec.record(
                     "scheduler", "duplicate_completion",
                     job=result.jobId, worker=result.workerId, tokens=wasted)
             return
+        assignment = self.active_jobs.get(result.jobId)
         self._migrations.pop(result.jobId, None)
         self._drop_resume_state(result.jobId)
         await self._clear_active(result.jobId, free_worker=True)
         self._jobs_total.inc(event="completed")
+        # usage ledger + demand model (ISSUE 16): the owning shard folds
+        # the result's cost payload exactly once
+        self.usage.account(result.usage, "completed")
+        model = (assignment.request.model if assignment is not None
+                 else str((result.usage or {}).get("model") or ""))
+        if model:
+            self.capacity.note_completion(model,
+                                          result.processingTimeMs / 1000)
         log.job("job completed", result.jobId, worker_id=result.workerId,
                 ms=round(result.processingTimeMs, 1))
         self.emit("job_completed", result)
@@ -1248,8 +1299,14 @@ class JobScheduler(EventEmitter):
             self._jobs_total.inc(event="failed")
             self._mark_done(result.jobId)
             self._drop_resume_state(result.jobId)
+            self.usage.note_outcome(
+                str(request.metadata.get("tenant") or ""),
+                request.model, "failed")
             self.flightrec.record("scheduler", "failed", job=result.jobId,
                                   worker=result.workerId,
+                                  tenant=str(request.metadata
+                                             .get("tenant") or ""),
+                                  model=request.model,
                                   error=str(result.error)[:200])
             self.tracer.abort(result.jobId, reason="failed")
             log.job("job failed permanently", result.jobId, error=result.error)
@@ -1275,8 +1332,14 @@ class JobScheduler(EventEmitter):
         self._mark_done(job_id)
         self._drop_resume_state(job_id)
         self._jobs_total.inc(event="timeout")
+        self.usage.note_outcome(
+            str(assignment.request.metadata.get("tenant") or ""),
+            assignment.request.model, "timeout")
         self.flightrec.record("scheduler", "timeout", job=job_id,
-                              worker=assignment.workerId)
+                              worker=assignment.workerId,
+                              tenant=str(assignment.request.metadata
+                                         .get("tenant") or ""),
+                              model=assignment.request.model)
         # close any still-open spans for the job so a timeout storm cannot
         # leak tracer state (asserted by the chaos tests)
         self._end_queue_span(job_id, timeout=True)

@@ -297,8 +297,16 @@ class KVImportManager:
         self.worker_id = worker_id
         self.tracer = tracer
         self.imported: dict[str, int] = {}  # request_id → tokens installed
+        # request_id → payload bytes imported, popped once into the usage
+        # attribution of the decode job's result (ISSUE 16)
+        self.imported_bytes: dict[str, int] = {}
         self._pending: dict[str, _Import] = {}
         self.flightrec = default_flight_recorder()
+
+    def take_imported_bytes(self, rid: str) -> int:
+        """Pop the migrated-bytes tally for a request (0 if none) —
+        consumed exactly once by the decode worker's usage payload."""
+        return self.imported_bytes.pop(rid, 0)
 
     @property
     def inflight(self) -> int:
@@ -401,6 +409,9 @@ class KVImportManager:
             self.imported[rid] = installed
             while len(self.imported) > 256:  # bounded: newest kept
                 self.imported.pop(next(iter(self.imported)))
+            self.imported_bytes[rid] = int(header["totalBytes"])
+            while len(self.imported_bytes) > 256:
+                self.imported_bytes.pop(next(iter(self.imported_bytes)))
             if self.tracer is not None:
                 self.tracer.record(
                     rid, "kvx.import", t0, time.time(),

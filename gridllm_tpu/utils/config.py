@@ -326,6 +326,18 @@ register_env("GRIDLLM_WATCHDOG_PROFILE_S", "0",
 register_env("GRIDLLM_FLIGHTREC_CAPACITY", "256",
              "Flight-recorder ring capacity per subsystem.")
 
+# observability: usage attribution / capacity signals
+register_env("GRIDLLM_TENANT_HEADER", "X-GridLLM-Tenant",
+             "HTTP header the gateway reads the tenant id from; falls "
+             "back to a hash of the Authorization bearer, else "
+             "'anonymous'.")
+register_env("GRIDLLM_TENANT_LRU", "64",
+             "Max distinct tenant label values per registry; overflow "
+             "tenants are folded into the 'other' bucket.")
+register_env("GRIDLLM_CAPACITY_EWMA_HALFLIFE_S", "60",
+             "Half-life (seconds) of the per-model arrival/service rate "
+             "and wait-time EWMAs behind /admin/capacity.")
+
 # observability: perf introspection
 register_env("GRIDLLM_RECOMPILE_BUDGET", "4",
              "Steady-state recompiles tolerated per window before a "

@@ -147,6 +147,10 @@ class WorkerInfo(_Model):
     # host:port of the worker's health HTTP server, for the direct
     # worker-to-worker KV transfer fallback (large payloads)
     httpAddr: str = ""
+    # per-model capacity headroom from the latest heartbeat (ISSUE 16):
+    # {model: {"slotsFree", "slotsTotal", "kvPagesFree"}} — the demand
+    # tracker behind /admin/capacity aggregates these across workers
+    modelCapacity: dict[str, dict[str, int]] = Field(default_factory=dict)
 
     def model_names(self) -> list[str]:
         return [m.name for m in self.capabilities.availableModels]
@@ -274,3 +278,9 @@ class JobResult(_Model):
     nack: bool = False
     completedAt: float = Field(default_factory=time.time)
     processingTimeMs: float = 0
+    # per-request cost attribution (ISSUE 16): tenant/model plus token,
+    # device-second, KV-page-second, and migrated-byte tallies built by
+    # the worker at finish (obs.usage.build_usage). The OWNING shard
+    # folds this into its per-tenant ledger exactly once; absent on
+    # failures, nacks, and pre-ISSUE 16 workers
+    usage: dict[str, Any] | None = None

@@ -326,6 +326,30 @@ def test_metric_hygiene_fires_on_bad_name_label_help(tmp_path):
                for m in msgs), msgs
 
 
+def test_metric_hygiene_confines_tenant_labels_to_usage_ledger(tmp_path):
+    # ISSUE 16: a `tenant` label is legal only in obs/usage.py (where the
+    # TenantLRU bounds its cardinality); the identical registration in any
+    # other module must fire
+    root = make_repo(tmp_path, {
+        "gridllm_tpu/rogue.py": (
+            "from gridllm_tpu.obs import default_registry\n"
+            "A = default_registry().counter(\n"
+            "    'gridllm_rogue_total', 'Rogue.', ('tenant', 'model'))\n"
+        ),
+        "gridllm_tpu/obs/usage.py": (
+            "from gridllm_tpu.obs import default_registry\n"
+            "B = default_registry().counter(\n"
+            "    'gridllm_ledger_total', 'Ledger.', ('tenant', 'model'))\n"
+        ),
+        "README.md": _full_env_table() +
+            "\n| `gridllm_rogue_total` `gridllm_ledger_total` | seeded |\n",
+    })
+    msgs = [f.message for f in findings_for(root, "metric-hygiene")]
+    assert any("gridllm_rogue_total" in m and "tenant" in m
+               for m in msgs), msgs
+    assert not any("gridllm_ledger_total" in m for m in msgs), msgs
+
+
 # -- channel-discipline (ISSUE 13) ------------------------------------------
 
 # a minimal bus/base.py channel registry for fixture repos: two families
