@@ -208,6 +208,25 @@ def _stage_stats(tracer, request_ids) -> dict:
     return out
 
 
+def _critical_path_stats(tracer, request_ids) -> dict:
+    """p50 per-segment critical-path decomposition (ms) across the
+    measured requests (ISSUE 17). Unlike _stage_stats' raw span
+    durations these segments are ADDITIVE — per request they sum to the
+    traced e2e latency — so the record carries a decomposition that
+    explains 100% of the latency, not a set of overlapping timers."""
+    from gridllm_tpu.obs.timeline import critical_path
+
+    per_seg: dict[str, list[float]] = {}
+    for rid in request_ids:
+        segs = critical_path(tracer.export(rid) or [])
+        if not segs:
+            continue  # root span not sealed (request still in flight)
+        for seg, seconds in segs.items():
+            per_seg.setdefault(seg, []).append(seconds * 1000.0)
+    return {seg: round(statistics.median(vals), 2)
+            for seg, vals in sorted(per_seg.items()) if vals}
+
+
 async def _run_bench_inner(client_ctx, engine, model, n_requests, n_tokens,
                            prompt_len, profile_dir, ckpt,
                            scheduler=None) -> dict:
@@ -291,6 +310,7 @@ async def _run_bench_inner(client_ctx, engine, model, n_requests, n_tokens,
     await client.close()  # remaining teardown is run_bench's finally
 
     stages = {}
+    critical_path_p50: dict = {}
     slo_attainment = None
     goodput_tok_s = None
     capacity = None
@@ -303,6 +323,7 @@ async def _run_bench_inner(client_ctx, engine, model, n_requests, n_tokens,
             await flush()
         measured = [r for r in scheduler.tracer.ids() if r not in warm_ids]
         stages = _stage_stats(scheduler.tracer, measured)
+        critical_path_p50 = _critical_path_stats(scheduler.tracer, measured)
         # SLO/goodput from the obs SLO engine (ISSUE 2): the measured
         # streams are the "interactive" class (the warmup is non-streaming
         # → "batch", so it does not pollute these numbers)
@@ -327,6 +348,7 @@ async def _run_bench_inner(client_ctx, engine, model, n_requests, n_tokens,
         "tokens": tokens_out[0],
         "wall_s": wall,
         "stages": stages,
+        "critical_path": critical_path_p50,
         "slo_attainment": slo_attainment,
         "goodput_tok_s": goodput_tok_s,
         "capacity": capacity,
@@ -1973,6 +1995,10 @@ def main() -> int:
             # per-stage breakdown from the obs tracer (queue-wait/prefill/
             # decode p50s) — explains the end-to-end numbers above
             payload["stages"] = r["stages"]
+        if r.get("critical_path"):
+            # additive per-segment p50 decomposition (ISSUE 17): unlike
+            # the raw stage durations these sum to the traced e2e
+            payload["critical_path"] = r["critical_path"]
         if r.get("slo_attainment") is not None:
             payload["slo_attainment"] = round(r["slo_attainment"], 4)
         if r.get("goodput_tok_s") is not None:

@@ -1,0 +1,351 @@
+"""event-discipline: every timeline event goes through the typed EVENTS
+registry in ``obs/timeline.py`` (ISSUE 17 — the forensic twin of
+channel-discipline).
+
+The fleet timeline stitches flight-recorder events from every member
+into one causal view; an event name or payload key that drifts from the
+registry silently breaks incident assembly and the README's forensics
+contract. Invariants:
+
+1. Every emission site resolves statically: a flight-recorder
+   ``record(subsystem, event, **fields)`` call (receiver spelled like a
+   recorder) or an ``emit_event(name, ...)`` call outside tests and
+   ``obs/timeline.py`` itself must have a statically known event name —
+   a constant, an inline conditional of constants, a local conditional
+   assignment, or a parameter pinned by same-file call sites.
+2. Every emitted event is declared exactly once in EVENTS, from a
+   declared module, and sends only declared payload keys (``**splat``
+   sites require ``open_keys``). ``emit_event``'s envelope arguments
+   (member/request_id/stamp) are transport attribution, not payload.
+3. Both ways: a declared event no module ever emits — or a declared
+   module/key no site ever uses — is a dead declaration (skipped for
+   ``open_keys`` events, whose key sets are a lower bound).
+4. The README "Timeline events" table and the registry agree both ways
+   (name, keys, emitting modules), the way channel-discipline pins the
+   Bus channels table.
+
+Like channel-discipline, the registry is parsed from the ANALYZED tree;
+fixture repos without an obs/timeline.py registry fall back to the
+imported registry and skip the repo-structure checks (3, 4).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from gridllm_tpu.analysis.core import (
+    Finding,
+    Repo,
+    dotted_name,
+    enclosing_function,
+    rule,
+    str_const,
+)
+
+RULE = "event-discipline"
+TIMELINE = "gridllm_tpu/obs/timeline.py"
+# emit_event() envelope: attribution the publisher strips into the event
+# envelope, never payload keys
+_ENVELOPE = {"member", "request_id", "stamp"}
+
+
+class _Spec:
+    __slots__ = ("name", "keys", "modules", "open_keys", "line")
+
+    def __init__(self, name, keys, modules, open_keys, line):
+        self.name = name
+        self.keys = keys
+        self.modules = modules
+        self.open_keys = open_keys
+        self.line = line
+
+
+def _tuple_const(node: ast.AST | None) -> tuple[str, ...] | None:
+    if isinstance(node, (ast.Tuple, ast.List)):
+        vals = [str_const(e) for e in node.elts]
+        if all(v is not None for v in vals):
+            return tuple(vals)  # type: ignore[arg-type]
+    return None
+
+
+def _parse_registry(repo: Repo) -> tuple[dict[str, _Spec], bool]:
+    """(name -> spec, from_tree) — parsed from the analyzed tree's
+    obs/timeline.py; imported-registry fallback for fixture repos."""
+    f = repo.file(TIMELINE)
+    specs: dict[str, _Spec] = {}
+    if f is not None:
+        for node in f.walk():
+            if not (isinstance(node, ast.Call)
+                    and dotted_name(node.func).endswith("register_event")
+                    and node.args):
+                continue
+            name = str_const(node.args[0])
+            if name is None:
+                continue
+            kw = {k.arg: k.value for k in node.keywords if k.arg}
+            specs[name] = _Spec(
+                name,
+                _tuple_const(kw.get("keys")) or (),
+                _tuple_const(kw.get("modules")) or (),
+                isinstance(kw.get("open_keys"), ast.Constant)
+                and bool(kw["open_keys"].value),  # type: ignore[union-attr]
+                node.lineno,
+            )
+    if specs:
+        return specs, True
+    from gridllm_tpu.obs.timeline import EVENTS
+
+    return {s.name: _Spec(s.name, s.keys, s.modules, s.open_keys, 0)
+            for s in EVENTS.values()}, False
+
+
+def _is_recorder(recv: str) -> bool:
+    low = recv.lower()
+    return "flightrec" in low or "recorder" in low
+
+
+def _resolve_event_names(f, call: ast.Call,
+                         arg: ast.AST) -> list[str] | None:
+    """Statically known spellings of an event-name argument: a constant,
+    an inline ``a if c else b`` of constants, a Name assigned such a
+    conditional in the enclosing function, or a parameter whose value is
+    pinned by every same-file call site. None when unresolvable."""
+    s = str_const(arg)
+    if s is not None:
+        return [s]
+    if isinstance(arg, ast.IfExp):
+        a, b = str_const(arg.body), str_const(arg.orelse)
+        if a is not None and b is not None:
+            return [a, b]
+    if isinstance(arg, ast.Name):
+        fn = enclosing_function(call)
+        if fn is None:
+            return None
+        for st in ast.walk(fn):
+            if (isinstance(st, ast.Assign) and len(st.targets) == 1
+                    and isinstance(st.targets[0], ast.Name)
+                    and st.targets[0].id == arg.id
+                    and isinstance(st.value, ast.IfExp)):
+                a = str_const(st.value.body)
+                b = str_const(st.value.orelse)
+                if a is not None and b is not None:
+                    return [a, b]
+        params = [p.arg for p in fn.args.args]
+        if arg.id in params:
+            idx = params.index(arg.id)
+            names = []
+            for c in f.walk():
+                if (isinstance(c, ast.Call)
+                        and isinstance(c.func, (ast.Name, ast.Attribute))
+                        and dotted_name(c.func).split(".")[-1] == fn.name
+                        and len(c.args) > idx):
+                    s2 = str_const(c.args[idx])
+                    if s2 is not None:
+                        names.append(s2)
+            if names:
+                return sorted(set(names))
+    return None
+
+
+class _Site:
+    __slots__ = ("names", "keys", "splat", "rel", "line")
+
+    def __init__(self, names, keys, splat, rel, line):
+        self.names = names
+        self.keys = keys
+        self.splat = splat
+        self.rel = rel
+        self.line = line
+
+
+def _collect_sites(repo: Repo) -> tuple[list[_Site], list[Finding]]:
+    """Every timeline-event emission site in the package (tests and the
+    registry module itself excluded)."""
+    sites: list[_Site] = []
+    findings: list[Finding] = []
+    for f in repo.package_files():
+        if f.rel == TIMELINE:
+            continue
+        for node in f.walk():
+            if not isinstance(node, ast.Call):
+                continue
+            names: list[str] | None = None
+            kw_start = node.keywords
+            if isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "record" \
+                    and _is_recorder(dotted_name(node.func.value)):
+                if len(node.args) < 2:
+                    continue
+                sub = str_const(node.args[0])
+                evs = _resolve_event_names(f, node, node.args[1])
+                if sub is None or evs is None:
+                    findings.append(Finding(
+                        RULE, f.rel, node.lineno,
+                        "flight-recorder record() with a statically "
+                        "unresolvable subsystem/event name — the timeline "
+                        "cannot be checked against the EVENTS registry"))
+                    continue
+                names = [f"{sub}.{ev}" for ev in evs]
+                envelope: set[str] = set()
+            elif dotted_name(node.func).split(".")[-1] == "emit_event":
+                arg = node.args[0] if node.args else None
+                names = (_resolve_event_names(f, node, arg)
+                         if arg is not None else None)
+                if names is None:
+                    findings.append(Finding(
+                        RULE, f.rel, node.lineno,
+                        "emit_event() with a statically unresolvable "
+                        "event name — the timeline cannot be checked "
+                        "against the EVENTS registry"))
+                    continue
+                envelope = _ENVELOPE
+            else:
+                continue
+            keys: set[str] = set()
+            splat = False
+            for kw in kw_start:
+                if kw.arg is None:
+                    splat = True
+                elif kw.arg not in envelope:
+                    keys.add(kw.arg)
+            sites.append(_Site(names, keys, splat, f.rel, node.lineno))
+    return sites, findings
+
+
+@rule(RULE, "timeline events go through the typed EVENTS registry in "
+            "obs/timeline.py; emission sites, payload keys, modules, and "
+            "the README Timeline events table must all agree with it")
+def check(repo: Repo) -> list[Finding]:
+    specs, from_tree = _parse_registry(repo)
+    sites, findings = _collect_sites(repo)
+
+    emitted: set[str] = set()
+    used_keys: dict[str, set[str]] = {}
+    used_mods: dict[str, set[str]] = {}
+    for site in sites:
+        for name in site.names:
+            spec = specs.get(name)
+            if spec is None:
+                findings.append(Finding(
+                    RULE, site.rel, site.line,
+                    f"timeline event {name!r} is emitted but not declared "
+                    "in the EVENTS registry (obs/timeline.py)"))
+                continue
+            emitted.add(name)
+            used_mods.setdefault(name, set()).add(site.rel)
+            used_keys.setdefault(name, set()).update(site.keys)
+            if site.rel not in spec.modules:
+                findings.append(Finding(
+                    RULE, site.rel, site.line,
+                    f"{site.rel} emits timeline event {name!r} but is not "
+                    "a declared module in the EVENTS registry"))
+            if site.splat and not spec.open_keys:
+                findings.append(Finding(
+                    RULE, site.rel, site.line,
+                    f"timeline event {name!r} is emitted with dynamic "
+                    "**fields but is not declared open_keys"))
+            for k in sorted(site.keys):
+                if k not in spec.keys:
+                    findings.append(Finding(
+                        RULE, site.rel, site.line,
+                        f"payload key {k!r} on timeline event {name!r} is "
+                        "not declared in the EVENTS registry"))
+
+    # -- 3. dead declarations (real repo only — fixture repos have no
+    #       emission sites for the imported registry)
+    if from_tree:
+        for spec in specs.values():
+            if spec.name not in emitted:
+                findings.append(Finding(
+                    RULE, TIMELINE, spec.line,
+                    f"EVENTS declares {spec.name!r}, which no module ever "
+                    "emits — dead declaration or missed migration"))
+                continue
+            for mod in spec.modules:
+                if mod not in used_mods.get(spec.name, set()):
+                    findings.append(Finding(
+                        RULE, TIMELINE, spec.line,
+                        f"EVENTS declares {spec.name!r} emitted from "
+                        f"{mod}, but that module never emits it"))
+            if not spec.open_keys:
+                for k in spec.keys:
+                    if k not in used_keys.get(spec.name, set()):
+                        findings.append(Finding(
+                            RULE, TIMELINE, spec.line,
+                            f"EVENTS declares payload key {k!r} on "
+                            f"{spec.name!r} that no site ever sends"))
+
+    # -- 4. README "Timeline events" table <-> registry, both ways
+    if from_tree:
+        findings.extend(_check_readme(repo, specs))
+    return findings
+
+
+def _keys_cell(spec: _Spec) -> str:
+    if not spec.keys and not spec.open_keys:
+        return "—"
+    body = ", ".join(spec.keys)
+    if spec.open_keys:
+        body = f"{body}, …" if body else "…"
+    return f"`{body}`"
+
+
+def _mods_cell(spec: _Spec) -> str:
+    return ", ".join(m.rsplit("/", 1)[-1].removesuffix(".py")
+                     for m in spec.modules)
+
+
+def _check_readme(repo: Repo, specs: dict[str, _Spec]) -> list[Finding]:
+    findings: list[Finding] = []
+    readme = repo.read_text("README.md")
+    if readme is None:
+        return [Finding(RULE, "README.md", 0, "README.md missing")]
+    in_section = False
+    rows: dict[str, tuple[str, str, int]] = {}  # name -> (keys, mods, line)
+    for i, line in enumerate(readme.splitlines(), 1):
+        if line.startswith("#"):
+            in_section = (line.lstrip("#").strip().lower()
+                          == "timeline events")
+            continue
+        if not in_section or not line.lstrip().startswith("|"):
+            continue
+        cells = [c.strip() for c in line.strip().strip("|").split("|")]
+        if len(cells) < 3:
+            continue
+        m = re.fullmatch(r"`([^`]+)`", cells[0])
+        if m is None or m.group(1) in ("Event",):
+            continue
+        rows.setdefault(m.group(1), (cells[1], cells[2], i))
+    if not rows:
+        return [Finding(
+            RULE, "README.md", 0,
+            "README has no \"Timeline events\" table documenting the "
+            "EVENTS registry")]
+    for name, (keys_cell, mods_cell, line) in sorted(rows.items()):
+        spec = specs.get(name)
+        if spec is None:
+            findings.append(Finding(
+                RULE, "README.md", line,
+                f"README documents timeline event {name!r}, which is not "
+                "in the obs/timeline.py EVENTS registry"))
+            continue
+        if keys_cell != _keys_cell(spec):
+            findings.append(Finding(
+                RULE, "README.md", line,
+                f"README says timeline event {name!r} keys are "
+                f"{keys_cell!r} but the registry says "
+                f"{_keys_cell(spec)!r}"))
+        if mods_cell != _mods_cell(spec):
+            findings.append(Finding(
+                RULE, "README.md", line,
+                f"README says timeline event {name!r} is emitted from "
+                f"{mods_cell!r} but the registry says "
+                f"{_mods_cell(spec)!r}"))
+    for spec in specs.values():
+        if spec.name not in rows:
+            findings.append(Finding(
+                RULE, "README.md", 0,
+                f"registered timeline event {spec.name!r} missing from "
+                "the README Timeline events table"))
+    return findings

@@ -30,6 +30,22 @@ from typing import Any, Awaitable, Callable
 from gridllm_tpu import faults
 from gridllm_tpu.obs import metrics as obs
 
+# Fleet timeline (ISSUE 17): every publish is stamped with the process
+# HLC (inside the broker's seq framing) and every delivery merges the
+# stamp back, so cross-member event order is provable without clock
+# sync. Importing obs.timeline here is safe ONLY because the line above
+# already loaded the whole obs package — timeline.py itself must never
+# import bus code at module level (see its module docstring).
+from gridllm_tpu.obs.timeline import (
+    EDGE_FAMILIES,
+    default_clock,
+    edge_request_id,
+    emit_event,
+    encode_hlc,
+    split_hlc,
+    timeline_armed,
+)
+
 # handler(channel, message) — message is the raw string payload
 Handler = Callable[[str, str], Awaitable[None]]
 
@@ -306,6 +322,41 @@ register_channel(
     helper="plan_channel",
     description="Multi-host SPMD plan replay: liaison publishes ordered "
                 "engine plan ops, followers apply in lockstep.")
+register_channel(
+    "obs:event", pattern="obs:event", payload="keys",
+    keys=("member", "events"), durable=True,
+    publishers=("gridllm_tpu/obs/timeline.py",),
+    subscribers=("gridllm_tpu/obs/timeline.py",),
+    helper="CH_OBS_EVENT",
+    description="Fleet timeline event batches (ISSUE 17): every member's "
+                "TimelinePublisher flushes HLC-stamped lifecycle events "
+                "here; TimelineStore instances on gateway replicas and "
+                "shards merge them into the causal fleet log behind "
+                "/admin/timeline and /admin/incidents. Durable: a "
+                "subscriber mid-reconnect replays the ring instead of "
+                "losing the incident window it exists to capture.")
+register_channel(
+    "obs:dump", pattern="obs:dump", payload="keys",
+    keys=("opId", "requester"),
+    publishers=("gridllm_tpu/gateway/obs_routes.py",),
+    subscribers=("gridllm_tpu/controlplane/status.py",),
+    helper="CH_OBS_DUMP",
+    description="Fleet-merged dump fan-out (ISSUE 17): a gateway replica "
+                "serving /admin/dump?fleet=1 broadcasts a collection op; "
+                "every control-plane member's StatusPublisher answers "
+                "with its local dump artifact on the per-op reply "
+                "channel. Best-effort — a silent member is reported "
+                "missing, never silently merged.")
+register_channel(
+    "obs:dump:reply", pattern="obs:dump:reply:{op_id}", payload="keys",
+    keys=("opId", "member", "dump"), durable=True,
+    publishers=("gridllm_tpu/controlplane/status.py",),
+    subscribers=("gridllm_tpu/gateway/obs_routes.py",),
+    helper="obs_dump_reply_channel",
+    description="Per-op replies to a fleet dump collection: one message "
+                "per live member, keyed by member identity. Durable so a "
+                "reply published while the requester's subscriber is "
+                "still settling replays instead of vanishing.")
 
 
 # -- registry constants & helpers (the only sanctioned channel spellings) ----
@@ -325,6 +376,8 @@ CH_JOB_PREEMPTED = "job:preempted"
 CH_CTRL_SUBMIT = "ctrl:submit"
 CH_CTRL_CANCEL = "ctrl:cancel"
 CH_CTRL_STATUS = "ctrl:status"
+CH_OBS_EVENT = "obs:event"
+CH_OBS_DUMP = "obs:dump"
 
 
 def worker_job_channel(worker_id: str) -> str:
@@ -353,6 +406,10 @@ def kvx_channel(xfer_id: str) -> str:
 
 def plan_channel(worker_id: str) -> str:
     return f"slice:{worker_id}:plan"
+
+
+def obs_dump_reply_channel(op_id: str) -> str:
+    return f"obs:dump:reply:{op_id}"
 
 
 # -- derived classification (pattern matchers over the registry) -------------
@@ -472,13 +529,28 @@ def liveness_suspended(bus: "MessageBus", grace_ms: float) -> bool:
     return (time.monotonic() - float(rejoined)) * 1000.0 < grace_ms
 
 
-def record_publish(channel: str) -> None:
+def record_publish(channel: str, message: str | None = None) -> str | None:
     """Called by bus implementations on every publish. The bus.publish
     fault site lives here — BEFORE the accounting and the actual send, so
     an injected publish failure looks exactly like a dead bus to the
-    caller (the message never leaves the process)."""
+    caller (the message never leaves the process).
+
+    Fleet timeline (ISSUE 17): when ``message`` is given, it comes back
+    HLC-framed (stamped with the process clock's ``tick()``) and the bus
+    implementation sends the RETURNED string; lifecycle families in
+    ``EDGE_FAMILIES`` additionally leave a ``bus.send`` edge event
+    carrying the same stamp, so a receiver's merge provably orders the
+    matching ``bus.recv`` after it."""
     faults.inject("bus.publish")
-    _PUBLISHED.inc(channel=channel_class(channel))
+    cls = channel_class(channel)
+    _PUBLISHED.inc(channel=cls)
+    if message is None:
+        return None
+    stamp = default_clock().tick()
+    if timeline_armed() and cls in EDGE_FAMILIES:
+        emit_event("bus.send", request_id=edge_request_id(message),
+                   stamp=stamp, channel=cls)
+    return encode_hlc(stamp, message)
 
 
 class HandlerPump:
@@ -498,10 +570,21 @@ class HandlerPump:
             if faults.check("bus.deliver"):
                 # injected delivery loss: the handler never sees the
                 # message — exactly what an at-least-once consumer must
-                # survive via sweeps/retries/heartbeat timeouts
+                # survive via sweeps/retries/heartbeat timeouts (and no
+                # HLC merge: a dropped message established no order)
                 self.queue.task_done()
                 continue
             cls = channel_class(channel)
+            stamp, message = split_hlc(message)
+            if stamp is not None:
+                # HLC merge hook (ISSUE 17): the local clock advances
+                # past the sender's stamp, so every event this process
+                # emits from here on is provably after the send
+                merged = default_clock().update(stamp)
+                if timeline_armed() and cls in EDGE_FAMILIES:
+                    emit_event("bus.recv",
+                               request_id=edge_request_id(message),
+                               stamp=merged, channel=cls)
             _DELIVERED.inc(channel=cls)
             _DELIVERY_LATENCY.observe(
                 max(0.0, time.monotonic() - t_push), channel=cls

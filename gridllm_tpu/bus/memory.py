@@ -104,7 +104,8 @@ class InMemoryBus(MessageBus):
 
     # -- pub/sub ------------------------------------------------------------
     async def publish(self, channel: str, message: str) -> int:
-        record_publish(channel)
+        # HLC-framed by record_publish (ISSUE 17); pumps strip + merge
+        message = record_publish(channel, message) or message
         pumps: list[HandlerPump] = list(self._subs.get(channel, []))
         for pattern, phs in self._psubs.items():
             if fnmatch.fnmatchcase(channel, pattern):

@@ -646,7 +646,10 @@ class RespBus(MessageBus):
 
     # -- pub/sub ------------------------------------------------------------
     async def publish(self, channel: str, message: str) -> int:
-        record_publish(channel)
+        # HLC-framed by record_publish (ISSUE 17); the broker's seq
+        # framing wraps OUTSIDE this, so _dedupe strips seq first and
+        # the HandlerPump strips + merges the surviving HLC frame
+        message = record_publish(channel, message) or message
         return int(await self._pub.command("PUBLISH", channel, message))
 
     async def subscribe(self, channel: str, handler: Handler) -> Subscription:

@@ -43,6 +43,32 @@ async def run_shard() -> None:
     shard = SchedulerShard(bus, registry, config.scheduler, cp,
                            slo_config=config.obs.slo,
                            watchdog_config=config.obs.watchdog)
+    # fleet timeline (ISSUE 17): shards publish their lifecycle events and
+    # keep their own store + incident collector, so a surviving shard's
+    # health port answers /admin/incidents even with every gateway down
+    timeline_pub = None
+    timeline_store = None
+    incidents = None
+    tl = config.obs.timeline
+    if tl.enabled:
+        from gridllm_tpu.obs import (
+            IncidentCollector,
+            TimelinePublisher,
+            TimelineStore,
+        )
+
+        timeline_pub = TimelinePublisher(
+            shard.member_id, queue_capacity=tl.queue_capacity,
+            flush_ms=tl.flush_ms, batch_max=tl.batch_max)
+        timeline_store = TimelineStore(capacity=tl.store_capacity,
+                                       max_requests=tl.store_requests)
+        incidents = IncidentCollector(
+            timeline_store, member=shard.member_id,
+            window_ms=tl.incident_window_ms,
+            max_incidents=tl.max_incidents)
+        timeline_pub.install()
+        await timeline_pub.start(bus)
+        await timeline_store.attach(bus)
     await registry.initialize()
     await shard.start()
     status = StatusPublisher(bus, shard.scheduler, "shard",
@@ -53,7 +79,9 @@ async def run_shard() -> None:
     runner: web.AppRunner | None = None
     if cp.shard_health_port:
         app = web.Application()
-        app.add_routes(obs_routes.build_routes(shard.scheduler))
+        app.add_routes(obs_routes.build_routes(shard.scheduler,
+                                               timeline=timeline_store,
+                                               incidents=incidents))
 
         async def live(_request: web.Request) -> web.Response:
             return web.json_response({
@@ -83,6 +111,10 @@ async def run_shard() -> None:
         await runner.cleanup()
     await shard.stop()
     await registry.shutdown()
+    if timeline_pub is not None:
+        await timeline_pub.stop()
+    if timeline_store is not None:
+        await timeline_store.detach()
     await bus.disconnect()
 
 

@@ -29,6 +29,7 @@ from gridllm_tpu.bus.base import (
     CH_CTRL_SUBMIT,
     MessageBus,
 )
+from gridllm_tpu.obs.timeline import emit_event
 from gridllm_tpu.obs.tracer import trace_pattern
 from gridllm_tpu.scheduler.registry import WorkerRegistry
 from gridllm_tpu.scheduler.scheduler import JobScheduler
@@ -90,6 +91,11 @@ class GatewaySubmitter(JobScheduler):
         # here too would double every job fleet-wide and break the
         # "queued balances against terminal events" invariant
         self._ctrl_submits.inc(event="published")
+        # fleet timeline (ISSUE 17): the gateway-side anchor of every
+        # request's causal slice — attributed to THIS replica, ordered
+        # before the owning shard's events by the ctrl:submit bus edge
+        emit_event("gateway.submitted", member=self.member_id,
+                   request_id=request.id, model=request.model)
         log.job("job published to scheduler shards", request.id,
                 model=request.model)
         self.emit("job_queued", request)

@@ -22,8 +22,14 @@ import json
 import time
 from typing import Any
 
-from gridllm_tpu.bus.base import CH_CTRL_STATUS, MessageBus, Subscription
-from gridllm_tpu.obs import MetricsRegistry, merge_capacity
+from gridllm_tpu.bus.base import (
+    CH_CTRL_STATUS,
+    CH_OBS_DUMP,
+    MessageBus,
+    Subscription,
+    obs_dump_reply_channel,
+)
+from gridllm_tpu.obs import MetricsRegistry, build_dump, merge_capacity
 from gridllm_tpu.utils.logging import get_logger
 
 log = get_logger("controlplane.status")
@@ -45,6 +51,7 @@ class StatusPublisher:
         self.interval_s = interval_ms / 1000.0
         self.lease = lease
         self._task: asyncio.Task | None = None
+        self._dump_sub: Subscription | None = None
 
     def _per_shard_counts(self) -> dict[str, dict[str, Any]]:
         """Exact per-partition queue/active counts (a member may hold
@@ -92,11 +99,39 @@ class StatusPublisher:
 
     async def start(self) -> None:
         self._task = asyncio.create_task(self._loop())
+        # fleet-merged dump (ISSUE 17): every member with a status
+        # publisher also answers dump collection ops, so ONE
+        # /admin/dump?fleet=1 call captures the whole control plane
+        self._dump_sub = await self.bus.subscribe(CH_OBS_DUMP,
+                                                  self._on_dump_request)
 
     async def stop(self) -> None:
         if self._task is not None:
             self._task.cancel()
             self._task = None
+        if self._dump_sub is not None:
+            await self._dump_sub.unsubscribe()
+            self._dump_sub = None
+
+    async def _on_dump_request(self, _ch: str, raw: str) -> None:
+        """Answer one fleet dump collection op with this member's local
+        artifact on the per-op reply channel (keyed by member identity;
+        the requester never merges artifacts silently)."""
+        try:
+            op_id = str(json.loads(raw).get("opId") or "")
+        except (ValueError, TypeError):
+            return
+        if not op_id:
+            return
+        try:
+            artifact = build_dump(self.scheduler, reason="fleet_dump")
+            await self.bus.publish(
+                obs_dump_reply_channel(op_id),
+                json.dumps({"opId": op_id, "member": self.member_id,
+                            "dump": artifact}, default=str))
+        except Exception as e:  # noqa: BLE001 — dumps are best-effort
+            log.warning("fleet dump reply failed", error=str(e),
+                        opId=op_id)
 
     async def publish_once(self) -> None:
         await self.bus.publish(CH_CTRL_STATUS, self.envelope())

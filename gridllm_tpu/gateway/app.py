@@ -34,9 +34,12 @@ log = get_logger("gateway.app")
 
 
 def create_app(bus: MessageBus, registry: WorkerRegistry, scheduler: JobScheduler,
-               config: Config | None = None, fleet=None) -> web.Application:
+               config: Config | None = None, fleet=None,
+               timeline=None, incidents=None) -> web.Application:
     """``fleet`` (ISSUE 15): a FleetView on scaled-control-plane gateway
-    replicas — the admin/health surfaces then answer fleet-wide."""
+    replicas — the admin/health surfaces then answer fleet-wide.
+    ``timeline``/``incidents`` (ISSUE 17): this member's TimelineStore +
+    IncidentCollector behind /admin/timeline and /admin/incidents."""
     config = config or load_config()
     version = gridllm_tpu.__version__
     app = web.Application(
@@ -82,7 +85,9 @@ def create_app(bus: MessageBus, registry: WorkerRegistry, scheduler: JobSchedule
     app.add_routes(inference_routes.build_routes(registry, scheduler))
     app.add_routes(health_routes.build_routes(bus, registry, scheduler,
                                               version, fleet=fleet))
-    app.add_routes(obs_routes.build_routes(scheduler, fleet=fleet))
+    app.add_routes(obs_routes.build_routes(scheduler, fleet=fleet,
+                                           timeline=timeline,
+                                           incidents=incidents))
 
     async def root(request: web.Request) -> web.Response:
         """Root summary (reference: server/src/index.ts:86-109)."""
@@ -159,8 +164,35 @@ class GatewayServer:
                 self.bus, self.registry, self.config.scheduler,
                 slo_config=self.config.obs.slo,
                 watchdog_config=self.config.obs.watchdog)
+        # fleet timeline & incident forensics (ISSUE 17): every gateway —
+        # local or replica — arms the event publisher plus a store +
+        # collector, so any member answers /admin/timeline + /admin/incidents
+        self.timeline_store = None
+        self.incidents = None
+        self._timeline_pub = None
+        tl = self.config.obs.timeline
+        if tl.enabled:
+            from gridllm_tpu.obs import (
+                IncidentCollector,
+                TimelinePublisher,
+                TimelineStore,
+            )
+
+            member = self.scheduler.identity().get("member") or "local"
+            self._timeline_pub = TimelinePublisher(
+                member, queue_capacity=tl.queue_capacity,
+                flush_ms=tl.flush_ms, batch_max=tl.batch_max)
+            self.timeline_store = TimelineStore(
+                capacity=tl.store_capacity,
+                max_requests=tl.store_requests)
+            self.incidents = IncidentCollector(
+                self.timeline_store, member=member,
+                window_ms=tl.incident_window_ms,
+                max_incidents=tl.max_incidents)
         self.app = create_app(self.bus, self.registry, self.scheduler,
-                              self.config, fleet=self.fleet)
+                              self.config, fleet=self.fleet,
+                              timeline=self.timeline_store,
+                              incidents=self.incidents)
         self._runner: web.AppRunner | None = None
         self._status_task: asyncio.Task | None = None
         self._wire_events()
@@ -182,6 +214,12 @@ class GatewayServer:
 
     async def start(self, port: int | None = None) -> int:
         await self.bus.connect()
+        if self._timeline_pub is not None:
+            # armed before scheduler/registry init so their lifecycle
+            # events are on the fleet timeline from the first moment
+            self._timeline_pub.install()
+            await self._timeline_pub.start(self.bus)
+            await self.timeline_store.attach(self.bus)
         await self.registry.initialize()
         await self.scheduler.initialize()
         if self.fleet is not None:
@@ -219,6 +257,10 @@ class GatewayServer:
             await self.fleet.stop()
         await self.scheduler.shutdown()
         await self.registry.shutdown()
+        if self._timeline_pub is not None:
+            await self._timeline_pub.stop()
+        if self.timeline_store is not None:
+            await self.timeline_store.detach()
         await self.bus.disconnect()
 
 

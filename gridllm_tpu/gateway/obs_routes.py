@@ -32,18 +32,27 @@
 from __future__ import annotations
 
 import asyncio
+import json
 import time
+import uuid
 
 from aiohttp import web
 
+from gridllm_tpu.bus.base import CH_OBS_DUMP, obs_dump_reply_channel
 from gridllm_tpu.obs import (
     PROMETHEUS_CONTENT_TYPE,
     build_dump,
     default_flight_recorder,
     default_registry,
     render_registries,
+    stamp_key,
+    timeline_emitter,
 )
 from gridllm_tpu.scheduler import JobScheduler
+
+# how long /admin/dump?fleet=1 waits for member replies before reporting
+# the silent ones as missing (never silently merged, never hung)
+FLEET_DUMP_TIMEOUT_S = 2.0
 
 
 def metrics_middleware(scheduler: JobScheduler):
@@ -98,13 +107,123 @@ def metrics_middleware(scheduler: JobScheduler):
 
 
 def build_routes(scheduler: JobScheduler,
-                 fleet=None) -> list[web.RouteDef]:
+                 fleet=None, timeline=None,
+                 incidents=None) -> list[web.RouteDef]:
     """``fleet`` (controlplane/status.py FleetView, ISSUE 15) is present
     on scaled-control-plane gateway replicas: /admin/slo and /admin/dump
     then attach the fleet-wide aggregation — keyed by member/shard
     identity, never silently summed — so any replica answers for the
     whole control plane. /metrics serves the same view through the
-    FleetView's collector gauges (gridllm_shard_*)."""
+    FleetView's collector gauges (gridllm_shard_*).
+
+    ``timeline`` / ``incidents`` (obs/timeline.py TimelineStore +
+    obs/forensics.py IncidentCollector, ISSUE 17) arm the
+    /admin/timeline/{request_id} and /admin/incidents forensic surfaces;
+    None (timeline disabled) serves 503 so a disarmed member is
+    distinguishable from an empty timeline."""
+
+    async def _flush_local_timeline() -> None:
+        # serving a forensic read flushes THIS process's pending events
+        # first, so single-process fleets (tests, bench) read their own
+        # just-emitted history without waiting a flush interval
+        pub = timeline_emitter()
+        if pub is not None:
+            for _ in range(8):
+                if await pub.flush_once() == 0:
+                    break
+        drain = getattr(scheduler.bus, "flush", None)
+        if drain is not None:
+            try:
+                await drain()
+            except Exception:  # noqa: BLE001 — reads stay best-effort
+                pass
+
+    async def timeline_slice(request: web.Request) -> web.Response:
+        if timeline is None:
+            raise web.HTTPServiceUnavailable(
+                text="timeline disabled (GRIDLLM_TIMELINE=0)")
+        request_id = request.match_info["request_id"]
+        await _flush_local_timeline()
+        events = timeline.slice(request_id)
+        spans = scheduler.tracer.export(request_id) or []
+        if not events and not spans:
+            from gridllm_tpu.gateway.errors import ApiError
+
+            raise ApiError(
+                f"No timeline recorded for request '{request_id}'",
+                404, "TIMELINE_NOT_FOUND")
+        return web.json_response({
+            "requestId": request_id,
+            "events": events,  # HLC (causal) order, fleet-stitched
+            "spans": spans,    # tracer wall-clock intervals, merged in
+            "members": sorted({str(ev.get("member") or "?")
+                               for ev in events}),
+        })
+
+    async def timeline_window(request: web.Request) -> web.Response:
+        if timeline is None:
+            raise web.HTTPServiceUnavailable(
+                text="timeline disabled (GRIDLLM_TIMELINE=0)")
+        await _flush_local_timeline()
+        events = sorted(timeline.events(), key=stamp_key)
+        try:
+            limit = int(request.query.get("limit", "256"))
+        except ValueError:
+            limit = 256
+        if limit > 0:
+            events = events[-limit:]
+        return web.json_response({
+            "events": events,  # HLC (causal) order, fleet-merged
+            "members": sorted({str(ev.get("member") or "?")
+                               for ev in events}),
+        })
+
+    async def incident_reports(request: web.Request) -> web.Response:
+        if incidents is None:
+            raise web.HTTPServiceUnavailable(
+                text="timeline disabled (GRIDLLM_TIMELINE=0)")
+        await _flush_local_timeline()
+        return web.json_response({
+            "member": scheduler.identity(),
+            "incidents": incidents.reports(),
+        })
+
+    async def _collect_fleet_dumps() -> dict:
+        """Broadcast a dump op and gather per-member replies through the
+        bus (every StatusPublisher answers); silent members are listed
+        as missing rather than merged away."""
+        op_id = uuid.uuid4().hex[:12]
+        expected = set(fleet.members())
+        replies: dict[str, object] = {}
+        done = asyncio.Event()
+
+        async def on_reply(_ch: str, raw: str) -> None:
+            try:
+                data = json.loads(raw)
+                member = str(data["member"])
+            except Exception:
+                return
+            replies[member] = data.get("dump")
+            if expected <= set(replies):
+                done.set()
+
+        sub = await scheduler.bus.subscribe(
+            obs_dump_reply_channel(op_id), on_reply)
+        try:
+            await scheduler.bus.publish(CH_OBS_DUMP, json.dumps({
+                "opId": op_id, "requester": scheduler.identity().get(
+                    "member")}))
+            try:
+                await asyncio.wait_for(done.wait(), FLEET_DUMP_TIMEOUT_S)
+            except asyncio.TimeoutError:
+                pass
+        finally:
+            await sub.unsubscribe()
+        return {
+            "requested": sorted(expected),
+            "missing": sorted(expected - set(replies)),
+            "members": replies,
+        }
 
     async def metrics(request: web.Request) -> web.Response:
         text = render_registries(scheduler.metrics, default_registry())
@@ -155,6 +274,11 @@ def build_routes(scheduler: JobScheduler,
                 "members": fleet.members(),
                 "stats": fleet.merged_stats(),
             }
+            if request.query.get("fleet"):
+                # fleet-merged dump (ISSUE 17): every live member's own
+                # artifact, keyed by member identity — one call captures
+                # the whole control plane post-incident
+                artifact["fleet"] = await _collect_fleet_dumps()
         return web.json_response(artifact)
 
     async def memory(request: web.Request) -> web.Response:
@@ -170,6 +294,9 @@ def build_routes(scheduler: JobScheduler,
     return [
         web.get("/metrics", metrics),
         web.get("/admin/trace/{request_id}", trace),
+        web.get("/admin/timeline", timeline_window),
+        web.get("/admin/timeline/{request_id}", timeline_slice),
+        web.get("/admin/incidents", incident_reports),
         web.get("/admin/slo", slo),
         web.get("/admin/capacity", capacity),
         web.get("/admin/dump", dump),

@@ -29,6 +29,7 @@ from gridllm_tpu.obs.flightrec import (
     register_engine_probe,
     unregister_engine_probe,
 )
+from gridllm_tpu.obs.forensics import TRIGGERS, IncidentCollector
 from gridllm_tpu.obs.metrics import (
     LATENCY_BUCKETS,
     PROMETHEUS_CONTENT_TYPE,
@@ -51,6 +52,26 @@ from gridllm_tpu.obs.perf import (
     unregister_memory_probe,
 )
 from gridllm_tpu.obs.slo import SLOEngine, classify_request
+from gridllm_tpu.obs.timeline import (
+    CRITICAL_PATH_SEGMENTS,
+    EDGE_FAMILIES,
+    EVENTS,
+    HLC,
+    EventSpec,
+    HLCStamp,
+    TimelinePublisher,
+    TimelineStore,
+    critical_path,
+    default_clock,
+    emit_event,
+    encode_hlc,
+    register_event,
+    set_emitter,
+    split_hlc,
+    stamp_key,
+    timeline_armed,
+    timeline_emitter,
+)
 from gridllm_tpu.obs.tracer import (
     TRACE_CHANNEL_PREFIX,
     Span,
@@ -68,16 +89,24 @@ from gridllm_tpu.obs.usage import (
 from gridllm_tpu.obs.watchdog import HangWatchdog
 
 __all__ = [
+    "CRITICAL_PATH_SEGMENTS",
+    "EDGE_FAMILIES",
+    "EVENTS",
+    "HLC",
     "LATENCY_BUCKETS",
     "PROMETHEUS_CONTENT_TYPE",
     "SIZE_BUCKETS",
+    "TRIGGERS",
     "CaptureBusy",
     "Counter",
     "DemandTracker",
+    "EventSpec",
     "FlightRecorder",
     "Gauge",
+    "HLCStamp",
     "HangWatchdog",
     "Histogram",
+    "IncidentCollector",
     "MetricsRegistry",
     "ProfilerCapture",
     "RecompileTripwire",
@@ -85,6 +114,8 @@ __all__ = [
     "Span",
     "TRACE_CHANNEL_PREFIX",
     "TenantLRU",
+    "TimelinePublisher",
+    "TimelineStore",
     "Tracer",
     "UsageAccountant",
     "account_engine_usage",
@@ -92,16 +123,26 @@ __all__ = [
     "build_dump",
     "build_usage",
     "classify_request",
+    "critical_path",
+    "default_clock",
     "default_flight_recorder",
     "default_profiler",
     "default_registry",
+    "emit_event",
+    "encode_hlc",
     "memory_snapshot",
     "merge_capacity",
     "recompile_totals",
     "register_engine_probe",
+    "register_event",
     "register_memory_probe",
     "render_registries",
     "resolve_tenant",
+    "set_emitter",
+    "split_hlc",
+    "stamp_key",
+    "timeline_armed",
+    "timeline_emitter",
     "trace_channel",
     "trace_pattern",
     "unregister_engine_probe",

@@ -38,6 +38,10 @@ class FlightRecorder:
         self._rings: dict[str, deque[dict[str, Any]]] = {}
         self._auto_dumps: deque[dict[str, Any]] = deque(maxlen=max_auto_dumps)
         self._dropped: dict[str, int] = {}  # subsystem → events evicted
+        # fleet timeline tap (ISSUE 17): obs/timeline.py's publisher
+        # mirrors every record() onto the causal event bus without the
+        # ~60 existing call sites changing
+        self._tap: Callable[[str, str, dict[str, Any]], None] | None = None
 
     def set_capacity(self, capacity: int) -> None:
         """Resize the rings (GRIDLLM_FLIGHTREC_CAPACITY at process start —
@@ -46,6 +50,12 @@ class FlightRecorder:
             self.capacity = capacity
             for name, ring in self._rings.items():
                 self._rings[name] = deque(ring, maxlen=capacity)
+
+    def set_tap(self,
+                fn: Callable[[str, str, dict[str, Any]], None] | None) -> None:
+        """Install (or clear) the timeline tap called after every
+        ``record()`` append."""
+        self._tap = fn
 
     def record(self, subsystem: str, event: str, **fields: Any) -> None:
         """Append one event. Fields must be JSON-able plain data; callers
@@ -58,6 +68,12 @@ class FlightRecorder:
             if len(ring) == self.capacity:
                 self._dropped[subsystem] = self._dropped.get(subsystem, 0) + 1
             ring.append(entry)
+        tap = self._tap
+        if tap is not None:
+            try:  # outside the lock; the ring append must never fail
+                tap(subsystem, event, fields)
+            except Exception:  # noqa: BLE001 — telemetry is best-effort
+                pass
 
     def snapshot(self) -> dict[str, Any]:
         """Ring contents, oldest-first, plus eviction counts so a reader

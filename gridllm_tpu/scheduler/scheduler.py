@@ -65,6 +65,7 @@ from gridllm_tpu.obs import (
     classify_request,
     default_flight_recorder,
 )
+from gridllm_tpu.obs.timeline import CRITICAL_PATH_SEGMENTS, critical_path
 from gridllm_tpu.obs.tracer import TRACE_CHANNEL_PREFIX, trace_pattern
 from gridllm_tpu.scheduler.registry import WorkerRegistry
 from gridllm_tpu.utils.config import SchedulerConfig, SLOConfig, WatchdogConfig
@@ -182,6 +183,18 @@ class JobScheduler(EventEmitter):
         )
         self._queue_depth = self.metrics.gauge(
             "gridllm_scheduler_queue_depth", "Jobs currently queued.")
+        # critical-path decomposition (ISSUE 17): each sealed request's
+        # e2e latency split into additive segments by obs/timeline.py's
+        # interval sweep over the stitched trace
+        self._critical_path = self.metrics.histogram(
+            "gridllm_critical_path_seconds",
+            "Per-request e2e latency decomposed into additive "
+            "critical-path segments (queue_wait/dispatch/prefill/"
+            "decode_device/decode_host_stall/migration/suspend_resume); "
+            "segments of one request sum to its traced e2e latency.",
+            ("segment",),
+        )
+        self._cp_observed: dict[str, float] = {}  # rid → observed-at (bounded)
         self._active_gauge = self.metrics.gauge(
             "gridllm_scheduler_active_jobs",
             "Jobs currently assigned to workers.")
@@ -527,6 +540,30 @@ class JobScheduler(EventEmitter):
             return
         if rid and isinstance(spans, list):
             self.tracer.ingest(rid, spans)
+            # the worker half may land before OR after the gateway seals
+            # the root span — both paths try, the guard keeps it to one
+            # observation per request
+            self._observe_critical_path(rid)
+
+    def _observe_critical_path(self, request_id: str) -> None:
+        """Decompose a sealed request's e2e latency into the additive
+        ``gridllm_critical_path_seconds{segment}`` observations. No-op
+        until the root span is sealed; at most once per request."""
+        if request_id in self._cp_observed:
+            return
+        spans = self.tracer.export(request_id)
+        if not spans:
+            return
+        seg = critical_path(spans)
+        if seg is None:
+            return
+        self._cp_observed[request_id] = time.monotonic()
+        if len(self._cp_observed) > 2048:  # bounded like _recent_done
+            cutoff = sorted(self._cp_observed.values())[1024]
+            self._cp_observed = {k: v for k, v in self._cp_observed.items()
+                                 if v > cutoff}
+        for name in CRITICAL_PATH_SEGMENTS:
+            self._critical_path.observe(seg[name], segment=name)
 
     def _begin_queue_span(self, request: InferenceRequest, **meta: Any) -> None:
         """Open a queue.wait span for a (re)queued job; closed at dispatch
@@ -660,6 +697,7 @@ class JobScheduler(EventEmitter):
                 self._drop_resume_state(request.id)
                 self.tracer.end(root, outcome=outcome)
                 self.tracer.finish(request.id)
+                self._observe_critical_path(request.id)
                 for sub in subs:
                     await sub.unsubscribe()
 

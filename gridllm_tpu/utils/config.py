@@ -326,6 +326,34 @@ register_env("GRIDLLM_WATCHDOG_PROFILE_S", "0",
 register_env("GRIDLLM_FLIGHTREC_CAPACITY", "256",
              "Flight-recorder ring capacity per subsystem.")
 
+# observability: fleet timeline & incident forensics (ISSUE 17)
+register_env("GRIDLLM_TIMELINE", "1",
+             "Fleet-wide causal timeline: arm the HLC-stamped event "
+             "publisher (and, on control-plane members, the store + "
+             "incident collector behind /admin/timeline and "
+             "/admin/incidents). 0 disarms all of it.")
+register_env("GRIDLLM_TIMELINE_QUEUE", "2048",
+             "Bounded timeline publisher queue (events); overflow drops "
+             "the OLDEST events and counts them in "
+             "gridllm_timeline_dropped_events_total — emitters never "
+             "block.")
+register_env("GRIDLLM_TIMELINE_FLUSH_MS", "200",
+             "Timeline publisher flush interval (ms): queued events "
+             "batch onto one obs:event message per flush.")
+register_env("GRIDLLM_TIMELINE_BATCH", "256",
+             "Max events per obs:event batch message.")
+register_env("GRIDLLM_TIMELINE_STORE", "4096",
+             "TimelineStore global event ring capacity (per member "
+             "running a store).")
+register_env("GRIDLLM_TIMELINE_REQUESTS", "512",
+             "TimelineStore per-request index: max distinct request ids "
+             "(LRU).")
+register_env("GRIDLLM_TIMELINE_INCIDENT_WINDOW_MS", "5000",
+             "Causal window (± ms around the trigger event) an incident "
+             "report snapshots from the fleet timeline.")
+register_env("GRIDLLM_TIMELINE_INCIDENTS", "32",
+             "Max retained incident reports (oldest evicted).")
+
 # observability: usage attribution / capacity signals
 register_env("GRIDLLM_TENANT_HEADER", "X-GridLLM-Tenant",
              "HTTP header the gateway reads the tenant id from; falls "
@@ -688,6 +716,21 @@ class ControlPlaneConfig(BaseModel):
     shard_health_port: int = Field(4_100, ge=0)
 
 
+class TimelineConfig(BaseModel):
+    """Fleet timeline & incident forensics (ISSUE 17): the HLC-stamped
+    event publisher every member arms, plus the store/collector sizes on
+    members that serve /admin/timeline + /admin/incidents."""
+
+    enabled: bool = True
+    queue_capacity: int = Field(2_048, gt=0)
+    flush_ms: float = Field(200.0, gt=0)
+    batch_max: int = Field(256, gt=0)
+    store_capacity: int = Field(4_096, gt=0)
+    store_requests: int = Field(512, gt=0)
+    incident_window_ms: float = Field(5_000.0, gt=0)
+    max_incidents: int = Field(32, gt=0)
+
+
 class ObsConfig(BaseModel):
     """Interpretation-layer observability (ISSUE 2): SLO engine, hang
     watchdog, flight recorder."""
@@ -696,6 +739,8 @@ class ObsConfig(BaseModel):
     watchdog: WatchdogConfig = Field(default_factory=WatchdogConfig)
     # per-subsystem ring capacity of the flight recorder
     flightrec_capacity: int = Field(256, gt=0)
+    # fleet timeline & incident forensics (ISSUE 17)
+    timeline: TimelineConfig = Field(default_factory=TimelineConfig)
 
 
 class Config(BaseModel):
@@ -835,6 +880,17 @@ def load_config() -> Config:
                         "GRIDLLM_WATCHDOG_PROFILE_S"),
                 ),
                 flightrec_capacity=env_int("GRIDLLM_FLIGHTREC_CAPACITY"),
+                timeline=TimelineConfig(
+                    enabled=env_bool("GRIDLLM_TIMELINE"),
+                    queue_capacity=env_int("GRIDLLM_TIMELINE_QUEUE"),
+                    flush_ms=env_float("GRIDLLM_TIMELINE_FLUSH_MS"),
+                    batch_max=env_int("GRIDLLM_TIMELINE_BATCH"),
+                    store_capacity=env_int("GRIDLLM_TIMELINE_STORE"),
+                    store_requests=env_int("GRIDLLM_TIMELINE_REQUESTS"),
+                    incident_window_ms=env_float(
+                        "GRIDLLM_TIMELINE_INCIDENT_WINDOW_MS"),
+                    max_incidents=env_int("GRIDLLM_TIMELINE_INCIDENTS"),
+                ),
             ),
         )
     except (ValidationError, ValueError) as e:  # pragma: no cover - fail fast

@@ -265,6 +265,21 @@ async def run(config: Config | None = None) -> None:
                      endpoints=config.bus.endpoints)
     await bus.connect()
 
+    # fleet timeline (ISSUE 17): the worker publishes its flight-recorder
+    # lifecycle events on obs:event so gateway/shard timelines include the
+    # execution side. Publisher only — incident stores live control-plane
+    # side. Batched + drop-counted: the decode loop never blocks on it.
+    timeline_pub = None
+    tl = config.obs.timeline
+    if tl.enabled:
+        from gridllm_tpu.obs import TimelinePublisher
+
+        timeline_pub = TimelinePublisher(
+            config.worker.worker_id, queue_capacity=tl.queue_capacity,
+            flush_ms=tl.flush_ms, batch_max=tl.batch_max)
+        timeline_pub.install()
+        await timeline_pub.start(bus)
+
     stop = asyncio.Event()
     slice_broken: list[str] = []
     if group.is_liaison:
@@ -371,6 +386,8 @@ async def run(config: Config | None = None) -> None:
             for pub in publishers:
                 await pub.stop()
             await runner.cleanup()
+            if timeline_pub is not None:
+                await timeline_pub.stop()
             await bus.disconnect()
             if slice_broken:
                 # jax.distributed teardown blocks on dead slice members —
@@ -434,6 +451,8 @@ async def run(config: Config | None = None) -> None:
             ready_task.cancel()
             await follower.stop()
             await membership.stop()
+            if timeline_pub is not None:
+                await timeline_pub.stop()
             await bus.disconnect()
             if slice_broken:
                 log.error("slice broken; follower exiting",

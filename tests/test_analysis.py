@@ -509,6 +509,145 @@ def test_channel_discipline_fires_on_helper_pattern_drift(tmp_path):
                for m in msgs), msgs
 
 
+# -- event-discipline (ISSUE 17) --------------------------------------------
+
+# a minimal obs/timeline.py EVENTS registry for fixture repos
+_FIXTURE_EVENTS = """\
+EVENTS = {}
+
+
+def register_event(name, **kw):
+    EVENTS[name] = kw
+
+
+register_event("svc.started", keys=("worker",),
+               modules=("gridllm_tpu/svc.py",))
+register_event("svc.stopped", keys=("reason", "worker"),
+               modules=("gridllm_tpu/svc.py",))
+"""
+
+_FIXTURE_SVC = """\
+class Svc:
+    def __init__(self, flightrec, worker_id):
+        self.flightrec = flightrec
+        self.worker_id = worker_id
+
+    def start(self):
+        self.flightrec.record("svc", "started", worker=self.worker_id)
+
+    def stop(self, reason):
+        self.flightrec.record("svc", "stopped", worker=self.worker_id,
+                              reason=reason)
+"""
+
+_FIXTURE_EVENT_TABLE = (
+    "\n## Timeline events\n\n"
+    "| Event | Payload keys | Emitted from |\n|---|---|---|\n"
+    "| `svc.started` | `worker` | svc |\n"
+    "| `svc.stopped` | `reason, worker` | svc |\n")
+
+
+def _event_repo(tmp_path, **overrides):
+    files = {
+        "gridllm_tpu/obs/timeline.py": _FIXTURE_EVENTS,
+        "gridllm_tpu/svc.py": _FIXTURE_SVC,
+        "README.md": _full_env_table() + _FIXTURE_EVENT_TABLE,
+    }
+    files.update(overrides)
+    return make_repo(tmp_path, files)
+
+
+def test_event_discipline_clean_fixture(tmp_path):
+    root = _event_repo(tmp_path)
+    assert findings_for(root, "event-discipline") == []
+
+
+def test_event_discipline_fires_on_undeclared_event_and_key(tmp_path):
+    root = _event_repo(tmp_path, **{"gridllm_tpu/svc.py": _FIXTURE_SVC + (
+        "\n"
+        "    def crash(self):\n"
+        "        self.flightrec.record('svc', 'crashed', worker='w')\n"
+        "        self.flightrec.record('svc', 'started', worker='w',\n"
+        "                              extra=1)\n"
+    )})
+    msgs = [f.message for f in findings_for(root, "event-discipline")]
+    assert any("'svc.crashed'" in m and "not declared" in m
+               for m in msgs), msgs
+    assert any("payload key 'extra'" in m for m in msgs), msgs
+
+
+def test_event_discipline_fires_on_unresolvable_and_splat(tmp_path):
+    root = _event_repo(tmp_path, **{"gridllm_tpu/svc.py": _FIXTURE_SVC + (
+        "\n"
+        "    def weird(self, ev, fields):\n"
+        "        self.flightrec.record('svc', ev)\n"
+        "        self.flightrec.record('svc', 'started', **fields)\n"
+    )})
+    msgs = [f.message for f in findings_for(root, "event-discipline")]
+    assert any("statically unresolvable" in m for m in msgs), msgs
+    assert any("dynamic **fields" in m and "open_keys" in m
+               for m in msgs), msgs
+
+
+def test_event_discipline_fires_on_dead_declaration(tmp_path):
+    events = _FIXTURE_EVENTS + (
+        'register_event("svc.ghost", keys=("worker",),\n'
+        '               modules=("gridllm_tpu/svc.py",))\n')
+    table = _FIXTURE_EVENT_TABLE.replace(
+        "| `svc.stopped`",
+        "| `svc.ghost` | `worker` | svc |\n| `svc.stopped`")
+    root = _event_repo(tmp_path, **{
+        "gridllm_tpu/obs/timeline.py": events,
+        "README.md": _full_env_table() + table})
+    msgs = [f.message for f in findings_for(root, "event-discipline")]
+    assert any("'svc.ghost'" in m and "no module ever emits" in m
+               for m in msgs), msgs
+
+
+def test_event_discipline_fires_on_readme_table_drift(tmp_path):
+    table = _FIXTURE_EVENT_TABLE.replace(
+        "| `svc.started` | `worker` |", "| `svc.started` | `job` |")
+    root = _event_repo(
+        tmp_path, **{"README.md": _full_env_table() + table})
+    msgs = [f.message for f in findings_for(root, "event-discipline")]
+    assert any("'svc.started'" in m and "keys" in m for m in msgs), msgs
+    # a missing row is drift too
+    root2 = _event_repo(tmp_path / "r2", **{
+        "README.md": _full_env_table() + _FIXTURE_EVENT_TABLE.replace(
+            "| `svc.started` | `worker` | svc |\n", "")})
+    msgs2 = [f.message for f in findings_for(root2, "event-discipline")]
+    assert any("'svc.started'" in m and "missing from the README" in m
+               for m in msgs2), msgs2
+
+
+def test_event_discipline_resolves_emit_event_envelope(tmp_path):
+    # emit_event envelope attrs (member/request_id/stamp) are not payload
+    # keys; a payload kwarg outside the registry still fires
+    events = _FIXTURE_EVENTS + (
+        'register_event("svc.edge", keys=("channel",),\n'
+        '               modules=("gridllm_tpu/edge.py",))\n')
+    table = _FIXTURE_EVENT_TABLE + "| `svc.edge` | `channel` | edge |\n"
+    root = _event_repo(tmp_path, **{
+        "gridllm_tpu/obs/timeline.py": events,
+        "gridllm_tpu/edge.py": (
+            "from gridllm_tpu.obs.timeline import emit_event\n"
+            "def send(rid, stamp):\n"
+            "    emit_event('svc.edge', member='m', request_id=rid,\n"
+            "               stamp=stamp, channel='c')\n"),
+        "README.md": _full_env_table() + table})
+    assert findings_for(root, "event-discipline") == []
+    root2 = _event_repo(tmp_path / "r2", **{
+        "gridllm_tpu/obs/timeline.py": events,
+        "gridllm_tpu/edge.py": (
+            "from gridllm_tpu.obs.timeline import emit_event\n"
+            "def send(rid):\n"
+            "    emit_event('svc.edge', request_id=rid, channel='c',\n"
+            "               shard=3)\n"),
+        "README.md": _full_env_table() + table})
+    msgs = [f.message for f in findings_for(root2, "event-discipline")]
+    assert any("payload key 'shard'" in m for m in msgs), msgs
+
+
 # -- async-discipline (ISSUE 13) --------------------------------------------
 
 def test_async_discipline_fires_on_blocking_calls(tmp_path):
@@ -962,7 +1101,7 @@ def test_readme_table_metrics_parses_rows_only():
 # -- the actual gate --------------------------------------------------------
 
 def test_self_run_is_clean():
-    """Zero findings from exactly 12 registered rules over this repo:
+    """Zero findings from exactly 13 registered rules over this repo:
     the invariant set the analyzer encodes HOLDS, and stays held — any
     regression fails here (and in the tier-1 static-analysis CI job)
     with a file:line reason. The rule-count pin makes a silently
@@ -972,7 +1111,7 @@ def test_self_run_is_clean():
     findings = run(REPO_ROOT)
     assert findings == [], "\n" + "\n".join(f.render() for f in findings)
     load_rules()
-    assert len(RULES) == 12, sorted(RULES)
+    assert len(RULES) == 13, sorted(RULES)
 
 
 def test_cli_exit_codes_and_json(tmp_path):

@@ -97,7 +97,7 @@ def test_durable_classification_matches_legacy_patterns():
     from gridllm_tpu.bus.base import CHANNELS
 
     legacy_prefixes = ("job:result:", "job:stream:", "admin:result:",
-                      "kvx:")
+                      "kvx:", "obs:dump:reply:")
     legacy_fixed = {"job:completed", "job:failed", "job:timeout",
                     "job:snapshot", "job:handoff", "job:drain",
                     "job:preempted",
@@ -106,7 +106,12 @@ def test_durable_classification_matches_legacy_patterns():
                     # a submission published while a scheduler shard's
                     # subscriber is mid-reconnect must replay, not vanish
                     # (ctrl:status stays best-effort fire-and-forget)
-                    "ctrl:submit", "ctrl:cancel"}
+                    "ctrl:submit", "ctrl:cancel",
+                    # ISSUE 17: timeline event batches and fleet-dump
+                    # replies replay across a subscriber reconnect —
+                    # the incident window / dump op must not vanish
+                    # into the exact outage it exists to record
+                    "obs:event"}
 
     def legacy(ch: str) -> bool:
         if ch in legacy_fixed or ch.startswith(legacy_prefixes):

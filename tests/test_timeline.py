@@ -1,0 +1,516 @@
+"""Fleet timeline, HLC, and incident forensics tests (ISSUE 17).
+
+Covers the HLC semantics the causal timeline rests on (merge
+monotonicity, cross-member happens-before through a bus round-trip,
+member-id tie-breaking, clock-skew injection), the publisher's
+never-block backpressure contract, the additive critical-path
+decomposition, the fleet store/forensics surfaces, and THE acceptance
+gate: SIGKILL-style death of the owning scheduler shard produces — from
+one ``GET /admin/incidents`` on a surviving member — a causally ordered
+incident report stitching events from ≥ 3 distinct members, with every
+bus edge ordered send-before-receive despite injected clock skew."""
+
+import asyncio
+import json
+import time
+
+from gridllm_tpu.bus import InMemoryBus
+from gridllm_tpu.obs import MetricsRegistry
+from gridllm_tpu.obs.flightrec import default_flight_recorder
+from gridllm_tpu.obs.forensics import IncidentCollector
+from gridllm_tpu.obs.timeline import (
+    HLC,
+    HLCStamp,
+    TimelinePublisher,
+    TimelineStore,
+    critical_path,
+    default_clock,
+    encode_hlc,
+    set_emitter,
+    split_hlc,
+    stamp_key,
+)
+
+from .test_controlplane import job_for_shard, make_fleet, req, stop_fleet
+from .helpers import FakeWorker
+
+
+def _cleanup_emitter():
+    set_emitter(None)
+    default_flight_recorder().set_tap(None)
+
+
+# -- HLC semantics -----------------------------------------------------------
+
+def test_hlc_tick_strictly_monotonic():
+    clock = HLC("a")
+    stamps = [clock.tick() for _ in range(100)]
+    for prev, cur in zip(stamps, stamps[1:]):
+        assert cur > prev
+
+
+def test_hlc_tick_monotonic_under_frozen_clock():
+    # a frozen physical clock still yields strictly increasing stamps
+    # through the logical counter
+    clock = HLC("a", now_fn=lambda: 1000.0)
+    stamps = [clock.tick() for _ in range(10)]
+    assert all(s.wall_ms == 1_000_000 for s in stamps)
+    assert [s.logical for s in stamps] == list(range(10))
+
+
+def test_hlc_update_happens_after_remote_and_local():
+    a, b = HLC("a"), HLC("b")
+    for _ in range(50):
+        remote = a.tick()
+        before = b.peek()
+        merged = b.update(remote)
+        assert merged > remote
+        assert merged > before
+
+
+def test_hlc_member_tie_break_is_deterministic():
+    s1 = HLCStamp(1000, 3, "member-a")
+    s2 = HLCStamp(1000, 3, "member-b")
+    assert s1 < s2  # same instant: member id orders, deterministically
+    assert sorted([s2, s1]) == [s1, s2]
+
+
+def test_hlc_clock_skew_preserves_causal_order():
+    """Member A's physical clock runs 90 s behind B's: a message A→B
+    then B→A must still order send < receive at every hop."""
+    t0 = time.time()
+    a = HLC("a", now_fn=lambda: t0 - 90.0)
+    b = HLC("b", now_fn=lambda: t0)
+    send_ab = a.tick()
+    recv_ab = b.update(send_ab)
+    assert recv_ab > send_ab
+    send_ba = b.tick()
+    recv_ba = a.update(send_ba)
+    assert recv_ba > send_ba
+    # and A's clock has absorbed B's future time: a local event on A
+    # now orders after the whole exchange even though A's wall lags
+    assert a.tick() > recv_ba
+
+
+def test_hlc_stamp_codec_round_trip():
+    s = HLCStamp(123456, 7, "shard-1")
+    assert HLCStamp.parse(s.encode()) == s
+    assert HLCStamp.from_list(s.to_list()) == s
+    assert HLCStamp.from_list("garbage") is None
+    framed = encode_hlc(s, '{"jobId": "x"}')
+    stamp, body = split_hlc(framed)
+    assert stamp == s and body == '{"jobId": "x"}'
+    # unframed messages pass through untouched (rolling upgrades, tests)
+    assert split_hlc('{"plain": 1}') == (None, '{"plain": 1}')
+
+
+async def test_bus_round_trip_orders_send_before_receive():
+    """A lifecycle publish through a real bus emits a bus.send and a
+    bus.recv edge with send < recv under HLC, tagged with the request id
+    parsed from the payload."""
+    bus = InMemoryBus(key_prefix="T:")
+    await bus.connect()
+    pub = TimelinePublisher("m1", registry=MetricsRegistry())
+    pub.install()
+    try:
+        got = asyncio.Event()
+
+        async def handler(_ch, _msg):
+            got.set()
+
+        sub = await bus.subscribe("job:completed", handler)
+        await bus.publish("job:completed", json.dumps({"jobId": "job-7"}))
+        await bus.flush()
+        await asyncio.wait_for(got.wait(), 2.0)
+        await sub.unsubscribe()
+        events = list(pub._q)
+        sends = [e for e in events if e["name"] == "bus.send"]
+        recvs = [e for e in events if e["name"] == "bus.recv"]
+        assert sends and recvs
+        assert sends[0]["requestId"] == "job-7"
+        assert recvs[0]["requestId"] == "job-7"
+        assert stamp_key(sends[0]) < stamp_key(recvs[0])
+    finally:
+        await pub.stop()
+        _cleanup_emitter()
+        await bus.disconnect()
+
+
+async def test_handler_sees_unframed_payload():
+    """The HLC frame is transport detail: subscribers receive the exact
+    payload the publisher passed in."""
+    bus = InMemoryBus(key_prefix="T:")
+    await bus.connect()
+    seen = []
+
+    async def handler(_ch, msg):
+        seen.append(msg)
+
+    sub = await bus.subscribe("job:completed", handler)
+    await bus.publish("job:completed", '{"jobId": "j1"}')
+    await bus.flush()
+    assert seen == ['{"jobId": "j1"}']
+    await sub.unsubscribe()
+    await bus.disconnect()
+
+
+# -- publisher backpressure ---------------------------------------------------
+
+def test_publisher_never_blocks_and_drops_oldest():
+    reg = MetricsRegistry()
+    pub = TimelinePublisher("m1", queue_capacity=8, registry=reg)
+    t0 = time.monotonic()
+    for i in range(10_000):
+        pub.emit("scheduler.retry", request_id=f"j{i}")
+    elapsed = time.monotonic() - t0
+    # the emit path is a deque append behind a lock — wedging the bus
+    # (no flush task running at all here) costs events, never latency
+    assert elapsed < 1.0
+    assert pub.pending() == 8
+    assert pub._dropped.value(member="m1") == 10_000 - 8
+    # oldest dropped, newest retained
+    assert [e["requestId"] for e in pub._q] == [
+        f"j{i}" for i in range(9992, 10_000)]
+
+
+async def test_publisher_counts_failed_flush_as_dropped():
+    class WedgedBus:
+        async def publish(self, *_a, **_k):
+            raise ConnectionError("broker down")
+
+    reg = MetricsRegistry()
+    pub = TimelinePublisher("m1", registry=reg)
+    pub._bus = WedgedBus()
+    pub.emit("scheduler.retry", request_id="j1")
+    assert await pub.flush_once() == 0
+    assert pub.pending() == 0  # batch not requeued — bound holds
+    assert pub._dropped.value(member="m1") == 1
+
+
+async def test_flightrec_tap_maps_record_sites_to_events():
+    pub = TimelinePublisher("gw-0", registry=MetricsRegistry())
+    pub.install()
+    try:
+        rec = default_flight_recorder()
+        rec.record("scheduler", "retry", job="job-1", attempt=2,
+                   error="boom")
+        rec.record("worker", "started", worker="w-9", models=["m1"])
+        events = {e["name"]: e for e in pub._q}
+        assert events["scheduler.retry"]["requestId"] == "job-1"
+        assert events["scheduler.retry"]["member"] == "gw-0"
+        assert events["scheduler.retry"]["fields"]["attempt"] == 2
+        # worker-side subsystems attribute to the worker id
+        assert events["worker.started"]["member"] == "w-9"
+    finally:
+        await pub.stop()
+        _cleanup_emitter()
+
+
+# -- timeline store -----------------------------------------------------------
+
+def _ev(name, wall, logical, member, rid=None):
+    ev = {"name": name, "member": member,
+          "stamp": [wall, logical, member]}
+    if rid:
+        ev["requestId"] = rid
+    return ev
+
+
+def test_store_slices_in_hlc_order():
+    store = TimelineStore()
+    store.ingest(_ev("b", 2000, 0, "m2", rid="r1"))
+    store.ingest(_ev("c", 2000, 1, "m1", rid="r1"))
+    store.ingest(_ev("a", 1000, 5, "m1", rid="r1"))
+    store.ingest(_ev("x", 1500, 0, "m1", rid="other"))
+    assert [e["name"] for e in store.slice("r1")] == ["a", "b", "c"]
+    assert store.slice("missing") == []
+    window = store.window(1500, 2000)
+    assert [e["name"] for e in window] == ["x", "b", "c"]
+
+
+def test_store_bounds_request_index():
+    store = TimelineStore(capacity=100, max_requests=3)
+    for i in range(5):
+        store.ingest(_ev("e", 1000 + i, 0, "m", rid=f"r{i}"))
+    assert store.slice("r0") == [] and store.slice("r1") == []
+    assert len(store.slice("r4")) == 1
+
+
+async def test_store_ingests_published_batches():
+    bus = InMemoryBus(key_prefix="T:")
+    await bus.connect()
+    pub = TimelinePublisher("m1", registry=MetricsRegistry())
+    store = TimelineStore()
+    await store.attach(bus)
+    await pub.start(bus)
+    try:
+        pub.emit("scheduler.retry", request_id="j1", fields={"attempt": 1})
+        assert await pub.flush_once() == 1
+        await bus.flush()
+        sl = store.slice("j1")
+        assert len(sl) == 1 and sl[0]["name"] == "scheduler.retry"
+    finally:
+        await pub.stop()
+        await store.detach()
+        _cleanup_emitter()
+        await bus.disconnect()
+
+
+# -- incident forensics -------------------------------------------------------
+
+def test_incident_collector_triggers_and_debounces():
+    store = TimelineStore()
+    inc = IncidentCollector(store, member="gw-0", window_ms=5000,
+                            registry=MetricsRegistry())
+    base = int(time.time() * 1000)
+    store.ingest(_ev("scheduler.retry", base - 10, 0, "s0", rid="j1"))
+    store.ingest(_ev("scheduler.hang", base, 0, "s0", rid="j1"))
+    # a retrigger for the same subject inside the window is the SAME
+    # incident, not a report flood
+    store.ingest(_ev("scheduler.hang", base + 100, 0, "s0", rid="j1"))
+    assert inc.count() == 1
+    reports = inc.reports(now_ms=base + 10_000)
+    assert len(reports) == 1
+    rep = reports[0]
+    assert rep["kind"] == "watchdog_hang" and rep["complete"]
+    # the causal window captured the pre-trigger context event too
+    assert [e["name"] for e in rep["events"]][:2] == [
+        "scheduler.retry", "scheduler.hang"]
+    # a second subject is a second incident
+    store.ingest(_ev("scheduler.hang", base, 0, "s0", rid="j2"))
+    assert inc.count() == 2
+
+
+def test_incident_report_incomplete_until_window_elapses():
+    store = TimelineStore()
+    inc = IncidentCollector(store, member="gw-0", window_ms=5000,
+                            registry=MetricsRegistry())
+    base = int(time.time() * 1000)
+    store.ingest(_ev("bus.failover", base, 0, "s0"))
+    assert not inc.reports(now_ms=base + 100)[0]["complete"]
+    assert inc.reports(now_ms=base + 5001)[0]["complete"]
+
+
+# -- critical-path decomposition ----------------------------------------------
+
+def _span(name, start, end, **meta):
+    return {"name": name, "source": "t", "start": start, "end": end,
+            "durationMs": (end - start) * 1000, "meta": meta or None}
+
+
+def test_critical_path_segments_are_additive():
+    spans = [
+        _span("gateway.request", 0.0, 10.0),
+        _span("queue.wait", 0.5, 2.0),
+        _span("worker.execute", 2.5, 9.5),
+        _span("engine.prefill", 3.0, 4.0),
+        _span("engine.decode", 4.0, 9.0, engineNs=3.0e9),
+        _span("kvx.send", 6.0, 6.5),  # migration interrupts decode
+    ]
+    seg = critical_path(spans)
+    assert seg is not None
+    total = sum(seg[k] for k in (
+        "queue_wait", "dispatch", "prefill", "decode_device",
+        "decode_host_stall", "migration", "suspend_resume"))
+    assert abs(total - seg["e2e"]) < 1e-9
+    assert abs(seg["e2e"] - 10.0) < 1e-9
+    assert abs(seg["queue_wait"] - 1.5) < 1e-9
+    assert abs(seg["prefill"] - 1.0) < 1e-9
+    assert abs(seg["migration"] - 0.5) < 1e-9  # wins over decode overlap
+    decode_cov = seg["decode_device"] + seg["decode_host_stall"]
+    assert abs(decode_cov - 4.5) < 1e-9  # 5.0 minus the migration bite
+    assert abs(seg["decode_device"] - 3.0) < 1e-9  # engineNs bound
+    assert abs(seg["decode_host_stall"] - 1.5) < 1e-9
+
+
+def test_critical_path_gap_inside_execution_is_suspend_resume():
+    spans = [
+        _span("gateway.request", 0.0, 10.0),
+        _span("worker.execute", 1.0, 4.0),
+        _span("worker.execute", 7.0, 9.0),  # resumed after migration gap
+        _span("engine.decode", 1.5, 3.5),
+    ]
+    seg = critical_path(spans)
+    # 4.0→7.0 is inside the execution hull but covered by no execute
+    # span — preemption/handoff dead time, not control-plane dispatch
+    assert abs(seg["suspend_resume"] - 3.0) < 1e-9
+    # 0→1, 1→1.5 pre-decode execute, 3.5→4 post, 7→9 execute, 9→10
+    assert abs(seg["dispatch"] - 5.0) < 1e-9
+    total = sum(seg[k] for k in (
+        "queue_wait", "dispatch", "prefill", "decode_device",
+        "decode_host_stall", "migration", "suspend_resume"))
+    assert abs(total - seg["e2e"]) < 1e-9
+
+
+def test_critical_path_requires_sealed_root():
+    assert critical_path([]) is None
+    assert critical_path([{"name": "gateway.request", "start": 0.0,
+                           "end": None}]) is None
+
+
+# -- THE acceptance gate: shard SIGKILL forensics ----------------------------
+
+TOKENS = [f"tok{i} " for i in range(30)]
+
+
+async def test_shard_kill_produces_causally_ordered_incident_report():
+    """SIGKILL the owning scheduler shard mid-decode with the timeline
+    armed and the process clock skew-injected: one /admin/incidents read
+    on a surviving member yields a causally ordered shard_lease_lost
+    report with events from ≥ 3 distinct members, and every bus edge
+    orders send-before-receive under HLC."""
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from gridllm_tpu.gateway.app import create_app
+    from gridllm_tpu.utils.config import Config
+
+    bus = InMemoryBus(key_prefix="T:")
+    await bus.connect()
+
+    # clock-skew injection: the process HLC's physical source jumps
+    # backwards 120 s on alternating reads — stamps must stay monotone
+    # and causally consistent anyway
+    clock = default_clock()
+    orig_now = clock.now_fn
+    flip = [0]
+
+    def skewed_now():
+        flip[0] += 1
+        return time.time() - (120.0 if flip[0] % 2 else 0.0)
+
+    clock.now_fn = skewed_now
+
+    reg = MetricsRegistry()
+    pub = TimelinePublisher("obs-gw", registry=reg)
+    store = TimelineStore()
+    incidents = IncidentCollector(store, member="obs-gw",
+                                  window_ms=10_000, registry=reg)
+    pub.install()
+    await pub.start(bus)
+    await store.attach(bus)
+
+    shards, gws = await make_fleet(bus)
+    w = FakeWorker(bus, "w-chaos", ["m1"], stream_tokens=list(TOKENS),
+                   stream_delay_s=0.02)
+    await w.start()
+    await bus.flush()
+    await asyncio.sleep(0.2)
+    jid = job_for_shard(0)
+
+    app = create_app(bus, gws[1].registry, gws[1], Config(),
+                     timeline=store, incidents=incidents)
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    try:
+        chunks = []
+
+        async def on_chunk(chunk):
+            chunks.append(chunk.response or "")
+            if len(chunks) == 5:
+                await shards[0].kill()
+
+        result = await gws[1].submit_streaming_job(
+            req(jid, stream=True), on_chunk, timeout_ms=20_000)
+        assert result.success
+        for _ in range(100):
+            if shards[1].lease.holds(0):
+                break
+            await asyncio.sleep(0.05)
+        assert shards[1].lease.holds(0)
+        await bus.flush()
+
+        # ONE GET on a surviving member assembles the whole story
+        resp = await client.get("/admin/incidents")
+        assert resp.status == 200
+        body = await resp.json()
+        reports = [r for r in body["incidents"]
+                   if r["kind"] == "shard_lease_lost"]
+        assert len(reports) == 1, body["incidents"]
+        rep = reports[0]
+        events = rep["events"]
+        assert len(events) >= 3
+        # causally ordered: the HLC sort key is non-decreasing
+        keys = [stamp_key(e) for e in events]
+        assert keys == sorted(keys)
+        # stitched from ≥ 3 distinct members (gateway submit, surviving
+        # shard's adoption, the observing member's bus edges at minimum;
+        # FakeWorker is a bus stub with no flight recorder of its own)
+        members = {e.get("member") for e in events if e.get("member")}
+        assert len(members) >= 3, members
+
+        # every bus edge pair orders send-before-receive despite the
+        # injected 120 s skew
+        timeline = await (await client.get(
+            f"/admin/timeline/{jid}")).json()
+        ev = timeline["events"]
+        assert ev, "timeline slice empty"
+        sends = [e for e in ev if e["name"] == "bus.send"]
+        recvs = [e for e in ev if e["name"] == "bus.recv"]
+        assert sends and recvs
+        for r in recvs:
+            ch = r["fields"]["channel"]
+            paired = [s for s in sends
+                      if s["fields"]["channel"] == ch
+                      and stamp_key(s) < stamp_key(r)]
+            assert paired, (ch, r)
+        # the slice merges the tracer spans for the same request
+        assert any(s["name"] == "gateway.request"
+                   for s in timeline["spans"])
+
+        # 404 with a typed error for unknown requests, not an empty 200
+        missing = await client.get("/admin/timeline/job-never-existed")
+        assert missing.status == 404
+    finally:
+        await client.close()
+        await pub.stop()
+        await store.detach()
+        _cleanup_emitter()
+        clock.now_fn = orig_now
+        await stop_fleet(shards, gws, w)
+        await bus.disconnect()
+
+
+async def test_fleet_dump_aggregates_every_member_keyed_by_identity():
+    """/admin/dump?fleet=1 broadcasts a collection op; every member with
+    a StatusPublisher answers on the per-op reply channel, keyed by
+    member identity — silent members are listed, never merged away."""
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from gridllm_tpu.controlplane.status import FleetView, StatusPublisher
+    from gridllm_tpu.gateway.app import create_app
+    from gridllm_tpu.utils.config import Config
+
+    bus = InMemoryBus(key_prefix="T:")
+    await bus.connect()
+    shards, gws = await make_fleet(bus, gateways=1)
+    gw = gws[0]
+    view = FleetView(bus, gw.metrics, stale_after_ms=5000)
+    await view.start()
+    pubs = [StatusPublisher(bus, sh.scheduler, "shard", sh.member_id,
+                            10_000, lease=sh.lease) for sh in shards]
+    for p in pubs:
+        await p.start()
+    await bus.flush()
+    await asyncio.sleep(0.1)
+
+    app = create_app(bus, gw.registry, gw, Config(), fleet=view)
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    try:
+        plain = await (await client.get("/admin/dump")).json()
+        assert "fleet" not in plain
+        dump = await (await client.get("/admin/dump?fleet=1")).json()
+        fleet = dump["fleet"]
+        assert set(fleet["requested"]) == {"shard-0", "shard-1"}
+        assert fleet["missing"] == []
+        for member in ("shard-0", "shard-1"):
+            art = fleet["members"][member]
+            # each member's own artifact, attributed — never merged
+            assert art["scheduler"]["stats"]["shard"]["member"] == member
+    finally:
+        await client.close()
+        for p in pubs:
+            await p.stop()
+        await view.stop()
+        await stop_fleet(shards, gws)
+        await bus.disconnect()
