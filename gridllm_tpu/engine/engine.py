@@ -78,6 +78,7 @@ from gridllm_tpu.ops.kvcache import (
     PagedKVCache,
     PageAllocator,
     QuantPages,
+    commit_tree_path,
     rollback_to_length,
 )
 from gridllm_tpu.ops.kvtier import set_tier_gauges
@@ -85,13 +86,20 @@ from gridllm_tpu.ops.sampling import (
     SamplingParams,
     sample_tokens,
     spec_accept,
+    spec_accept_tree,
     window_push,
     window_set_slot,
 )
-from gridllm_tpu.ops.spec import make_drafter
+from gridllm_tpu.ops.spec import (
+    DraftModelDrafter,
+    make_drafter,
+    tree_ancestor_mask,
+    tree_depths,
+    tree_topology,
+)
 from gridllm_tpu.parallel.mesh import MeshConfig, build_mesh
 from gridllm_tpu.parallel.sharding import shard_cache, shard_params
-from gridllm_tpu.utils.config import env_bool, env_int
+from gridllm_tpu.utils.config import env_bool, env_int, env_str
 from gridllm_tpu.utils.logging import get_logger
 
 log = get_logger("engine")
@@ -142,28 +150,33 @@ _PREFIX_HIT_RATE = _OBS.gauge(
 # rejected = proposed - accepted (a draft discarded because an EARLIER one
 # missed counts as rejected too — it was wasted verify work either way).
 # The per-step histogram is the acceptance-collapse signal: spec on with
-# rate ≈ 0 means drafting is pure overhead (prometheus alert).
+# rate ≈ 0 means drafting is pure overhead (prometheus alert). The
+# "drafter" label (ISSUE 18) splits the series by drafting backend —
+# "ngram" (prompt-lookup) vs "model" (draft-model tree) — so an A/B or a
+# collapse localizes to the backend that caused it.
 _SPEC_PROPOSED = _OBS.counter(
     "gridllm_spec_proposed_tokens_total",
-    "Draft tokens proposed to speculative verify steps, by model.",
-    ("model",),
+    "Draft tokens proposed to speculative verify steps, by model and "
+    "drafter kind.",
+    ("model", "drafter"),
 )
 _SPEC_ACCEPTED = _OBS.counter(
     "gridllm_spec_accepted_tokens_total",
-    "Draft tokens accepted by speculative verify steps, by model.",
-    ("model",),
+    "Draft tokens accepted by speculative verify steps, by model and "
+    "drafter kind.",
+    ("model", "drafter"),
 )
 _SPEC_REJECTED = _OBS.counter(
     "gridllm_spec_rejected_tokens_total",
     "Draft tokens rejected (or discarded past the first miss) by "
-    "speculative verify steps, by model.",
-    ("model",),
+    "speculative verify steps, by model and drafter kind.",
+    ("model", "drafter"),
 )
 _SPEC_ACCEPT_RATE = _OBS.histogram(
     "gridllm_spec_acceptance_rate",
     "Per-verify-step draft acceptance rate (accepted/proposed, over steps "
-    "with at least one proposed draft), by model.",
-    ("model",), buckets=(0.0, 0.1, 0.25, 0.5, 0.75, 0.9, 1.0),
+    "with at least one proposed draft), by model and drafter kind.",
+    ("model", "drafter"), buckets=(0.0, 0.1, 0.25, 0.5, 0.75, 0.9, 1.0),
 )
 # flight recorder (obs/flightrec.py): lifecycle events land in the "engine"
 # ring; block dispatches are SAMPLED (one record per _FLIGHT_SAMPLE
@@ -250,6 +263,22 @@ class EngineConfig:
     # (ops/sampling.py spec_accept).
     spec_decode: bool | None = None
     spec_k: int | None = None
+    # draft-model + tree speculation (ISSUE 18). draft_model names a
+    # registered config for a tiny SAME-TOKENIZER draft model loaded next
+    # to the target (sharing the device mesh) — "" keeps n-gram drafting.
+    # None → GRIDLLM_SPEC_DRAFT_MODEL. draft_checkpoint is its weight
+    # path ("" → fresh init, the tier-1/bench path); None →
+    # GRIDLLM_SPEC_DRAFT_CHECKPOINT. With a draft model active the verify
+    # block generalizes from the [S, K+1] chain to a static token TREE:
+    # a depth-K greedy chain plus (spec_tree_width - 1) first-level
+    # sibling alternatives, verified in one tree-masked forward
+    # (ops/spec.py tree_topology). width 1 = pure chain; None →
+    # GRIDLLM_SPEC_TREE_WIDTH (default 2). An incompatible draft model
+    # (vocab mismatch, no verify/decode path) logs and falls back to
+    # n-gram rather than failing the engine.
+    draft_model: str | None = None
+    draft_checkpoint: str | None = None
+    spec_tree_width: int | None = None
     # tiered KV cache (ISSUE 11). kv_host_bytes: host-RAM tier capacity —
     # prefix-cache pages evicted from HBM spill there (wire-codec encoded)
     # and page back in on match_prefix hits; the capacity IS the enable
@@ -466,8 +495,9 @@ class InferenceEngine:
         # cumulative host-side totals (bench + batch_state read them).
         self._spec_k = 0
         self._drafter = None
+        self._tree_width = 1
         self.spec_stats = {"steps": 0, "proposed": 0, "accepted": 0,
-                           "emitted": 0}
+                           "emitted": 0, "draft_ns": 0}
         # step-time decomposition state (runner thread only)
         self._t_prev_fetch: float | None = None
         self._t_ingest_done: float | None = None
@@ -632,6 +662,93 @@ class InferenceEngine:
             k = env_int("GRIDLLM_SPEC_K")
         return max(int(k), 0)
 
+    def _resolve_draft_model(self) -> str:
+        """Draft-model config name ("" = n-gram drafting, the default).
+        EngineConfig overrides GRIDLLM_SPEC_DRAFT_MODEL."""
+        name = self.config.draft_model
+        if name is None:
+            name = env_str("GRIDLLM_SPEC_DRAFT_MODEL")
+        return (name or "").strip()
+
+    def _resolve_tree_width(self) -> int:
+        """Tree sibling fan-out at depth 1 (1 = pure chain). EngineConfig
+        overrides GRIDLLM_SPEC_TREE_WIDTH. Clamped so the node budget
+        1 + K + (width-1) is at least the root + chain."""
+        w = self.config.spec_tree_width
+        if w is None:
+            w = env_int("GRIDLLM_SPEC_TREE_WIDTH")
+        return max(int(w), 1)
+
+    def _build_model_drafter(self, spec_k: int):
+        """Construct the draft-model tree drafter (ISSUE 18), or None when
+        no draft model is configured / the configured one is incompatible
+        with the target — the caller then keeps the n-gram drafter, so a
+        bad knob degrades speculation quality instead of failing serving.
+
+        The draft model shares the target's mesh and dtype but owns a
+        small fixed-stripe KV pool (DraftModelDrafter): per slot, enough
+        pages for the engine's max_context plus the draft chain, page
+        size matching the engine's."""
+        name = self._resolve_draft_model()
+        if not name:
+            return None
+        try:
+            dcfg = get_config(name)
+        except Exception:
+            log.warning("draft model unknown; falling back to n-gram",
+                        model=self.cfg.name, draftModel=name)
+            return None
+        dmod = _model_module(dcfg)
+        if dcfg.vocab_size != self.cfg.vocab_size:
+            # acceptance compares token ids — different vocabs make the
+            # rejection test meaningless (and usually out-of-range)
+            log.warning("draft model vocab mismatch; falling back to n-gram",
+                        model=self.cfg.name, draftModel=name,
+                        vocab=self.cfg.vocab_size, draftVocab=dcfg.vocab_size)
+            return None
+        if not (hasattr(dmod, "verify_step") and hasattr(dmod, "decode_step")):
+            log.warning("draft model family lacks verify/decode steps; "
+                        "falling back to n-gram",
+                        model=self.cfg.name, draftModel=name)
+            return None
+        c = self.config
+        dtype = jnp.dtype(c.dtype)
+        ckpt = self.config.draft_checkpoint
+        if ckpt is None:
+            ckpt = env_str("GRIDLLM_SPEC_DRAFT_CHECKPOINT")
+        ckpt = (ckpt or "").strip()
+        if ckpt:
+            from gridllm_tpu.engine.loader import load_checkpoint
+            from gridllm_tpu.parallel.sharding import param_shardings
+
+            shardings = None
+            if self.mesh is not None:
+                proto = jax.eval_shape(
+                    lambda: dmod.init_params(dcfg, jax.random.PRNGKey(0),
+                                             dtype)
+                )
+                shardings = param_shardings(proto, self.mesh)
+            dparams = load_checkpoint(dcfg, ckpt, dtype, shardings)
+        else:
+            dparams = dmod.init_params(dcfg, jax.random.PRNGKey(0), dtype)
+            if self.mesh is not None:
+                dparams = shard_params(dparams, self.mesh)
+        # pool sizing: the engine never drafts past its own max_context,
+        # and the decode steps write ≤ spec_k rows past it
+        mpps = -(-(self.max_context + spec_k + 1) // c.page_size)
+        drafter = DraftModelDrafter(
+            dmod, dcfg, dparams,
+            max_slots=c.max_slots, page_size=c.page_size,
+            max_pages_per_slot=mpps, mesh=self.mesh,
+            ingest_width=max(env_int("GRIDLLM_SPEC_DRAFT_INGEST"), 1),
+            dtype=dtype, wrap=self.perf.wrap,
+        )
+        log.info("draft-model speculation enabled", model=self.cfg.name,
+                 draftModel=name, checkpoint=ckpt or "(fresh init)",
+                 treeWidth=self._resolve_tree_width(),
+                 draftPoolPages=c.max_slots * mpps)
+        return drafter
+
     def _pool_head_dim(self) -> int:
         """Page-pool head dim: lane-padded to 128 when the Pallas kernels
         will run (Mosaic's alignment constraint), so d=64 models (qwen2.5
@@ -764,6 +881,11 @@ class InferenceEngine:
             self._t_ingest_done = None  # device/host pace
             self._free_slots = list(range(self.config.max_slots - 1, -1, -1))
             self._init_device_state()
+            if self._drafter is not None and hasattr(self._drafter, "reset"):
+                # the drafter's jitted entries donate ITS cache — an
+                # exception mid-draft can leave it referencing deleted
+                # buffers, same failure mode this reset exists to cure
+                self._drafter.reset()
             self._update_kv_gauges()
             if self.plan_sink is not None:  # after-success; see _try_admit
                 self.plan_sink({"op": "reset"})
@@ -1040,7 +1162,11 @@ class InferenceEngine:
         else:
             self._spec_k = spec_k
             if spec_k:
-                self._drafter = make_drafter()
+                # draft-model tree drafting (ISSUE 18) when configured and
+                # compatible; n-gram prompt-lookup otherwise
+                self._drafter = (self._build_model_drafter(spec_k)
+                                 or make_drafter())
+            self._tree_width = self._resolve_tree_width()
             # the verify program is built even with speculation off so a
             # multi-host follower can replay a liaison's "verify" plan ops
             # regardless of its own env (K comes from the record; nothing
@@ -1075,6 +1201,67 @@ class InferenceEngine:
                 return block, n_emit, tokens, cache, counts, window, wlen, sp
 
             self._verify_fn = self.perf.wrap("verify_block", verify_block_fn)
+
+            # Tree verification (ISSUE 18): one program per draft-tree
+            # TOPOLOGY (parents tuple) — static per process for the local
+            # drafter, but a follower replaying a liaison's "verify_tree"
+            # plan op rebuilds the fn from the record's parents, so the
+            # hosts never need to agree on env knobs. The depth/ancestor
+            # arrays are jit-closure constants; per-slot raggedness
+            # travels as the node-validity operand (data, not shape), so
+            # steady state compiles each topology exactly once.
+            self._tree_fns: dict[tuple, Any] = {}
+
+            def _tree_fn_for(parents):
+                key = tuple(int(p) for p in parents)
+                fn = self._tree_fns.get(key)
+                if fn is not None:
+                    return fn
+                parents_np = np.asarray(key, np.int32)
+                depths = tree_depths(parents_np)
+                anc = tree_ancestor_mask(parents_np)
+
+                @partial(jax.jit, donate_argnums=(1, 2, 4, 5, 6, 7))
+                def verify_tree_fn(params, cache, tokens, active, counts,
+                                   window, wlen, sp, drafts, valid):
+                    # candidates [S, N]: col 0 = the device's committed
+                    # last token (tree root), cols 1.. = drafted nodes in
+                    # topological order. Node i's KV is written
+                    # optimistically at storage row lengths + i; its
+                    # LOGICAL position is lengths + depth[i] (rope +
+                    # ancestor-masked attention inside verify_step).
+                    cand = jnp.concatenate([tokens[:, None], drafts],
+                                           axis=1)
+                    logits, cache = mod.verify_step(
+                        params, mc, cand, cache, active, mesh=self.mesh,
+                        tree_pos=depths, tree_mask=anc,
+                    )
+                    (out, path, n_emit, last, counts, window, wlen,
+                     sp) = spec_accept_tree(
+                        logits, cand, parents_np, valid, sp, counts,
+                        window, wlen, active, mc.vocab_size,
+                    )
+                    tokens = jnp.where(active, last, tokens)
+                    # compact the accepted root-to-leaf path over the
+                    # optimistic rows, then roll forward — rejected
+                    # branches vanish without ever touching host state
+                    cache = commit_tree_path(cache, path, active)
+                    cache = rollback_to_length(
+                        cache,
+                        jnp.minimum(cache.lengths + n_emit,
+                                    cache.max_context),
+                    )
+                    # block protocol: [N+1, S], same contract as the
+                    # chain path (row 0 = block-input tokens)
+                    block = jnp.concatenate([cand[:, :1].T, out])
+                    return (block, n_emit, tokens, cache, counts, window,
+                            wlen, sp)
+
+                fn = self.perf.wrap("verify_tree", verify_tree_fn)
+                self._tree_fns[key] = fn
+                return fn
+
+            self._tree_fn_for = _tree_fn_for
 
     # ------------------------------------------------------------ admission
 
@@ -1460,6 +1647,15 @@ class InferenceEngine:
                 np.asarray(rec["dlen"], np.int32),
             )
             self._inflight.clear()  # replay never fetches
+        elif op == "verify_tree":
+            # the record carries the tree topology, so the follower
+            # rebuilds the exact program regardless of its own env
+            self._dispatch_verify_tree(
+                np.asarray(rec["drafts"], np.int32),
+                np.asarray(rec["valid"], bool),
+                np.asarray(rec["parents"], np.int32),
+            )
+            self._inflight.clear()  # replay never fetches
         elif op == "deact":
             self.active = self.active.at[int(rec["slot"])].set(False)
         elif op == "embed":
@@ -1583,6 +1779,10 @@ class InferenceEngine:
         self._update_kv_gauges()
         del self._slots[slot]
         self._free_slots.append(slot)
+        if self._drafter is not None and hasattr(self._drafter, "reset_slot"):
+            # draft-model drafters keep a per-slot KV prefix view; the
+            # next request reusing this slot starts from scratch
+            self._drafter.reset_slot(slot)
         _FLIGHTREC.record("engine", "finish", model=self.cfg.name,
                           request=st.req.id, slot=slot, reason=reason,
                           tokens=len(st.generated))
@@ -1685,6 +1885,103 @@ class InferenceEngine:
                 self.plan_sink({"op": "verify", "drafts": drafts.tolist(),
                                 "dlen": dlen.tolist()})
 
+    def _dispatch_verify_tree(self, drafts: np.ndarray, valid: np.ndarray,
+                              parents: np.ndarray) -> None:
+        """Dispatch one TREE verify block (ISSUE 18): [S, N-1] drafted
+        node tokens + [S, N] per-slot node validity against the static
+        topology `parents`. No host sync — the fetch happens in
+        _step_spec_tree. The plan record carries the topology, so a
+        multi-host follower replays the identical program without any
+        env agreement (mirrors the chain path's k-from-record rule)."""
+        with self.dispatch_lock:
+            _BATCH_OCCUPANCY.observe(len(self._slots), model=self.cfg.name)
+            self._gen += 1
+            if self._gen % _FLIGHT_SAMPLE == 0:
+                _FLIGHTREC.record("engine", "verify_tree",
+                                  model=self.cfg.name, gen=self._gen,
+                                  nodes=int(len(parents)),
+                                  slots=len(self._slots),
+                                  drafted=int(valid[:, 1:].sum()),
+                                  pending=len(self._pending))
+            fn = self._tree_fn_for(parents)
+            t0 = time.perf_counter()
+            (block, n_emit, self.tokens, self.cache, self.counts,
+             self.window, self.wlen, self.sampling) = fn(
+                self.params, self.cache, self.tokens, self.active,
+                self.counts, self.window, self.wlen, self.sampling,
+                jnp.asarray(drafts, jnp.int32), jnp.asarray(valid, bool),
+            )
+            now = time.perf_counter()
+            DISPATCH_SECONDS.observe(now - t0, model=self.cfg.name)
+            self._inflight.append((self._gen, (block, n_emit), 1, now))
+            if self.plan_sink is not None:  # after-success; see _try_admit
+                self.plan_sink({
+                    "op": "verify_tree", "drafts": drafts.tolist(),
+                    "valid": valid.tolist(),
+                    "parents": [int(p) for p in parents],
+                })
+
+    def _step_spec_tree(self, k: int) -> None:
+        """One draft-model TREE iteration (ISSUE 18): batched device
+        drafting over every live slot, one tree-masked verify dispatch,
+        fetch, ragged ingest. Same serial-by-construction shape as
+        _step_spec — the next step's drafts depend on this step's
+        emitted tokens — but the draft pass itself is one device batch
+        instead of per-slot host loops."""
+        width = self._tree_width
+        parents = tree_topology(k, width)
+        n = len(parents)
+        s = self.config.max_slots
+        drafts = np.zeros((s, n - 1), np.int32) if n > 1 else np.zeros(
+            (s, 0), np.int32)
+        valid = np.zeros((s, n), bool)
+        dlen = np.zeros((s,), np.int32)
+        todo: dict[int, list[int]] = {}
+        budget: dict[int, int] = {}
+        for slot, st in list(self._slots.items()):
+            if st.joined_gen > self._gen:
+                continue  # first token still device-side
+            # don't draft past num_predict (chain-path rule): accepting
+            # the whole depth-b chain plus the bonus token lands exactly
+            # on the remaining allowance
+            b = k if st.num_predict < 0 else max(
+                st.num_predict - len(st.generated) - 1, 0)
+            todo[slot] = st.ids
+            budget[slot] = b
+            # every live slot verifies at least the root — a slot the
+            # drafter skips (pool overflow / zero budget) still emits its
+            # one corrected token, exactly a plain decode step
+            valid[slot, 0] = True
+        props = self._drafter.draft_batch(todo, k, width) if todo else {}
+        # drafter overhead is host+device wall time inside draft_batch,
+        # cumulative (bench reads the per-arm delta)
+        self.spec_stats["draft_ns"] = int(
+            getattr(self._drafter, "draft_ns", 0))
+        for slot, (chain, alts) in props.items():
+            b = budget[slot]
+            depth = min(len(chain), b)
+            for i in range(depth):
+                drafts[slot, i] = chain[i]
+                valid[slot, 1 + i] = True
+            if b >= 1 and k >= 1:
+                # depth-1 siblings: accepting one emits at most sibling +
+                # bonus = 2 tokens, the same bound as a depth-1 chain
+                for j, a in enumerate(alts):
+                    drafts[slot, k + j] = a
+                    valid[slot, k + 1 + j] = True
+            # proposed = chain depth, matching the chain drafter's
+            # accounting so acceptance rates compare across drafters
+            # (siblings are a free second chance, not extra proposals)
+            dlen[slot] = depth
+        self._dispatch_verify_tree(drafts, valid, parents)
+        gen, (block, n_emit), _blk, t_disp = self._inflight.popleft()
+        t0 = time.perf_counter()
+        raw = np.asarray(jax.device_get(block))  # sync-ok (see _step_spec)
+        n_np = np.asarray(jax.device_get(n_emit))  # sync-ok
+        self._observe_device_step(t_disp, 1)
+        self._ingest_spec(gen, raw, n_np, dlen)
+        _STEP_DURATION.observe(time.perf_counter() - t0, model=self.cfg.name)
+
     def _step_spec(self) -> None:
         """One speculative iteration: draft per slot from host-visible
         history, dispatch the verify block, fetch, ingest the ragged
@@ -1698,6 +1995,9 @@ class InferenceEngine:
             # assumes the queue head is its own dispatch)
             self._fetch_oldest()
         k = self._spec_k
+        if getattr(self._drafter, "tree", False):
+            self._step_spec_tree(k)
+            return
         drafts = np.zeros((self.config.max_slots, k), np.int32)
         dlen = np.zeros((self.config.max_slots,), np.int32)
         for slot, st in list(self._slots.items()):
@@ -1761,13 +2061,15 @@ class InferenceEngine:
         if ingested:
             _TOKENS_TOTAL.inc(ingested, model=self.cfg.name, kind="decode")
         m = self.cfg.name
+        dk = getattr(self._drafter, "kind", "ngram") or "ngram"
         if proposed_t:
-            _SPEC_PROPOSED.inc(proposed_t, model=m)
-            _SPEC_ACCEPT_RATE.observe(accepted_t / proposed_t, model=m)
+            _SPEC_PROPOSED.inc(proposed_t, model=m, drafter=dk)
+            _SPEC_ACCEPT_RATE.observe(accepted_t / proposed_t, model=m,
+                                      drafter=dk)
         if accepted_t:
-            _SPEC_ACCEPTED.inc(accepted_t, model=m)
+            _SPEC_ACCEPTED.inc(accepted_t, model=m, drafter=dk)
         if proposed_t - accepted_t:
-            _SPEC_REJECTED.inc(proposed_t - accepted_t, model=m)
+            _SPEC_REJECTED.inc(proposed_t - accepted_t, model=m, drafter=dk)
         stats = self.spec_stats
         stats["steps"] += 1
         stats["proposed"] += proposed_t
@@ -2599,7 +2901,12 @@ class InferenceEngine:
                          if not self.embedding_only
                          and self.host_tier is not None else None),
             "specDecode": {
-                "k": self._spec_k, **self.spec_stats,
+                "k": self._spec_k,
+                "drafter": getattr(self._drafter, "kind", "ngram"),
+                "treeWidth": (self._tree_width
+                              if getattr(self._drafter, "tree", False)
+                              else 1),
+                **self.spec_stats,
             } if self._spec_k else None,
             "jit": self.perf.state(),
         }

@@ -398,17 +398,23 @@ def verify_step(
     active: jnp.ndarray,
     mlp=None,
     mesh=None,
+    tree_pos: jnp.ndarray | None = None,
+    tree_mask: jnp.ndarray | None = None,
 ) -> tuple[jnp.ndarray, PagedKVCache]:
     """Speculative-verify forward (llama.verify_step contract): T candidate
     tokens per slot in one pass, KV written optimistically, lengths left
     for the engine's rollback_to_length commit. Softcap and the per-layer
     sliding windows thread through paged_attention_verify exactly as they
-    do through the decode path."""
+    do through the decode path. Tree verify (`tree_pos`/`tree_mask`,
+    ISSUE 18): rope at logical positions base + depth, KV still stored at
+    base + i — same contract as llama.verify_step."""
     del mlp
     s, t = tokens.shape
     x = _embed_in(params, cfg, tokens)  # [S, T, E]
     base = cache.lengths
-    pos = base[:, None] + jnp.arange(t, dtype=jnp.int32)[None]
+    store_pos = base[:, None] + jnp.arange(t, dtype=jnp.int32)[None]
+    pos = (base[:, None] + jnp.asarray(tree_pos, jnp.int32)[None]
+           if tree_pos is not None else store_pos)
 
     def attn_fn(q, k, v, win, li):
         if ragged_attention_enabled():
@@ -417,12 +423,14 @@ def verify_step(
                 q_group=q, page_table=cache.page_table, group_lengths=base,
                 k_group=k, v_group=v, layer=li, use_pallas=cfg.use_pallas,
                 logit_softcap=cfg.attn_logit_softcap, window=win, mesh=mesh,
+                tree_pos=tree_pos, tree_mask=tree_mask,
             )
             return att.reshape(s, t, -1)
         return paged_attention_verify(
             q, cache.k, cache.v, cache.page_table, base, cache.page_size,
             k_cur=k, v_cur=v, layer=li, use_pallas=cfg.use_pallas,
             logit_softcap=cfg.attn_logit_softcap, window=win, mesh=mesh,
+            tree_pos=tree_pos, tree_mask=tree_mask,
         ).reshape(s, t, -1)
 
     x, k_new, v_new = _scan_layers(params, cfg, x, pos, attn_fn)
@@ -430,7 +438,7 @@ def verify_step(
     logits = _unembed(cfg, params, x)  # [S, T, V]
 
     k_pool, v_pool = write_multi_all(
-        cache.k, cache.v, k_new, v_new, cache.page_table, pos, active,
+        cache.k, cache.v, k_new, v_new, cache.page_table, store_pos, active,
         cache.page_size, use_pallas=cfg.use_pallas, mesh=mesh,
     )
     return logits, PagedKVCache(

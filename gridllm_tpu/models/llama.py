@@ -557,16 +557,27 @@ def verify_layers(
     page_size: int,
     mlp: MlpFn = _mlp,
     mesh=None,
+    tree_pos: jnp.ndarray | None = None,
+    tree_mask: jnp.ndarray | None = None,
 ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Speculative-verify layer scan: T candidate tokens for ALL slots at
     once against each slot's paged prefix (ISSUE 5). x: [S, T, E];
     base_lengths: [S] cached-prefix length per slot (candidate i sits at
     absolute position base_lengths[s] + i). Returns (x out, k_new
     [L, S, T, KVH, D], v_new) — pool writes are the caller's, same
-    deferred-write discipline as decode_layers."""
+    deferred-write discipline as decode_layers.
+
+    Tree verify (ISSUE 18): with `tree_pos` ([T] node depths) and
+    `tree_mask` ([T, T] ancestor-or-self, both static host constants) the
+    T candidates form a token tree — node i takes rope at LOGICAL
+    position base_lengths[s] + tree_pos[i] and its query attends the
+    prefix plus exactly its tree ancestors (see
+    ops.attention.paged_attention_verify_ref)."""
     s, t = x.shape[:2]
     inv_freq = precompute_rope(cfg.head_dim_, cfg.rope_theta, cfg.rope_scaling)
-    pos = base_lengths[:, None] + jnp.arange(t, dtype=jnp.int32)[None]
+    rel = (jnp.asarray(tree_pos, jnp.int32) if tree_pos is not None
+           else jnp.arange(t, dtype=jnp.int32))
+    pos = base_lengths[:, None] + rel[None]
     n = jax.tree.leaves(layers)[0].shape[0]
 
     def layer(x, xs):
@@ -587,6 +598,7 @@ def verify_layers(
                 group_lengths=base_lengths, k_group=k, v_group=v,
                 layer=li, use_pallas=cfg.use_pallas,
                 window=cfg.sliding_window, mesh=mesh,
+                tree_pos=tree_pos, tree_mask=tree_mask,
             )
             att = att.reshape(s, t, -1)
         else:
@@ -594,6 +606,7 @@ def verify_layers(
                 q, k_pool, v_pool, page_table, base_lengths, page_size,
                 k_cur=k, v_cur=v, layer=li, use_pallas=cfg.use_pallas,
                 window=cfg.sliding_window, mesh=mesh,
+                tree_pos=tree_pos, tree_mask=tree_mask,
             ).reshape(s, t, -1)
         x = x + qdot(att, lp["wo"], precision=_precision(x))
         hx = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
@@ -613,6 +626,8 @@ def verify_step(
     active: jnp.ndarray,
     mlp: MlpFn = _mlp,
     mesh=None,
+    tree_pos: jnp.ndarray | None = None,
+    tree_mask: jnp.ndarray | None = None,
 ) -> tuple[jnp.ndarray, PagedKVCache]:
     """One speculative-verify forward for ALL slots (ISSUE 5). tokens:
     [S, T] candidate blocks (col 0 = each slot's committed last token,
@@ -621,7 +636,14 @@ def verify_step(
     0..j — and the cache with the candidates' KV written OPTIMISTICALLY at
     positions lengths[s]..lengths[s]+T-1 but lengths UNCHANGED: the engine
     commits the accepted length afterwards via
-    ops.kvcache.rollback_to_length, which drops rejected rows)."""
+    ops.kvcache.rollback_to_length, which drops rejected rows).
+
+    Tree verify (ISSUE 18): `tree_pos`/`tree_mask` (static topology, see
+    verify_layers) make cols 1..T-1 a token TREE — node i still lands at
+    STORAGE position lengths[s] + i (the engine compacts the accepted
+    path with ops.kvcache.commit_tree_path before rolling lengths
+    forward), logits row i is the distribution after consuming node i's
+    root path."""
     _check_supported(cfg)
     s, t = tokens.shape
     x = params["embed"][tokens]  # [S, T, E]
@@ -631,6 +653,7 @@ def verify_step(
     x, k_new, v_new = verify_layers(
         params["layers"], cfg, x, cache.k, cache.v, cache.page_table,
         base, cache.page_size, mlp, mesh=mesh,
+        tree_pos=tree_pos, tree_mask=tree_mask,
     )
     x = rms_norm(x, params["final_norm"], cfg.rms_eps)
     logits = _unembed(cfg, params, x)  # [S, T, V]

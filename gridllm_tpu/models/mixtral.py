@@ -316,13 +316,16 @@ def verify_step(
     cache: PagedKVCache,
     active: jnp.ndarray,
     mesh=None,
+    tree_pos: jnp.ndarray | None = None,
+    tree_mask: jnp.ndarray | None = None,
 ) -> tuple[jnp.ndarray, PagedKVCache]:
     """Speculative-verify forward (llama.verify_step contract) with the
     MoE feed-forward routed per candidate token — _moe_mlp is leading-dim
-    agnostic, so the [S, T, E] verify stream routes like prefill's."""
+    agnostic, so the [S, T, E] verify stream routes like prefill's (and
+    the tree-verify args pass straight through)."""
     return llama.verify_step(
         params, cfg, tokens, cache, active, mlp=_mlp_for(cfg, mesh),
-        mesh=mesh,
+        mesh=mesh, tree_pos=tree_pos, tree_mask=tree_mask,
     )
 
 

@@ -241,6 +241,10 @@ register_event("engine.step_failure", keys=("error", "model", "streak"),
 register_event("engine.verify",
                keys=("drafted", "gen", "k", "model", "pending", "slots"),
                modules=("gridllm_tpu/engine/engine.py",))
+register_event("engine.verify_tree",
+               keys=("drafted", "gen", "model", "nodes", "pending",
+                     "slots"),
+               modules=("gridllm_tpu/engine/engine.py",))
 register_event("gateway.server_error", keys=("method", "route", "status"),
                modules=("gridllm_tpu/gateway/obs_routes.py",))
 register_event("gateway.submitted", keys=("model",),

@@ -22,6 +22,7 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from gridllm_tpu.analysis import numcheck
 from gridllm_tpu.utils.config import env_bool
@@ -526,10 +527,17 @@ def paged_attention_verify(
     logit_softcap: float = 0.0,
     window: jnp.ndarray | int = 0,
     mesh=None,
+    tree_pos: jnp.ndarray | None = None,
+    tree_mask: jnp.ndarray | None = None,
 ) -> jnp.ndarray:
     """Batched multi-token decode attention — the speculative-verify step
     (ISSUE 5): S slots × T candidate tokens each, attending the slot's
-    paged prefix plus the candidates before them.
+    paged prefix plus the candidates before them. With
+    `tree_pos`/`tree_mask` the candidates form a token tree (ISSUE 18,
+    see paged_attention_verify_ref) — the per-slot chunk-kernel loop
+    cannot express an ancestor mask, so tree verify always takes the
+    batched reference here (the fused ragged kernel carries the tree
+    leg).
 
     q: [S, T, H, D] (candidate queries, post-rope); k_cur/v_cur:
     [S, T, KVH, D] (the candidates' fresh K/V, not yet in the pool);
@@ -552,6 +560,13 @@ def paged_attention_verify(
     t = q.shape[1]
     use, interpret = _pallas_mode(use_pallas)
     mode, _ax = kernel_mesh_axis(mesh, k_cur.shape[2], q.shape[2])
+    if tree_pos is not None:
+        record_kernel_path("attention_verify", False)
+        return paged_attention_verify_ref(
+            q, k_pages, v_pages, page_table, lengths, page_size, k_cur,
+            v_cur, layer=layer, logit_softcap=logit_softcap, window=window,
+            tree_pos=tree_pos, tree_mask=tree_mask,
+        )
     if use and mode != "ref" and not isinstance(k_pages, QuantPages):
         outs = [
             attention_prefix_chunk(
@@ -582,14 +597,36 @@ def paged_attention_verify_ref(
     layer: jnp.ndarray | None = None,
     logit_softcap: float = 0.0,
     window: jnp.ndarray | int = 0,
+    tree_pos: jnp.ndarray | None = None,
+    tree_mask: jnp.ndarray | None = None,
 ) -> jnp.ndarray:
     """Batched verify-attention reference: vmap over slots of the dense
     per-slot gather + candidate overlay + causal mask — the same math as
     attention_prefix_chunk's fallback with start = lengths[s] and every
     candidate row valid. Pools may be one layer [P, ps, KVH, D] or the
     full [L, P, ps, KVH, D] stack with `layer` selecting (pass from
-    inside a layer scan). Returns [S, T, H, D]."""
+    inside a layer scan). Returns [S, T, H, D].
+
+    Tree verify (ISSUE 18): with `tree_pos` ([T] i32 — node depths) and
+    `tree_mask` ([T, T] bool — ancestor-or-self, row i marks node i's
+    root-to-i path) the T candidates form a static-topology token TREE
+    instead of a chain. Node i's K/V row is still stored/overlaid at
+    absolute position lengths[s] + i, but its ROPE/logical position is
+    lengths[s] + tree_pos[i]; node i's query attends the whole prefix
+    plus exactly its tree ancestors (and itself), with the sliding
+    window measured in LOGICAL distance. The topology is shared by all
+    slots (a jit constant — the recompile tripwire stays green); per-slot
+    raggedness lives in the accept walk, not the mask, because node
+    validity is ancestor-closed so a live query never attends a dead
+    node. A chain (tree_pos = arange(T), tree_mask = lower-triangular)
+    produces the exact same mask as the legacy branch, but the legacy
+    trace is kept verbatim on a separate branch so chain spec stays
+    bit-identical."""
     s, t, h, d = q.shape
+    tree = tree_pos is not None
+    if tree:
+        tree_pos = jnp.asarray(tree_pos, jnp.int32)
+        tree_mask = jnp.asarray(tree_mask, bool)
     kvh = k_pages.shape[-2]
     g = h // kvh
     scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32))
@@ -619,14 +656,31 @@ def paged_attention_verify_ref(
             jnp.concatenate([vs, pad]), vc.astype(vs.dtype), (start, 0, 0)
         )[:n]
         qf = qi.astype(jnp.float32).reshape(t, kvh, g, d)
-        q_pos = start + jnp.arange(t)
         k_pos = jnp.arange(n)
         total = start + t
-        dist = q_pos[:, None] - k_pos[None, :]
-        mask = (
-            (dist >= 0) & ((w <= 0) | (dist < w))
-            & (k_pos[None, :] < total)
-        )
+        if tree:
+            # logical positions: query node i at start + depth[i]; a key
+            # in the candidate region [start, start+T) is node j at
+            # logical start + depth[j], a prefix key sits at its own
+            # index. Candidate keys are valid iff ancestor-or-self;
+            # prefix keys iff causal — both windowed on logical distance.
+            q_pos = start + tree_pos
+            is_cand = (k_pos >= start) & (k_pos < total)
+            node = jnp.clip(k_pos - start, 0, t - 1)
+            k_log = jnp.where(is_cand, start + tree_pos[node], k_pos)
+            dist = q_pos[:, None] - k_log[None, :]
+            mask = (
+                jnp.where(is_cand[None, :], tree_mask[:, node], dist >= 0)
+                & ((w <= 0) | (dist < w))
+                & (k_pos[None, :] < total)
+            )
+        else:
+            q_pos = start + jnp.arange(t)
+            dist = q_pos[:, None] - k_pos[None, :]
+            mask = (
+                (dist >= 0) & ((w <= 0) | (dist < w))
+                & (k_pos[None, :] < total)
+            )
         logits = jnp.einsum(
             "tkgd,nkd->kgtn", qf, ks.astype(jnp.float32),
             precision=jax.lax.Precision.HIGHEST,
@@ -665,6 +719,8 @@ def ragged_paged_attention(
     logit_softcap: float = 0.0,
     window: jnp.ndarray | int = 0,
     mesh=None,
+    tree_pos: jnp.ndarray | None = None,
+    tree_mask: jnp.ndarray | None = None,
 ) -> tuple[jnp.ndarray | None, jnp.ndarray | None]:
     """Unified ragged paged attention (ISSUE 6, Ragged Paged Attention
     design): causal paged attention for a ragged token batch — one prefill
@@ -672,6 +728,15 @@ def ragged_paged_attention(
     launch, replacing the three per-phase dispatchers
     (attention_prefix_chunk / paged_attention_decode /
     paged_attention_verify) and the per-slot Python loop verify used.
+
+    Tree verify (ISSUE 18): `tree_pos` [Td] i32 + `tree_mask` [Td, Td]
+    bool turn the GROUP region's Td tokens into a static-topology token
+    tree (see paged_attention_verify_ref for the exact mask semantics).
+    The topology is a jit constant shared by every slot; the kernel
+    carries it as two scalar-prefetch rows (depths + ancestor BITMASKS,
+    one int32 per node — hence Td <= 32 on the kernel path, larger
+    budgets fall back to the jnp reference). The non-tree trace is
+    untouched — tree args absent compiles the exact pre-ISSUE-18 kernel.
 
     Regions (either may be absent; descriptors are per-sequence
     `(query_len, context_len, page_table_row)` in the RPA sense):
@@ -719,7 +784,8 @@ def ragged_paged_attention(
             q_group=q_group, page_table=page_table,
             group_lengths=group_lengths, k_group=k_group, v_group=v_group,
             layer=layer, use_pallas=use_pallas, logit_softcap=logit_softcap,
-            window=window, mesh=mesh,
+            window=window, mesh=mesh, tree_pos=tree_pos,
+            tree_mask=tree_mask,
         )
         return (
             oc[..., :d] if oc is not None else None,
@@ -755,6 +821,28 @@ def ragged_paged_attention(
         # plumbing for the scale operands) — a meshed call is a wiring
         # bug upstream; serve the exact jnp path instead of guessing
         mode = "ref"
+    has_tree = tree_pos is not None and q_group is not None
+    tree_kw = {}
+    if has_tree:
+        if q_group.shape[1] > 32:
+            # one int32 ancestor bitmask per node on the kernel path —
+            # oversized budgets take the exact jnp reference instead
+            mode = "ref"
+        else:
+            # topology is a host constant (static per process); pack the
+            # ancestor rows into int32 bitmasks for the scalar-prefetch
+            # lane of the kernel (bit j of row i = node j on node i's
+            # root path)
+            tm = np.asarray(tree_mask, bool)
+            bits = np.zeros((tm.shape[0],), np.uint32)
+            for j in range(tm.shape[1]):
+                bits |= tm[:, j].astype(np.uint32) << np.uint32(j)
+            tree_kw = {
+                "tree_pos": jnp.asarray(np.asarray(tree_pos, np.int32),
+                                        dtype=jnp.int32),
+                "tree_bits": jnp.asarray(bits.view(np.int32),
+                                         dtype=jnp.int32),
+            }
     if use and mode != "ref" and lanes_ok and chunk_ok:
         from gridllm_tpu.ops import pallas_kernels
 
@@ -782,7 +870,8 @@ def ragged_paged_attention(
                     k_chunk=k_chunk, v_chunk=v_chunk, q_group=q_group,
                     page_table=page_table, group_lengths=group_lengths,
                     k_group=k_group, v_group=v_group, layer=layer,
-                    logit_softcap=logit_softcap, window=window),
+                    logit_softcap=logit_softcap, window=window,
+                    tree_pos=tree_pos, tree_mask=tree_mask),
                 valid=(vc, vg),
             )
 
@@ -807,7 +896,7 @@ def ragged_paged_attention(
                 q_group=q_group, page_table=page_table,
                 group_lengths=group_lengths, k_group=k_group,
                 v_group=v_group, layer=layer, window=window,
-                k_scale=ksc, v_scale=vsc,
+                k_scale=ksc, v_scale=vsc, **tree_kw,
             ))
         kp = k_pages if k_pages.ndim == 5 else k_pages[None]
         vp = v_pages if v_pages.ndim == 5 else v_pages[None]
@@ -823,7 +912,7 @@ def ragged_paged_attention(
                 k_chunk=k_chunk, v_chunk=v_chunk,
                 q_group=q_group, page_table=page_table,
                 group_lengths=group_lengths, k_group=k_group,
-                v_group=v_group, layer=layer, window=window,
+                v_group=v_group, layer=layer, window=window, **tree_kw,
             ))
         from jax.sharding import PartitionSpec as P
 
@@ -846,6 +935,8 @@ def ragged_paged_attention(
             opt["group_lengths"] = (group_lengths, P(None))
             opt["k_group"] = (k_group, P(None, None, ax, None))
             opt["v_group"] = (v_group, P(None, None, ax, None))
+        for tn, tv in tree_kw.items():
+            opt[tn] = (tv, P(None))
         names = sorted(opt)
 
         out_specs = (
@@ -878,6 +969,7 @@ def ragged_paged_attention(
         q_group=q_group, page_table=page_table,
         group_lengths=group_lengths, k_group=k_group, v_group=v_group,
         layer=layer, logit_softcap=logit_softcap, window=window,
+        tree_pos=tree_pos, tree_mask=tree_mask,
     )
 
 
@@ -899,13 +991,17 @@ def ragged_paged_attention_ref(
     layer: jnp.ndarray | None = None,
     logit_softcap: float = 0.0,
     window: jnp.ndarray | int = 0,
+    tree_pos: jnp.ndarray | None = None,
+    tree_mask: jnp.ndarray | None = None,
 ) -> tuple[jnp.ndarray | None, jnp.ndarray | None]:
     """jnp reference for the unified ragged launch — the per-region
     legacy references composed VERBATIM (the fallback leg of
     ragged_paged_attention, and the oracle the KERNELS registry and the
     numerics sanitizer hold the ragged kernel to). Greedy streams stay
     bit-identical ragged-on vs ragged-off on the jnp path because each
-    region delegates to the exact legacy reference."""
+    region delegates to the exact legacy reference. Tree verify
+    (`tree_pos`/`tree_mask`, ISSUE 18) routes the group region through
+    paged_attention_verify_ref's tree branch."""
     out_chunk = out_group = None
     if q_chunk is not None:
         out_chunk = _prefix_chunk_ref(
@@ -915,7 +1011,14 @@ def ragged_paged_attention_ref(
         )
     if q_group is not None:
         td = q_group.shape[1]
-        if td == 1:
+        if tree_pos is not None:
+            out_group = paged_attention_verify_ref(
+                q_group, k_pages, v_pages, page_table, group_lengths,
+                page_size, k_group, v_group, layer=layer,
+                logit_softcap=logit_softcap, window=window,
+                tree_pos=tree_pos, tree_mask=tree_mask,
+            )
+        elif td == 1:
             # Td == 1 IS legacy decode — delegate to its reference so the
             # ragged-on jnp path stays bit-identical to ragged-off decode
             kp, vp = k_pages, v_pages

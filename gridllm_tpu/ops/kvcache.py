@@ -654,6 +654,80 @@ def rollback_to_length(cache: PagedKVCache,
     )
 
 
+def commit_tree_path(cache: PagedKVCache,
+                     path: jnp.ndarray,
+                     active: jnp.ndarray) -> PagedKVCache:
+    """Compact the ACCEPTED root-to-leaf path of a tree-verify step into
+    contiguous KV rows (ISSUE 18).
+
+    Tree verify writes node i's K/V optimistically at storage position
+    ``lengths + i`` (write_multi_all), but node i's LOGICAL position is
+    ``lengths + depth[i]`` — a rejected sibling leaves a hole between
+    accepted chain rows. ``path[s, j]`` names the tree node whose row
+    backs committed position ``lengths[s] + 1 + j`` (0 = no KV: the
+    final corrected/bonus token, or beyond n_emit — spec_accept_tree's
+    contract). This copies row ``lengths + path[s, j]`` over row
+    ``lengths + 1 + j`` for every ``path[s, j] > 0`` and leaves lengths
+    untouched (the caller rolls forward with rollback_to_length, same as
+    the chain path).
+
+    Safety invariants:
+
+    - all gathers read the ORIGINAL pool and all scatters land via the
+      out-of-bounds sentinel (mode="drop"), so overlapping src/dst rows
+      and inactive/unmapped hazards are both safe;
+    - topological node order (parents[i] < i) gives src >= dst for every
+      copy, so the accepted path only ever moves data DOWN toward its
+      committed position, never over a row another slot could read —
+      pages are slot-exclusive past the prompt, and tree rows start at
+      position ``lengths`` >= prompt length, strictly past any
+      refcount-shared prefix page (same argument as rollback_to_length);
+    - int8 pools (QuantPages) move the quantized data AND the per-row
+      scale verbatim — a dequantize/requantize round trip is NOT exact
+      (the scale would be recomputed from the row's int8 absmax), so the
+      committed row must be bit-identical to the optimistic write.
+    """
+    s, n = path.shape
+    ps = cache.page_size
+    table = cache.page_table
+    max_pages = table.shape[1]
+    pool = cache.k.data if isinstance(cache.k, QuantPages) else cache.k
+    num_pages = pool.shape[1]
+
+    j = jnp.arange(n, dtype=jnp.int32)[None, :]
+    do = active[:, None] & (path > 0) & (path != j + 1)
+    src_pos = (cache.lengths[:, None] + path).reshape(-1)
+    dst_pos = (cache.lengths[:, None] + 1 + j).reshape(-1)
+    dv = do.reshape(-1)
+    slot_of = jnp.repeat(jnp.arange(s, dtype=jnp.int32), n)
+
+    # src: gather clamps out-of-range and wraps -1 entries to a real page,
+    # so a hazardous read returns junk — harmless, the matching scatter
+    # row is masked to the sentinel below and dropped.
+    src_page = table[slot_of, jnp.clip(src_pos // ps, 0, max_pages - 1)]
+    src_off = src_pos % ps
+    dst_page = _safe_page_idx(
+        lambda p: table[slot_of, p], dst_pos, dv, ps, max_pages, num_pages,
+    )
+    dst_off = dst_pos % ps
+
+    def move(pages):
+        if isinstance(pages, QuantPages):
+            return QuantPages(
+                pages.data.at[:, dst_page, dst_off].set(
+                    pages.data[:, src_page, src_off], mode="drop"),
+                pages.scale.at[:, dst_page, dst_off].set(
+                    pages.scale[:, src_page, src_off], mode="drop"),
+            )
+        return pages.at[:, dst_page, dst_off].set(
+            pages[:, src_page, src_off], mode="drop")
+
+    return PagedKVCache(
+        k=move(cache.k), v=move(cache.v), page_table=cache.page_table,
+        lengths=cache.lengths, page_size=cache.page_size,
+    )
+
+
 def write_prefill_all(
     k_pages: jnp.ndarray,
     v_pages: jnp.ndarray,

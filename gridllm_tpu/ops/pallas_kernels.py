@@ -822,7 +822,7 @@ def _ragged_attn_kernel(
     *refs,
     ps: int, bq: int, bk: int, c: int, kvh: int, g: int, d: int,
     td: int, nct: int, softcap: float, has_chunk: bool, has_group: bool,
-    quant: bool = False,
+    quant: bool = False, has_tree: bool = False,
 ):
     """One grid over query-token tiles serving all phases at once
     (the Ragged Paged Attention shape): tiles [0, nct) are the prefill
@@ -839,6 +839,10 @@ def _ragged_attn_kernel(
     if has_group:
         lens_ref = next(it)      # SMEM [S] per-slot context lengths
         gtable_ref = next(it)    # SMEM [S, maxp]
+    if has_tree:
+        tpos_ref = next(it)      # SMEM [Td] node depths (tree verify)
+        tbits_ref = next(it)     # SMEM [Td] ancestor bitmasks (bit j of
+                                 # entry i = node j on node i's root path)
     if has_chunk:
         crow_ref = next(it)      # SMEM [maxp] chunk slot's page row
         qc_ref = next(it)        # VMEM (BQ, KVH, G, D)
@@ -1076,7 +1080,16 @@ def _ragged_attn_kernel(
         q = qg_ref[0].astype(jnp.float32) * scale   # [Td, KVH, G, D]
         q_heads = [_lp(q[:, h].reshape(r, d)) for h in range(kvh)]
         tok = jax.lax.broadcasted_iota(jnp.int32, (r,), 0) // g
-        q_abs = length + tok
+        if has_tree:
+            # tree verify (ISSUE 18): row token i's LOGICAL position is
+            # length + depth[i] (its storage position stays length + i).
+            # The topology rides in as two static-length scalar-prefetch
+            # rows; td unrolled scalar reads per tile (td <= 32).
+            depths = jnp.stack([tpos_ref[j] for j in range(td)])
+            row_depth = jnp.broadcast_to(depths[:, None], (td, g)).reshape(r)
+            q_abs = length + row_depth
+        else:
+            q_abs = length + tok
 
         m0 = jnp.full((kvh, r, 1), _NEG_INF, jnp.float32)
         l0 = jnp.zeros((kvh, r, 1), jnp.float32)
@@ -1103,8 +1116,20 @@ def _ragged_attn_kernel(
         if softcap:
             logits = softcap * jnp.tanh(logits / softcap)
         col = jax.lax.broadcasted_iota(jnp.int32, (kvh, r, td), 2)
-        dist = tok[None, :, None] - col
-        valid = (dist >= 0) & ((window <= 0) | (dist < window))
+        if has_tree:
+            # fresh column j is tree node j: valid iff ancestor-or-self
+            # of the row's node (bit j of the row's ancestor bitmask),
+            # windowed on logical (depth) distance — ancestor implies
+            # dist >= 0, so no separate causal term
+            bits = jnp.stack([tbits_ref[j] for j in range(td)])
+            row_bits = jnp.broadcast_to(bits[:, None], (td, g)).reshape(r)
+            anc = ((row_bits[None, :, None] >> col) & 1) != 0
+            dist = row_depth[None, :, None] - jnp.broadcast_to(
+                depths[None, None, :], (kvh, r, td))
+            valid = anc & ((window <= 0) | (dist < window))
+        else:
+            dist = tok[None, :, None] - col
+            valid = (dist >= 0) & ((window <= 0) | (dist < window))
         logits = jnp.where(valid, logits, _NEG_INF)
         m_new = jnp.maximum(m, logits.max(axis=2, keepdims=True))
         alpha = jnp.exp(m - m_new)
@@ -1161,6 +1186,8 @@ def ragged_attention(
     window: jnp.ndarray | int = 0,
     k_scale: jnp.ndarray | None = None,
     v_scale: jnp.ndarray | None = None,
+    tree_pos: jnp.ndarray | None = None,
+    tree_bits: jnp.ndarray | None = None,
 ) -> tuple[jnp.ndarray | None, jnp.ndarray | None]:
     """Kernel form of ops.attention.ragged_paged_attention: ONE launch,
     static grid (C/BQ chunk tiles + S group tiles) serving chunked
@@ -1174,6 +1201,8 @@ def ragged_attention(
     has_chunk = q_chunk is not None
     has_group = q_group is not None
     assert has_chunk or has_group
+    has_tree = tree_pos is not None
+    assert not has_tree or has_group
     quant = k_scale is not None
     if k_pages.ndim == 4:
         k_pages = k_pages[None]
@@ -1204,6 +1233,7 @@ def ragged_attention(
         _ragged_attn_kernel, ps=page_size, bq=bq, bk=bk, c=c, kvh=kvh,
         g=g, d=d, td=td, nct=nct, softcap=softcap,
         has_chunk=has_chunk, has_group=has_group, quant=quant,
+        has_tree=has_tree,
     )
 
     scal = jnp.stack([
@@ -1219,6 +1249,9 @@ def ragged_attention(
     if has_group:
         prefetch += [group_lengths.astype(jnp.int32),
                      page_table.astype(jnp.int32)]
+    if has_tree:
+        prefetch += [tree_pos.astype(jnp.int32),
+                     tree_bits.astype(jnp.int32)]
     if has_chunk:
         prefetch += [chunk_row.astype(jnp.int32)]
 

@@ -267,6 +267,147 @@ def spec_accept(
     return out, n_emit, last, counts, window, wlen, params
 
 
+def _spec_tree_keys(seed: jnp.ndarray, step: jnp.ndarray, topk: int,
+                    rounds: int):
+    """Per-slot (uniform[rounds], gumbel[topk]) draws for one emitted-token
+    index of the TREE accept walk: one uniform per candidate child round
+    (multi-round rejection needs an independent accept test per sibling)
+    plus the shared residual-fallback gumbel. Same (seed, step) chain as
+    _spec_keys, sub-folded at 3+round so chain and tree draws never
+    collide; deterministic per (seed, step) but not bit-equal to the
+    chain accept (only greedy streams are byte-identical, the documented
+    contract)."""
+    key = jax.random.fold_in(jax.random.PRNGKey(seed), step)
+    u = jnp.stack([
+        jax.random.uniform(jax.random.fold_in(key, 3 + c), (), jnp.float32)
+        for c in range(rounds)
+    ])
+    g = jax.random.gumbel(jax.random.fold_in(key, 2), (topk,), jnp.float32)
+    return u, g
+
+
+def spec_accept_tree(
+    logits: jnp.ndarray,       # [S, N, V] fp32 — tree-verify logits
+    node_tokens: jnp.ndarray,  # [S, N] — col 0 = committed root token,
+                               # cols 1..N-1 = drafted tree nodes
+    parents,                   # [N] host ints (static topology,
+                               # topological: parents[i] < i, root -1)
+    node_valid: jnp.ndarray,   # [S, N] bool — per-slot live nodes (root
+                               # always True; ancestor-closed)
+    params: SamplingParams,
+    counts: jnp.ndarray,       # [S, V] i32 repeat-penalty counts
+    window: jnp.ndarray,       # [S, W] i32 repeat-penalty window
+    wlen: jnp.ndarray,         # [S] i32
+    active: jnp.ndarray,       # [S] bool
+    vocab: int,
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray,
+           jnp.ndarray, jnp.ndarray, SamplingParams]:
+    """Tree generalization of spec_accept (ISSUE 18): walk the accepted
+    root-to-leaf path through a static-topology draft tree under the same
+    rejection-sampling rule.
+
+    logits[s, i] is the model's next-token distribution AFTER consuming
+    the root-to-node-i path (the tree-masked verify forward guarantees
+    node i's query row attends exactly its ancestors). The scan walks
+    depth steps; at each step the current node's children are tested in
+    node order:
+
+    - greedy (temperature <= 0): the step emits argmax of the penalized
+      logits at the current node — identical to the sequential decode
+      path — and descends into the (first) child carrying that token.
+      Greedy spec-on streams stay byte-identical to spec-off.
+    - sampled: SpecInfer-style multi-round rejection. Child c with token
+      x is accepted w.p. residual(x) where the residual starts as the
+      full truncated/penalized/temperature-scaled target and every
+      rejected sibling's token is zeroed + renormalized; if all children
+      reject, the step emits a sample from the final residual. This
+      preserves the target distribution exactly.
+    - a step with no accepted child emits its corrected/bonus token and
+      ends the walk.
+
+    Repeat-penalty counts/window evolve token-by-token inside the scan
+    (window_push), exactly as a sequential run's would.
+
+    Returns (out [N, S] emitted tokens — row j valid iff j < n_emit[s];
+    path [S, N] — path[s, j] = tree node whose optimistically-written KV
+    row backs committed position lengths[s]+1+j, 0 where the emitted
+    token was a correction/bonus (no KV) or beyond n_emit; n_emit [S];
+    last [S]; counts; window; wlen; params with step advanced)."""
+    import numpy as np
+
+    s, n, _ = logits.shape
+    parents_np = np.asarray(parents, np.int64).tolist()
+    assert len(parents_np) == n
+    logits = logits.astype(jnp.float32)
+    topk = min(TOPK, logits.shape[-1])
+    greedy_mode = params.temperature <= 0.0
+
+    def body(carry, j):
+        counts, window, wlen, emitted, alive, cur = carry
+        lg = jnp.take_along_axis(logits, cur[:, None, None], axis=1)[:, 0]
+        greedy, idx, keep, scaled = _sampler_dists(lg, params, counts)
+        u, gum = jax.vmap(
+            lambda sd, st: _spec_tree_keys(sd, st, topk, max(n - 1, 1))
+        )(params.seed, params.step + emitted)
+        probs = jax.nn.softmax(jnp.where(keep, scaled, -jnp.inf), axis=-1)
+
+        fb_keep = keep
+        acc_node = jnp.full((s,), -1, jnp.int32)
+        for c in range(1, n):
+            tok_c = node_tokens[:, c].astype(jnp.int32)
+            considered = (
+                node_valid[:, c] & (cur == parents_np[c]) & (acc_node < 0)
+            )
+            is_tok = fb_keep & (idx == tok_c[:, None])
+            num = jnp.sum(jnp.where(is_tok, probs, 0.0), axis=-1)
+            den = jnp.sum(jnp.where(fb_keep, probs, 0.0), axis=-1)
+            p_c = num / jnp.maximum(den, 1e-30)
+            # forced acceptance: rejecting would leave an empty residual
+            # (this child's token is the only kept mass left)
+            forced = ~jnp.any(fb_keep & (idx != tok_c[:, None]), axis=-1)
+            s_acc = considered & ((u[:, c - 1] < p_c) | forced)
+            g_acc = considered & (tok_c == greedy)
+            acc = jnp.where(greedy_mode, g_acc, s_acc)
+            acc_node = jnp.where(acc, jnp.int32(c), acc_node)
+            rejected = considered & ~acc & ~greedy_mode
+            fb_keep = fb_keep & ~(rejected[:, None] & (idx == tok_c[:, None]))
+
+        has = acc_node >= 0
+        acc_tok = jnp.take_along_axis(
+            node_tokens, jnp.maximum(acc_node, 0)[:, None], axis=1
+        )[:, 0].astype(jnp.int32)
+        choice = jnp.argmax(jnp.where(fb_keep, scaled + gum, -jnp.inf),
+                            axis=-1)
+        fallback = jnp.take_along_axis(
+            idx, choice[:, None], axis=-1
+        )[:, 0].astype(jnp.int32)
+        tok = jnp.where(greedy_mode, greedy, jnp.where(has, acc_tok,
+                                                       fallback))
+        emit = alive & active
+        window, wlen, counts = window_push(
+            window, wlen, counts, tok, emit, params.repeat_last_n, vocab
+        )
+        emitted = emitted + emit.astype(jnp.int32)
+        cur = jnp.where(has & emit, acc_node, cur)
+        alive = alive & has
+        return (
+            (counts, window, wlen, emitted, alive, cur),
+            (jnp.where(emit, tok, 0),
+             jnp.where(emit & has, acc_node, 0)),
+        )
+
+    init = (counts, window, wlen, jnp.zeros((s,), jnp.int32),
+            jnp.ones((s,), bool), jnp.zeros((s,), jnp.int32))
+    (counts, window, wlen, n_emit, _, _), (out, path) = jax.lax.scan(
+        body, init, jnp.arange(n, dtype=jnp.int32)
+    )
+    last = jnp.take_along_axis(
+        out.T, jnp.maximum(n_emit - 1, 0)[:, None], axis=1
+    )[:, 0]
+    params = dataclasses.replace(params, step=params.step + n_emit)
+    return out, path.T, n_emit, last, counts, window, wlen, params
+
+
 # ---------------------------------------------------------------------------
 # repeat-penalty window maintenance (llama.cpp penalty_last_n semantics)
 # ---------------------------------------------------------------------------

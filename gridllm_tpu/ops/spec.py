@@ -18,14 +18,30 @@ output (code edits, extraction, "repeat the policy clause" workloads),
 where acceptance routinely exceeds 50%. On novel text it degrades to
 proposing nothing, which the engine handles as a plain decode step.
 
-The interface is deliberately tiny so a small draft *model* can land later
-as another Drafter implementation without touching the engine: the engine
-only ever calls `draft(ids, k)` per slot between verify steps.
+Phase 2 (ISSUE 18) is **model-based drafting + token trees**: a tiny
+same-family draft model (DraftModelDrafter) loaded next to the target,
+sharing the device mesh, runs one batched catch-up forward plus K greedy
+decode steps per verify step against its own small paged-KV pool, and
+emits a STATIC-topology token tree — a depth-K greedy chain plus
+(width-1) first-level sibling alternatives whose logits come free from
+the first draft step. The tree's parent/depth/ancestor arrays are fixed
+per process (tree_topology), so every verify shape stays static and the
+recompile tripwire stays green; per-slot raggedness travels as a boolean
+node-validity mask (data, not shape). n-gram remains the default and the
+fallback whenever no draft model is configured (GRIDLLM_SPEC_DRAFT_MODEL
+empty) or the configured one is incompatible with the target.
+
+The interface is deliberately tiny: the engine calls `draft(ids, k)` per
+slot (chain drafters) or `draft_batch(ids_by_slot, k, width)` (tree
+drafters, batched over all slots in one device dispatch).
 """
 
 from __future__ import annotations
 
+import time
 from typing import Protocol, Sequence
+
+import numpy as np
 
 from gridllm_tpu.utils.config import env_int, env_str
 
@@ -56,6 +72,8 @@ class NgramDrafter:
     context lengths — noise next to a model forward.
     """
 
+    kind = "ngram"
+
     def __init__(self, max_n: int = 4, min_n: int = 1, lookback: int = 0):
         if min_n < 1 or max_n < min_n:
             raise ValueError(f"bad n-gram range [{min_n}, {max_n}]")
@@ -82,10 +100,12 @@ class NgramDrafter:
 
 
 def make_drafter(kind: str | None = None) -> Drafter:
-    """Drafter factory (env-pluggable): GRIDLLM_SPEC_DRAFTER selects the
-    implementation ("ngram" is the only phase-1 option; a draft-model
-    drafter slots in here later), GRIDLLM_SPEC_NGRAM_MAX / _MIN /
-    GRIDLLM_SPEC_LOOKBACK tune the n-gram matcher."""
+    """Host-only drafter factory (env-pluggable): GRIDLLM_SPEC_DRAFTER
+    selects the implementation ("ngram"), GRIDLLM_SPEC_NGRAM_MAX / _MIN /
+    GRIDLLM_SPEC_LOOKBACK tune the matcher. The model-based drafter is
+    NOT built here — it needs the engine's mesh/dtype/loader context, so
+    the engine constructs DraftModelDrafter directly and falls back to
+    this factory when no draft model is configured."""
     kind = kind or env_str("GRIDLLM_SPEC_DRAFTER")
     if kind == "ngram":
         return NgramDrafter(
@@ -94,3 +114,281 @@ def make_drafter(kind: str | None = None) -> Drafter:
             lookback=env_int("GRIDLLM_SPEC_LOOKBACK"),
         )
     raise ValueError(f"unknown drafter: {kind!r}")
+
+
+# ---------------------------------------------------------------------------
+# token-tree topology (ISSUE 18)
+# ---------------------------------------------------------------------------
+#
+# A draft tree is N nodes in topological order (parents[i] < i). Node 0 is
+# the ROOT: the committed last token, matching column 0 of the chain verify
+# block — its KV lags the pool exactly like a decode step's input token.
+# Nodes 1..N-1 carry drafted tokens; node i's KV is written optimistically
+# at storage position base + i, while its ROPE/logical position is
+# base + depth[i]. The topology is FIXED per process (depth-K greedy chain
+# at nodes 1..K, first-level siblings at K+1..N-1, all children of the
+# root), so parents/depth/ancestor arrays are jit-time constants and only
+# the per-slot node-validity mask is runtime data.
+
+
+def tree_depths(parents: np.ndarray) -> np.ndarray:
+    """Node depths from a topological parent array (parents[0] == -1,
+    parents[i] < i). Root depth 0."""
+    n = len(parents)
+    depth = np.zeros(n, np.int32)
+    for i in range(1, n):
+        p = int(parents[i])
+        if not 0 <= p < i:
+            raise ValueError(f"parents must be topological; node {i} -> {p}")
+        depth[i] = depth[p] + 1
+    return depth
+
+
+def tree_ancestor_mask(parents: np.ndarray) -> np.ndarray:
+    """[N, N] bool: anc[i, j] iff node j is an ancestor of i OR i itself —
+    exactly the key columns node i's query row may attend inside the
+    candidate block (the root-to-i path IS the sequential prefix)."""
+    n = len(parents)
+    anc = np.zeros((n, n), bool)
+    for i in range(n):
+        j = i
+        while j >= 0:
+            anc[i, j] = True
+            j = int(parents[j])
+    return anc
+
+
+def tree_ancestor_bits(parents: np.ndarray) -> np.ndarray:
+    """The ancestor mask packed row-wise into int32 bitmasks (bit j of
+    entry i = anc[i, j]) — the SMEM-friendly form the Pallas ragged
+    kernel's group region consumes. Node budget therefore caps at 32."""
+    anc = tree_ancestor_mask(parents)
+    n = len(parents)
+    if n > 32:
+        raise ValueError(f"tree node budget {n} > 32 (bitmask packing)")
+    bits = np.zeros(n, np.int32)
+    for i in range(n):
+        for j in range(n):
+            if anc[i, j]:
+                bits[i] |= 1 << j
+    return bits
+
+
+def tree_topology(k: int, width: int) -> np.ndarray:
+    """The process-static draft topology: a depth-`k` chain (nodes 1..k,
+    each the child of the previous) plus `width - 1` extra first-level
+    alternatives (children of the root — their logits come free from the
+    draft model's first decode step). width == 1 is the pure chain;
+    k == 0 degenerates to the root alone."""
+    if k < 0 or width < 1:
+        raise ValueError(f"bad tree shape k={k} width={width}")
+    parents = [-1] + list(range(k)) + [0] * (width - 1 if k else 0)
+    return np.asarray(parents, np.int32)
+
+
+class DraftModelDrafter:
+    """Model-based drafting (ISSUE 18): a tiny same-family draft model with
+    its own small paged-KV pool, batched over all slots.
+
+    Per engine verify step the drafter (1) diffs each slot's host context
+    against what its draft cache has consumed and rolls the cache back to
+    the common prefix (pure length bookkeeping — rejected speculation and
+    corrections rewind for free), (2) ingests the new tokens in fixed-width
+    catch-up chunks through the draft model's verify forward, and (3) runs
+    K greedy decode steps emitting the chain plus the top-(width-1)
+    first-step alternatives. Drafted tokens' KV stays in the draft pool
+    optimistically: accepted tokens are identical tokens at identical
+    positions, so the next call's common-prefix diff keeps their KV and
+    only mispredictions re-ingest.
+
+    All device work happens in exactly two jitted programs with static
+    shapes (one catch-up width, one draft depth), so the recompile
+    tripwire stays green; slots whose context outgrows the draft pool
+    simply stop proposing (the engine then runs plain verify steps).
+    """
+
+    kind = "model"
+    tree = True
+
+    def __init__(self, mod, cfg, params, *, max_slots: int, page_size: int,
+                 max_pages_per_slot: int, mesh=None, ingest_width: int = 64,
+                 dtype=None, wrap=None):
+        import jax
+        import jax.numpy as jnp
+
+        from gridllm_tpu.ops.kvcache import PagedKVCache, rollback_to_length
+
+        self.mod, self.cfg, self.params = mod, cfg, params
+        self.mesh = mesh
+        self.max_slots = max_slots
+        self.page_size = page_size
+        self.draft_ns = 0  # cumulative host wall time inside draft_batch
+        self._w = max(int(ingest_width), 1)
+        # every slot owns a fixed page stripe — no allocator, the table is
+        # a constant (the draft pool is tiny; simplicity beats packing)
+        table = np.arange(max_slots * max_pages_per_slot, dtype=np.int32)
+        table = table.reshape(max_slots, max_pages_per_slot)
+        self.max_context = min(cfg.max_seq_len,
+                               max_pages_per_slot * page_size)
+
+        def _new_cache():
+            cache = PagedKVCache.create(
+                cfg.num_layers, max_slots * max_pages_per_slot, page_size,
+                cfg.num_kv_heads, cfg.head_dim_, max_slots,
+                max_pages_per_slot,
+                dtype=jnp.dtype(dtype) if dtype is not None
+                else jnp.bfloat16,
+            )
+            cache = PagedKVCache(
+                k=cache.k, v=cache.v,
+                page_table=jnp.asarray(table, dtype=jnp.int32),
+                lengths=cache.lengths, page_size=page_size,
+            )
+            if mesh is not None:
+                from gridllm_tpu.parallel.sharding import shard_cache
+                cache = shard_cache(cache, mesh)
+            return cache
+
+        self._new_cache = _new_cache
+        self.cache = _new_cache()
+        # host-side per-slot view of what the draft pool holds: the token
+        # prefix whose KV is valid (possibly AHEAD of the engine thanks to
+        # optimistic draft writes)
+        self._ctx: list[list[int]] = [[] for _ in range(max_slots)]
+
+        from functools import partial
+
+        @partial(jax.jit, donate_argnums=(1,))
+        def ingest_fn(params, cache, tokens, tlen, lengths, active):
+            # fixed-width catch-up chunk: consume `tlen` new tokens per
+            # slot (right-padded to the static width), writing their KV
+            cache = PagedKVCache(
+                k=cache.k, v=cache.v, page_table=cache.page_table,
+                lengths=lengths, page_size=page_size,
+            )
+            logits, cache = mod.verify_step(
+                params, cfg, tokens, cache, active, mesh=mesh)
+            cache = rollback_to_length(
+                cache, jnp.minimum(cache.lengths + tlen, self.max_context))
+            # the chunk's last valid row IS the next-token distribution
+            last = jnp.take_along_axis(
+                logits, jnp.maximum(tlen - 1, 0)[:, None, None], axis=1
+            )[:, 0]
+            return last, cache
+
+        @partial(jax.jit, static_argnames=("k", "width"),
+                 donate_argnums=(1,))
+        def draft_fn(params, cache, last_logits, active, *, k, width):
+            # K greedy steps from the catch-up logits; the first step's
+            # top-`width` alternatives ride along (alts[:, 0] == chain[0])
+            alts = jax.lax.top_k(last_logits, width)[1].astype(jnp.int32)
+            tok = alts[:, 0]
+            chain = [tok]
+            for _ in range(k - 1):
+                logits, cache = mod.decode_step(
+                    params, cfg, tok, cache, active, mesh=mesh)
+                tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                chain.append(tok)
+            return jnp.stack(chain, axis=1), alts, cache
+
+        wrap = wrap or (lambda name, fn: fn)
+        self._ingest_fn = wrap("draft_ingest", ingest_fn)
+        self._draft_fn = wrap("draft_step", draft_fn)
+
+    def reset_slot(self, slot: int) -> None:
+        """Invalidate a slot's draft context (request finished/replaced)."""
+        self._ctx[slot] = []
+
+    def reset(self) -> None:
+        """Rebuild the draft pool wholesale. The jitted entries donate
+        the cache, so an exception mid-call can leave self.cache
+        referencing deleted buffers — same failure mode as the engine's
+        reset_device_state, which calls this alongside its own rebuild."""
+        self.cache = self._new_cache()
+        self._ctx = [[] for _ in range(self.max_slots)]
+
+    def draft(self, ids: Sequence[int], k: int) -> list[int]:
+        """Drafter-protocol chain compatibility: slot-0 batched call."""
+        out = self.draft_batch({0: list(ids)}, k, 1)
+        return out.get(0, ([], []))[0]
+
+    def draft_batch(
+        self, ids_by_slot: dict[int, list[int]], k: int, width: int,
+    ) -> dict[int, tuple[list[int], list[int]]]:
+        """One batched draft pass. Returns per slot (chain tokens ≤ k,
+        first-level alternative tokens ≤ width-1). Slots that would
+        overflow the draft pool (or were not asked for) are absent."""
+        import jax
+        import numpy as _np
+
+        t0 = time.perf_counter_ns()
+        s = self.max_slots
+        live: list[int] = []
+        for slot, ids in ids_by_slot.items():
+            # +k: the decode steps write chain[0..k-2] past the context;
+            # +1 headroom for the padded ingest chunk's junk tail
+            if len(ids) + k + 1 > self.max_context or not ids:
+                self._ctx[slot] = []
+                continue
+            live.append(slot)
+        if not live or k <= 0:
+            self.draft_ns += time.perf_counter_ns() - t0
+            return {}
+
+        # host diff: longest common prefix between the draft pool's view
+        # and the engine's context decides the rollback point
+        base = _np.zeros(s, _np.int32)
+        todo: dict[int, list[int]] = {}
+        for slot in live:
+            ids = ids_by_slot[slot]
+            ctx = self._ctx[slot]
+            n = 0
+            for a, b in zip(ctx, ids):
+                if a != b:
+                    break
+                n += 1
+            base[slot] = n
+            todo[slot] = ids[n:]
+            self._ctx[slot] = list(ids)  # consumed after the catch-up
+
+        active_np = _np.zeros(s, bool)
+        for slot in live:
+            active_np[slot] = True
+        active = jax.numpy.asarray(active_np)
+
+        # fixed-width catch-up chunks; all but the final chunk only write
+        # KV, the final chunk's last-row logits seed the draft chain
+        w = self._w
+        rounds = max((max(len(v) for v in todo.values()) + w - 1) // w, 1)
+        last_logits = None
+        for r in range(rounds):
+            toks = _np.zeros((s, w), _np.int32)
+            tlen = _np.zeros(s, _np.int32)
+            for slot in live:
+                seg = todo[slot][r * w:(r + 1) * w]
+                if not seg:
+                    # already caught up (optimistic draft KV matched, or a
+                    # later round for a short slot): re-feed the final
+                    # token so this chunk still yields next-token logits
+                    seg = [self._ctx[slot][-1]]
+                    base[slot] -= 1
+                toks[slot, :len(seg)] = seg
+                tlen[slot] = len(seg)
+            last_logits, self.cache = self._ingest_fn(
+                self.params, self.cache, jax.numpy.asarray(toks),
+                jax.numpy.asarray(tlen), jax.numpy.asarray(base + 0),
+                active)
+            base += tlen
+        chain, alts, self.cache = self._draft_fn(
+            self.params, self.cache, last_logits, active,
+            k=k, width=max(width, 1))
+        chain = _np.asarray(jax.device_get(chain))
+        alts = _np.asarray(jax.device_get(alts))
+        out: dict[int, tuple[list[int], list[int]]] = {}
+        for slot in live:
+            ch = [int(t) for t in chain[slot]]
+            # the decode steps consumed chain[:-1] and wrote their KV
+            self._ctx[slot] = self._ctx[slot] + ch[:-1]
+            out[slot] = (ch, [int(t) for t in alts[slot][1:]])
+        self.draft_ns += time.perf_counter_ns() - t0
+        return out

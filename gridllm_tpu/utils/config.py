@@ -263,6 +263,18 @@ register_env("GRIDLLM_SPEC_NGRAM_MIN", "1",
 register_env("GRIDLLM_SPEC_LOOKBACK", "0",
              "Drafter match window over the slot history in tokens; "
              "0 = unbounded.")
+register_env("GRIDLLM_SPEC_DRAFT_MODEL", "",
+             "Registered config name of a tiny same-tokenizer draft model "
+             "for model-based tree drafting; empty keeps n-gram drafting.")
+register_env("GRIDLLM_SPEC_DRAFT_CHECKPOINT", "",
+             "Checkpoint dir for the draft model; empty = fresh "
+             "PRNGKey(0) init (test/bench path).")
+register_env("GRIDLLM_SPEC_TREE_WIDTH", "2",
+             "Draft-tree sibling fan-out at depth 1 (tree node budget is "
+             "1 + K + width - 1); 1 = pure chain.")
+register_env("GRIDLLM_SPEC_DRAFT_INGEST", "64",
+             "Fixed catch-up chunk width (tokens) of the draft model's "
+             "context-ingest forward.")
 
 # multi-host SPMD
 register_env("GRIDLLM_COORD_ADDR", "",

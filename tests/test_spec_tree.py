@@ -1,0 +1,549 @@
+"""Draft-model speculative decoding with tree verification (ISSUE 18).
+
+Layers of pinning:
+
+- topology units: parents/depths/ancestor-mask/bitmask construction;
+- accept walk: spec_accept_tree's greedy root-to-leaf walk (chain
+  descent, sibling rescue, path/bonus accounting);
+- KV commit: commit_tree_path moves exactly the accepted path's rows —
+  across page boundaries, never touching rows below the verify base
+  (pinned prefix-cache pages), int8 pools bit-verbatim;
+- attention: the tree-masked verify reference degenerates to the legacy
+  chain trace for a chain topology, and the interpret-mode ragged
+  kernel's tree leg matches the reference;
+- stream parity: greedy streams are byte-identical tree-spec-on vs
+  spec-off — solo, concurrent, warm prefix-cache replays, and
+  mid-stream resume from the context watermark; seeded sampling stays
+  deterministic;
+- hygiene: zero steady-state recompiles with the tree armed; unknown /
+  incompatible draft models fall back to n-gram instead of failing.
+"""
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gridllm_tpu.engine import EngineConfig, GenerationRequest, InferenceEngine
+from gridllm_tpu.obs.perf import recompile_totals
+from gridllm_tpu.ops import attention as A
+from gridllm_tpu.ops import pallas_kernels as PK
+from gridllm_tpu.ops.kvcache import PagedKVCache, QuantPages, commit_tree_path
+from gridllm_tpu.ops.sampling import SamplingParams, spec_accept_tree
+from gridllm_tpu.ops.spec import (
+    DraftModelDrafter,
+    tree_ancestor_bits,
+    tree_ancestor_mask,
+    tree_depths,
+    tree_topology,
+)
+
+TINY = dict(
+    model="tiny-llama",
+    max_slots=4,
+    page_size=8,
+    num_pages=64,
+    max_pages_per_slot=8,
+    prefill_buckets=(16, 32),
+)
+REP_PROMPT = "ab ab ab ab ab ab"
+REP_OPTS = {"temperature": 0.0, "repeat_penalty": 1.0, "num_predict": 24}
+
+
+@pytest.fixture(scope="module")
+def tree_on():
+    # draft model == target config with the same fresh PRNGKey(0) init →
+    # identical weights, so acceptance is near-ceiling and the parity
+    # tests exercise deep accepted paths, not the fallback row
+    return InferenceEngine(EngineConfig(
+        **TINY, spec_decode=True, spec_k=3, draft_model="tiny-llama"))
+
+
+@pytest.fixture(scope="module")
+def spec_off():
+    return InferenceEngine(EngineConfig(**TINY, spec_decode=False))
+
+
+# ---------------------------------------------------------------------------
+# topology units
+# ---------------------------------------------------------------------------
+
+
+def test_tree_topology_chain_plus_siblings():
+    p = tree_topology(3, 2)
+    assert p.tolist() == [-1, 0, 1, 2, 0]
+    assert tree_depths(p).tolist() == [0, 1, 2, 3, 1]
+    # width 1 = pure chain; k = 0 degenerates to the root alone
+    assert tree_topology(3, 1).tolist() == [-1, 0, 1, 2]
+    assert tree_topology(0, 4).tolist() == [-1]
+    with pytest.raises(ValueError):
+        tree_topology(-1, 2)
+    with pytest.raises(ValueError):
+        tree_topology(2, 0)
+
+
+def test_ancestor_mask_construction():
+    p = tree_topology(2, 3)  # [-1, 0, 1, 0, 0]
+    anc = tree_ancestor_mask(p)
+    want = np.array([
+        [1, 0, 0, 0, 0],   # root: itself
+        [1, 1, 0, 0, 0],   # chain 1: root + itself
+        [1, 1, 1, 0, 0],   # chain 2: root + chain1 + itself
+        [1, 0, 0, 1, 0],   # sibling: root + itself (NOT chain nodes)
+        [1, 0, 0, 0, 1],
+    ], bool)
+    np.testing.assert_array_equal(anc, want)
+    # bitmask packing: bit j of entry i == anc[i, j]
+    bits = tree_ancestor_bits(p)
+    for i in range(len(p)):
+        for j in range(len(p)):
+            assert bool((int(bits[i]) >> j) & 1) == bool(anc[i, j])
+    with pytest.raises(ValueError):
+        tree_ancestor_bits(np.asarray([-1] + list(range(33)), np.int32))
+
+
+# ---------------------------------------------------------------------------
+# accept walk (greedy)
+# ---------------------------------------------------------------------------
+
+
+def _greedy_params(s):
+    return dataclasses.replace(
+        SamplingParams.defaults(s),
+        temperature=jnp.zeros((s,), jnp.float32),
+        repeat_penalty=jnp.ones((s,), jnp.float32),
+    )
+
+
+def _walk(logits, node_tokens, parents, valid, vocab=16, W=8):
+    s = logits.shape[0]
+    return spec_accept_tree(
+        jnp.asarray(logits), jnp.asarray(node_tokens), parents,
+        jnp.asarray(valid), _greedy_params(s),
+        jnp.zeros((s, vocab), jnp.int32), jnp.zeros((s, W), jnp.int32),
+        jnp.zeros((s,), jnp.int32), jnp.ones((s,), bool), vocab)
+
+
+def test_accept_tree_greedy_chain_walk():
+    parents = tree_topology(2, 2)  # [-1, 0, 1, 0]
+    n, S, V = len(parents), 2, 16
+    logits = np.full((S, n, V), -10.0, np.float32)
+    tgt = [(i * 2 + 3) % V for i in range(n)]
+    for i in range(n):
+        logits[:, i, tgt[i]] = 5.0
+    nt = np.zeros((S, n), np.int32)
+    nt[:, 1] = tgt[0]            # chain head matches both slots
+    nt[0, 2] = tgt[1]            # slot 0 depth-2 matches
+    nt[1, 2] = (tgt[1] + 1) % V  # slot 1 depth-2 misses
+    nt[:, 3] = (tgt[0] + 5) % V  # sibling never reached (head accepted)
+    out, path, n_emit, last, *_ = _walk(
+        logits, nt, parents, np.ones((S, n), bool))
+    out, path = np.asarray(out), np.asarray(path)
+    assert np.asarray(n_emit).tolist() == [3, 2]
+    # slot 0: both chain nodes + bonus; slot 1: head + correction
+    assert out.T[0, :3].tolist() == [tgt[0], tgt[1], tgt[2]]
+    assert out.T[1, :2].tolist() == [tgt[0], tgt[1]]
+    # path names the node backing each committed position; 0 = no KV
+    # (the final corrected/bonus token)
+    assert path[0, :3].tolist() == [1, 2, 0]
+    assert path[1, :2].tolist() == [1, 0]
+    assert np.asarray(last).tolist() == [tgt[2], tgt[1]]
+
+
+def test_accept_tree_sibling_rescues_rejected_head():
+    parents = tree_topology(2, 2)
+    n, V = len(parents), 16
+    logits = np.full((1, n, V), -10.0, np.float32)
+    logits[0, 0, 7] = 5.0   # root argmax = 7
+    logits[0, 3, 9] = 5.0   # after the sibling node, argmax = 9
+    nt = np.zeros((1, n), np.int32)
+    nt[0, 1] = 5            # chain head misses
+    nt[0, 3] = 7            # sibling carries the greedy token
+    out, path, n_emit, _, *_ = _walk(logits, nt, parents,
+                                     np.ones((1, n), bool))
+    assert int(n_emit[0]) == 2
+    assert np.asarray(out).T[0, :2].tolist() == [7, 9]
+    # position base+1 is backed by the SIBLING's optimistic row (node 3)
+    assert np.asarray(path)[0, :2].tolist() == [3, 0]
+
+
+def test_accept_tree_respects_node_validity():
+    """A matching token on an INVALID node must not be accepted — per-slot
+    budgets travel as validity data, not topology."""
+    parents = tree_topology(2, 2)
+    n, V = len(parents), 16
+    logits = np.full((1, n, V), -10.0, np.float32)
+    logits[0, :, 7] = 5.0
+    nt = np.zeros((1, n), np.int32)
+    nt[0, 1] = 7
+    nt[0, 2] = 7
+    valid = np.ones((1, n), bool)
+    valid[0, 2] = False  # depth-2 node budget-masked out
+    out, path, n_emit, _, *_ = _walk(logits, nt, parents, valid)
+    # head accepted, then NO valid child at depth 2 → bonus ends the walk
+    assert int(n_emit[0]) == 2
+    assert np.asarray(path)[0, :2].tolist() == [1, 0]
+
+
+# ---------------------------------------------------------------------------
+# KV commit of the accepted path
+# ---------------------------------------------------------------------------
+
+
+def _tree_cache(lengths, S=2, L=1, ps=4, P=16, maxp=4, kvh=2, d=8,
+                quant=False):
+    table = np.full((S, maxp), -1, np.int32)
+    table[0] = [0, 1, 2, 3]
+    table[1] = [4, 5, 6, 7]
+    if quant:
+        kd = np.zeros((L, P, ps, kvh, d), np.int8)
+        sc = np.ones((L, P, ps), np.float32)
+        k = QuantPages(jnp.asarray(kd), jnp.asarray(sc))
+        v = QuantPages(jnp.asarray(kd.copy()), jnp.asarray(sc.copy()))
+    else:
+        k = jnp.zeros((L, P, ps, kvh, d), jnp.float32)
+        v = jnp.zeros((L, P, ps, kvh, d), jnp.float32)
+    return PagedKVCache(k=k, v=v, page_table=jnp.asarray(table),
+                        lengths=jnp.asarray(lengths, jnp.int32),
+                        page_size=ps), table
+
+
+def _fill_rows(cache, table, base, n):
+    """Stamp rows base..base+n-1 of each slot with slot*100 + node."""
+    k = np.array(cache.k)
+    v = np.array(cache.v)
+    ps = cache.page_size
+    for s in range(table.shape[0]):
+        for i in range(n):
+            pos = base[s] + i
+            pg, off = table[s][pos // ps], pos % ps
+            k[:, pg, off] = 100 * s + i
+            v[:, pg, off] = 100 * s + i + 0.5
+    return dataclasses.replace(cache, k=jnp.asarray(k), v=jnp.asarray(v))
+
+
+def test_commit_tree_path_across_page_boundary():
+    # base 5 with page_size 4: node rows 5..8 straddle pages 1 and 2
+    cache, table = _tree_cache([5, 5])
+    cache = _fill_rows(cache, table, [5, 5], 4)
+    # slot 0: chain path (identity — no moves); slot 1: sibling (node 3)
+    # backs position base+1, which lives on a DIFFERENT page than node 3
+    path = jnp.asarray([[1, 2, 0, 0], [3, 0, 0, 0]], jnp.int32)
+    out = commit_tree_path(cache, path, jnp.asarray([True, True]))
+    k = np.asarray(out.k)
+    ps = cache.page_size
+    # slot 0 untouched (path[j] == j+1 everywhere it matters)
+    for i in range(4):
+        pg, off = table[0][(5 + i) // ps], (5 + i) % ps
+        assert k[0, pg, off, 0, 0] == i
+    # slot 1: position 6 now holds node 3's row; the root row and the
+    # optimistic source row are untouched
+    pg, off = table[1][6 // ps], 6 % ps
+    assert k[0, pg, off, 0, 0] == 103
+    assert np.asarray(out.v)[0, pg, off, 0, 0] == 103.5
+    pg, off = table[1][5 // ps], 5 % ps
+    assert k[0, pg, off, 0, 0] == 100
+    # lengths are the CALLER's business (rollback_to_length), not commit's
+    assert np.asarray(out.lengths).tolist() == [5, 5]
+
+
+def test_commit_tree_path_never_touches_prefix_rows():
+    """Rows strictly below lengths + 1 (the committed prompt, possibly
+    refcount-shared prefix-cache pages) are never written: every
+    destination is lengths + 1 + j with path > 0."""
+    cache, table = _tree_cache([5, 3])
+    cache = _fill_rows(cache, table, [0, 0], 8)  # stamp the WHOLE prefix
+    before = np.asarray(cache.k).copy()
+    path = jnp.asarray([[3, 0, 0, 0], [2, 3, 0, 0]], jnp.int32)
+    out = commit_tree_path(cache, path, jnp.asarray([True, True]))
+    after = np.asarray(out.k)
+    ps = cache.page_size
+    for s, base in ((0, 5), (1, 3)):
+        for pos in range(base + 1):  # prompt rows + the root row
+            pg, off = table[s][pos // ps], pos % ps
+            np.testing.assert_array_equal(after[0, pg, off],
+                                          before[0, pg, off])
+    # inactive slots never move rows either
+    out2 = commit_tree_path(cache, path, jnp.asarray([False, False]))
+    np.testing.assert_array_equal(np.asarray(out2.k), before)
+
+
+def test_commit_tree_path_quant_moves_bits_verbatim():
+    """int8 pools move data + per-row scale verbatim — a dequant/requant
+    round trip would recompute the scale and lose bits."""
+    cache, table = _tree_cache([5, 5], quant=True)
+    kd = np.array(cache.k.data)
+    sc = np.array(cache.k.scale)
+    ps = cache.page_size
+    for s in range(2):
+        for i in range(4):
+            pos = 5 + i
+            pg, off = table[s][pos // ps], pos % ps
+            kd[:, pg, off] = (10 * s + i) % 127
+            sc[:, pg, off] = 0.25 * (i + 1)
+    q = QuantPages(jnp.asarray(kd), jnp.asarray(sc))
+    cache = dataclasses.replace(cache, k=q, v=q)
+    path = jnp.asarray([[1, 2, 0, 0], [3, 0, 0, 0]], jnp.int32)
+    out = commit_tree_path(cache, path, jnp.asarray([True, True]))
+    pg, off = table[1][6 // ps], 6 % ps
+    assert np.asarray(out.k.data)[0, pg, off, 0, 0] == 13
+    assert np.asarray(out.k.scale)[0, pg, off] == 1.0
+
+
+# ---------------------------------------------------------------------------
+# tree-masked attention: chain degeneracy + kernel differential
+# ---------------------------------------------------------------------------
+
+
+def test_verify_ref_tree_chain_degenerates_to_legacy():
+    """tree_pos = arange, lower-triangular ancestor mask == the legacy
+    chain verify bit-for-bit (same math, the tree branch just spells the
+    causal mask explicitly)."""
+    rng = np.random.default_rng(3)
+    L, P, ps, kvh, d, h = 2, 32, 8, 2, 16, 4
+    S, maxp, T = 3, 6, 4
+    kp = jnp.asarray(rng.normal(size=(L, P, ps, kvh, d)), jnp.float32)
+    vp = jnp.asarray(rng.normal(size=(L, P, ps, kvh, d)), jnp.float32)
+    table = jnp.asarray(
+        rng.choice(32, size=S * maxp, replace=False).reshape(S, maxp),
+        jnp.int32)
+    lengths = jnp.asarray([13, 0, 37], jnp.int32)
+    q = jnp.asarray(rng.normal(size=(S, T, h, d)), jnp.float32)
+    kc = jnp.asarray(rng.normal(size=(S, T, kvh, d)), jnp.float32)
+    vc = jnp.asarray(rng.normal(size=(S, T, kvh, d)), jnp.float32)
+    want = A.paged_attention_verify_ref(
+        q, kp, vp, table, lengths, ps, kc, vc, layer=jnp.int32(1))
+    chain_pos = np.arange(T, dtype=np.int32)
+    chain_mask = np.tril(np.ones((T, T), bool))
+    got = A.paged_attention_verify_ref(
+        q, kp, vp, table, lengths, ps, kc, vc, layer=jnp.int32(1),
+        tree_pos=chain_pos, tree_mask=chain_mask)
+    np.testing.assert_allclose(np.asarray(want), np.asarray(got),
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_ragged_kernel_tree_leg_matches_ref():
+    """Interpret-mode ragged kernel with the tree scalar-prefetch rows
+    (depths + ancestor bitmasks) matches the tree-masked reference —
+    a real branchy topology, not the chain degenerate."""
+    rng = np.random.default_rng(4)
+    L, P, ps, kvh, d, h = 2, 32, 8, 2, 16, 4
+    S, maxp = 3, 6
+    parents = tree_topology(2, 3)  # [-1, 0, 1, 0, 0] — N = 5
+    T = len(parents)
+    depths = tree_depths(parents)
+    anc = tree_ancestor_mask(parents)
+    bits = tree_ancestor_bits(parents)
+    kp = jnp.asarray(rng.normal(size=(L, P, ps, kvh, d)), jnp.float32)
+    vp = jnp.asarray(rng.normal(size=(L, P, ps, kvh, d)), jnp.float32)
+    table = jnp.asarray(
+        rng.choice(32, size=S * maxp, replace=False).reshape(S, maxp),
+        jnp.int32)
+    lengths = jnp.asarray([13, 0, 37], jnp.int32)
+    q = jnp.asarray(rng.normal(size=(S, T, h, d)), jnp.float32)
+    kc = jnp.asarray(rng.normal(size=(S, T, kvh, d)), jnp.float32)
+    vc = jnp.asarray(rng.normal(size=(S, T, kvh, d)), jnp.float32)
+    for window in (0, 9):
+        want = A.paged_attention_verify_ref(
+            q, kp, vp, table, lengths, ps, kc, vc, layer=jnp.int32(0),
+            window=window, tree_pos=depths, tree_mask=anc)
+        _, got = PK.ragged_attention(
+            kp, vp, ps, q_group=q, page_table=table,
+            group_lengths=lengths, k_group=kc, v_group=vc,
+            layer=jnp.int32(0), interpret=True, window=window,
+            tree_pos=jnp.asarray(depths), tree_bits=jnp.asarray(bits))
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)
+
+
+def test_ragged_dispatcher_routes_tree_to_ref():
+    """The jnp dispatcher path accepts tree args and matches the direct
+    reference (the engine's CPU tier-1 route)."""
+    rng = np.random.default_rng(5)
+    L, P, ps, kvh, d, h = 1, 16, 8, 2, 16, 4
+    S, maxp = 2, 4
+    parents = tree_topology(2, 2)
+    T = len(parents)
+    depths, anc = tree_depths(parents), tree_ancestor_mask(parents)
+    kp = jnp.asarray(rng.normal(size=(L, P, ps, kvh, d)), jnp.float32)
+    vp = jnp.asarray(rng.normal(size=(L, P, ps, kvh, d)), jnp.float32)
+    table = jnp.asarray(
+        rng.choice(16, size=S * maxp, replace=False).reshape(S, maxp),
+        jnp.int32)
+    lengths = jnp.asarray([9, 3], jnp.int32)
+    q = jnp.asarray(rng.normal(size=(S, T, h, d)), jnp.float32)
+    kc = jnp.asarray(rng.normal(size=(S, T, kvh, d)), jnp.float32)
+    vc = jnp.asarray(rng.normal(size=(S, T, kvh, d)), jnp.float32)
+    want = A.paged_attention_verify_ref(
+        q, kp, vp, table, lengths, ps, kc, vc, layer=jnp.int32(0),
+        tree_pos=depths, tree_mask=anc)
+    _, got = A.ragged_paged_attention(
+        kp, vp, ps, q_group=q, page_table=table, group_lengths=lengths,
+        k_group=kc, v_group=vc, layer=jnp.int32(0), use_pallas=False,
+        tree_pos=depths, tree_mask=anc)
+    np.testing.assert_allclose(np.asarray(want), np.asarray(got),
+                               rtol=1e-6, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# drafter units
+# ---------------------------------------------------------------------------
+
+
+def test_draft_model_drafter_batch_and_slot_isolation(tree_on):
+    d = tree_on._drafter
+    assert isinstance(d, DraftModelDrafter)
+    assert d.kind == "model" and d.tree
+    out = d.draft_batch({0: [5, 6, 7], 2: [9, 9, 9, 9]}, 3, 2)
+    assert set(out) == {0, 2}
+    for chain, alts in out.values():
+        assert len(chain) == 3 and len(alts) == 1
+        # the first alternative differs from the chain head by contract
+        # (top-k rank 1 vs rank 0)
+        assert alts[0] != chain[0]
+    # overflow slots stop proposing instead of corrupting the pool
+    long_ids = list(range(d.max_context))
+    assert d.draft_batch({1: long_ids}, 3, 2) == {}
+    d.reset_slot(0)
+    d.reset_slot(2)
+    assert d._ctx[0] == [] and d._ctx[2] == []
+
+
+def test_unknown_draft_model_falls_back_to_ngram():
+    eng = InferenceEngine(EngineConfig(
+        **TINY, spec_decode=True, spec_k=2, draft_model="no-such-model"))
+    assert eng._spec_k == 2
+    assert getattr(eng._drafter, "kind", None) == "ngram"
+
+
+# ---------------------------------------------------------------------------
+# stream parity
+# ---------------------------------------------------------------------------
+
+
+def test_greedy_parity_tree_vs_off_with_real_acceptance(tree_on, spec_off):
+    for prompt in (REP_PROMPT, "hello world, here we go"):
+        r_off = spec_off.generate(GenerationRequest(
+            id="o", prompt=prompt, options=dict(REP_OPTS)))
+        r_on = tree_on.generate(GenerationRequest(
+            id="t", prompt=prompt, options=dict(REP_OPTS)))
+        assert r_on.token_ids == r_off.token_ids, prompt
+        assert r_on.text == r_off.text
+        assert r_on.spec_proposed > 0
+        assert r_on.spec_accepted > 0
+
+
+def test_greedy_parity_concurrent_tree_batch(tree_on, spec_off):
+    opts = {"temperature": 0.0, "repeat_penalty": 1.0, "num_predict": 10}
+    prompts = ("aa aa aa aa", "bc bc bc bc", "hello")
+    solo = {
+        p: spec_off.generate(GenerationRequest(
+            id=p, prompt=p, options=dict(opts))).token_ids
+        for p in prompts
+    }
+    results = {}
+
+    def mk(p):
+        def cb(d, done, res):
+            if done:
+                results[p] = res.token_ids
+        return cb
+
+    for p in prompts:
+        tree_on.submit(GenerationRequest(
+            id=p, prompt=p, options=dict(opts), on_chunk=mk(p)))
+    while len(results) < len(prompts):
+        tree_on.step()
+    assert results == solo
+
+
+def test_greedy_parity_warm_prefix_cache(tree_on, spec_off):
+    """A warm replay admits through cached prefix pages — the tree
+    verify must keep byte parity on top of the reused KV."""
+    opts = {"temperature": 0.0, "repeat_penalty": 1.0, "num_predict": 12}
+    prompt = "cache me twice cache me twice"
+    want = spec_off.generate(GenerationRequest(
+        id="w0", prompt=prompt, options=dict(opts))).token_ids
+    cold = tree_on.generate(GenerationRequest(
+        id="w1", prompt=prompt, options=dict(opts)))
+    warm = tree_on.generate(GenerationRequest(
+        id="w2", prompt=prompt, options=dict(opts)))
+    assert cold.token_ids == want
+    assert warm.token_ids == want
+    assert warm.cached_tokens > 0  # the replay really hit the cache
+
+
+def test_greedy_parity_resume_mid_stream(tree_on, spec_off):
+    """Splitting a stream at a watermark (result.context → prompt_ids)
+    and resuming must reproduce the unsplit stream, spec-off and
+    tree-spec alike."""
+    opts = {"temperature": 0.0, "repeat_penalty": 1.0}
+    prompt = "resume ab resume ab resume"
+    full = spec_off.generate(GenerationRequest(
+        id="f", prompt=prompt, options={**opts, "num_predict": 16}))
+
+    def split_run(eng):
+        head = eng.generate(GenerationRequest(
+            id="h", prompt=prompt, options={**opts, "num_predict": 8}))
+        tail = eng.generate(GenerationRequest(
+            id="t", prompt_ids=list(head.context),
+            options={**opts, "num_predict": 8}))
+        return head.token_ids + tail.token_ids
+
+    assert split_run(spec_off) == full.token_ids
+    assert split_run(tree_on) == full.token_ids
+
+
+def test_sampled_seeded_deterministic_tree(tree_on):
+    """Sampled tree streams are not byte-equal to spec-off (documented:
+    the DISTRIBUTION is preserved) but must stay deterministic per
+    (seed, prompt)."""
+    opts = {"temperature": 0.9, "seed": 11, "num_predict": 12}
+    r1 = tree_on.generate(GenerationRequest(
+        id="s1", prompt=REP_PROMPT, options=dict(opts)))
+    r2 = tree_on.generate(GenerationRequest(
+        id="s2", prompt=REP_PROMPT, options=dict(opts)))
+    assert r1.token_ids == r2.token_ids
+
+
+def test_num_predict_exact_under_tree(tree_on):
+    res = tree_on.generate(GenerationRequest(
+        id="np", prompt=REP_PROMPT, options={**REP_OPTS, "num_predict": 7}))
+    assert res.eval_count == 7
+    assert res.done_reason == "length"
+
+
+# ---------------------------------------------------------------------------
+# hygiene
+# ---------------------------------------------------------------------------
+
+
+def test_zero_steady_recompiles_with_tree_armed(tree_on):
+    """Varying batch fill, per-slot budgets, and ragged accept depths all
+    run through ONE compiled tree-verify program per topology."""
+    assert tree_on.perf.armed  # fixtures above completed requests
+    before = recompile_totals()["steady"]
+    opts = {"temperature": 0.0, "repeat_penalty": 1.0, "num_predict": 6}
+    done = []
+    for n in (1, 2, 3):
+        for i in range(n):
+            tree_on.submit(GenerationRequest(
+                id=f"fill{n}-{i}", prompt=REP_PROMPT if i % 2 else "hello",
+                options=dict(opts),
+                on_chunk=lambda d, fin, res: fin and done.append(res)))
+        target = sum((1, 2, 3)[: (1, 2, 3).index(n) + 1])
+        while len(done) < target:
+            tree_on.step()
+    assert recompile_totals()["steady"] == before
+
+
+def test_tree_stats_flow_to_batch_state(tree_on):
+    tree_on.generate(GenerationRequest(
+        id="st", prompt=REP_PROMPT, options=dict(REP_OPTS)))
+    state = tree_on.batch_state()["specDecode"]
+    assert state["drafter"] == "model"
+    assert state["treeWidth"] == 2
+    assert state["steps"] > 0
+    assert state["draft_ns"] > 0
+    assert state["emitted"] >= state["accepted"]
