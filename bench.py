@@ -314,6 +314,7 @@ async def _run_bench_inner(client_ctx, engine, model, n_requests, n_tokens,
     slo_attainment = None
     goodput_tok_s = None
     capacity = None
+    fleet_health = None
     if scheduler is not None:
         # worker-side spans publish on trace:{id} AFTER job:result resolves
         # the HTTP stream — drain the bus so the tail requests' prefill/
@@ -339,6 +340,13 @@ async def _run_bench_inner(client_ctx, engine, model, n_requests, n_tokens,
             "snapshot": scheduler.capacity.snapshot(),
             "usage_tokens": scheduler.usage.token_totals(),
         }
+        # fleet health (ISSUE 19): canary probe summary + per-state worker
+        # counts — on a healthy single-worker bench this gates to zero
+        # quarantines and (when probing is enabled) a 1.0 pass rate
+        fleet_health = {
+            "canary": scheduler.prober.summary(),
+            "worker_states": scheduler.health.counts(),
+        }
     p95 = _p95(ttfts)
     return {
         "tok_s": tokens_out[0] / wall,
@@ -352,6 +360,7 @@ async def _run_bench_inner(client_ctx, engine, model, n_requests, n_tokens,
         "slo_attainment": slo_attainment,
         "goodput_tok_s": goodput_tok_s,
         "capacity": capacity,
+        "fleet_health": fleet_health,
         "perf": _perf_sidecar(),
         "weights": "real-checkpoint" if ckpt else "random-weights synthetic",
     }
@@ -2048,6 +2057,10 @@ def main() -> int:
             # (ISSUE 16) — the capacity-smoke CI gate asserts the bench
             # traffic was attributed and the demand tracker saw it
             payload["capacity"] = r["capacity"]
+        if r.get("fleet_health") is not None:
+            # canary probe summary + worker health-state counts (ISSUE
+            # 19) — a healthy bench run records zero quarantines
+            payload["fleet_health"] = r["fleet_health"]
     else:
         payload["texts"] = r["texts"]
     if fallback:

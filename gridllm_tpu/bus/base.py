@@ -134,12 +134,13 @@ register_channel(
     keys=("type", "job", "jobId", "reason", "xfer", "fromWorker", "header"),
     durable=True,
     publishers=("gridllm_tpu/scheduler/scheduler.py",
-                "gridllm_tpu/transfer/migrate.py"),
+                "gridllm_tpu/transfer/migrate.py",
+                "gridllm_tpu/obs/health.py"),
     subscribers=("gridllm_tpu/worker/service.py",),
     helper="worker_job_channel",
     description="Per-worker control: job_assignment/job_cancellation/"
-                "job_preempt/kv_import/kv_release messages, demuxed by "
-                "the 'type' key.")
+                "job_preempt/kv_import/kv_release/drain messages, "
+                "demuxed by the 'type' key.")
 register_channel(
     "worker:reregister", pattern="worker:reregister:{worker_id}",
     payload="keys", keys=("type", "timestamp"),
@@ -357,6 +358,19 @@ register_channel(
                 "per live member, keyed by member identity. Durable so a "
                 "reply published while the requester's subscriber is "
                 "still settling replays instead of vanishing.")
+register_channel(
+    "health:state", pattern="health:state", payload="keys",
+    keys=("worker", "state", "reason", "member", "ts"), durable=True,
+    publishers=("gridllm_tpu/obs/health.py",),
+    subscribers=("gridllm_tpu/scheduler/registry.py",),
+    helper="CH_HEALTH_STATE",
+    description="Worker health-state transitions (ISSUE 19): the shard's "
+                "health monitor announces online/degraded/quarantined/"
+                "probation verdicts; every registry (shards AND observer "
+                "replicas) applies them to its worker table so placement "
+                "and /health/workers agree fleet-wide. Durable: a missed "
+                "quarantine verdict would leave a replica routing at a "
+                "bad worker.")
 
 
 # -- registry constants & helpers (the only sanctioned channel spellings) ----
@@ -378,6 +392,7 @@ CH_CTRL_CANCEL = "ctrl:cancel"
 CH_CTRL_STATUS = "ctrl:status"
 CH_OBS_EVENT = "obs:event"
 CH_OBS_DUMP = "obs:dump"
+CH_HEALTH_STATE = "health:state"
 
 
 def worker_job_channel(worker_id: str) -> str:

@@ -92,6 +92,15 @@ class StatusPublisher:
             "usage": (sched.usage.snapshot()
                       if getattr(sched, "usage", None) is not None
                       else None),
+            # active fleet health (ISSUE 19): this member's worker health
+            # verdicts + canary summary — shards carry the authoritative
+            # view (their monitors issue the verdicts)
+            "health": (sched.health.snapshot()
+                       if getattr(sched, "health", None) is not None
+                       else None),
+            "canary": (sched.prober.summary()
+                       if getattr(sched, "prober", None) is not None
+                       else None),
             "queued": len(sched.job_queue),
             "active": len(sched.active_jobs),
             "hangs": len(sched.watchdog.hangs),
@@ -293,6 +302,17 @@ class FleetView:
         into one unlabeled number."""
         return {
             member: {"role": env.get("role"), "slo": env.get("slo")}
+            for member, env in self._live_members().items()}
+
+    def merged_health(self) -> dict[str, Any]:
+        """Fleet health (ISSUE 19): every member's worker-health verdicts
+        and canary summary, keyed by member id with its role — verdicts
+        from different monitors are presented side by side, never merged
+        into one unlabeled state."""
+        return {
+            member: {"role": env.get("role"),
+                     "health": env.get("health"),
+                     "canary": env.get("canary")}
             for member, env in self._live_members().items()}
 
     def merged_capacity(self) -> dict[str, Any]:

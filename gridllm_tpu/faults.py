@@ -37,6 +37,11 @@ parse instead of silently injecting nothing:
                       the torn reply stream, never resync into it)
     broker.fsync      gridbus AOF fsync stalls, freezing the broker event
                       loop the way a saturated disk does
+    probe.issue       raise from the canary prober before a probe is
+                      submitted (the round is counted as an error, never
+                      a golden-hash verdict)
+    health.baseline   drop one baseline observation before it reaches the
+                      EWMA detector (a deaf detector round)
 
 The hot-path cost with no spec configured is one module-global boolean
 check. Tests drive the layer through :func:`configure` directly; the env
@@ -64,6 +69,8 @@ SITES = (
     "broker.accept",
     "broker.reply",
     "broker.fsync",
+    "probe.issue",
+    "health.baseline",
 )
 
 _INJECTED = default_registry().counter(

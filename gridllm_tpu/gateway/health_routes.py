@@ -72,6 +72,7 @@ def build_routes(bus: MessageBus, registry: WorkerRegistry,
             detail.append({
                 "workerId": w.workerId,
                 "status": w.status,
+                "healthState": w.healthState,
                 "role": w.role,
                 "decodeSlotsFree": w.decodeSlotsFree,
                 "currentJobs": w.currentJobs,

@@ -266,6 +266,24 @@ def build_routes(scheduler: JobScheduler,
             snap["fleet"] = fleet.merged_capacity()
         return web.json_response(snap)
 
+    async def health_fleet(request: web.Request) -> web.Response:
+        # active fleet health (ISSUE 19): this member's worker health
+        # verdicts + canary prober summary, plus — on scaled control
+        # planes — every member's view keyed by identity, so any replica
+        # answers "which workers are degraded/quarantined and why"
+        snap = {
+            "shard": scheduler.identity(),
+            "health": (scheduler.health.snapshot()
+                       if getattr(scheduler, "health", None) is not None
+                       else None),
+            "canary": (scheduler.prober.summary()
+                       if getattr(scheduler, "prober", None) is not None
+                       else None),
+        }
+        if fleet is not None:
+            snap["fleet"] = fleet.merged_health()
+        return web.json_response(snap)
+
     async def dump(request: web.Request) -> web.Response:
         artifact = build_dump(scheduler, reason="on_demand")
         if fleet is not None:
@@ -299,6 +317,7 @@ def build_routes(scheduler: JobScheduler,
         web.get("/admin/incidents", incident_reports),
         web.get("/admin/slo", slo),
         web.get("/admin/capacity", capacity),
+        web.get("/admin/health/fleet", health_fleet),
         web.get("/admin/dump", dump),
         web.get("/admin/memory", memory),
         web.post("/admin/profile", profile),

@@ -12,6 +12,10 @@ Fleet economics (ISSUE 16): usage.py (per-tenant/per-model cost
 attribution with an exactly-once engine/shard conservation ledger),
 capacity.py (per-model demand rates, headroom, and autoscaling hints
 behind /admin/capacity).
+Active fleet health (ISSUE 19): probe.py (golden-hash canary prober),
+health.py (per-worker EWMA+z-score regression baselines driving the
+degraded/quarantined/probation state machine behind
+/admin/health/fleet).
 
 Pure stdlib — no prometheus_client, no OpenTelemetry; perf.py imports
 jax lazily so control-plane processes stay light.
@@ -30,6 +34,7 @@ from gridllm_tpu.obs.flightrec import (
     unregister_engine_probe,
 )
 from gridllm_tpu.obs.forensics import TRIGGERS, IncidentCollector
+from gridllm_tpu.obs.health import HEALTH_STATES, STATE_CODES, HealthMonitor
 from gridllm_tpu.obs.metrics import (
     LATENCY_BUCKETS,
     PROMETHEUS_CONTENT_TYPE,
@@ -51,6 +56,7 @@ from gridllm_tpu.obs.perf import (
     register_memory_probe,
     unregister_memory_probe,
 )
+from gridllm_tpu.obs.probe import CanaryProber
 from gridllm_tpu.obs.slo import SLOEngine, classify_request
 from gridllm_tpu.obs.timeline import (
     CRITICAL_PATH_SEGMENTS,
@@ -80,6 +86,7 @@ from gridllm_tpu.obs.tracer import (
     trace_pattern,
 )
 from gridllm_tpu.obs.usage import (
+    CANARY_TENANT,
     TenantLRU,
     UsageAccountant,
     account_engine_usage,
@@ -89,14 +96,18 @@ from gridllm_tpu.obs.usage import (
 from gridllm_tpu.obs.watchdog import HangWatchdog
 
 __all__ = [
+    "CANARY_TENANT",
     "CRITICAL_PATH_SEGMENTS",
     "EDGE_FAMILIES",
     "EVENTS",
+    "HEALTH_STATES",
     "HLC",
     "LATENCY_BUCKETS",
     "PROMETHEUS_CONTENT_TYPE",
     "SIZE_BUCKETS",
+    "STATE_CODES",
     "TRIGGERS",
+    "CanaryProber",
     "CaptureBusy",
     "Counter",
     "DemandTracker",
@@ -105,6 +116,7 @@ __all__ = [
     "Gauge",
     "HLCStamp",
     "HangWatchdog",
+    "HealthMonitor",
     "Histogram",
     "IncidentCollector",
     "MetricsRegistry",

@@ -30,6 +30,10 @@ TRIGGERS: dict[str, str] = {
     "bus.failover": "broker_failover",
     "scheduler.migration_lost": "migration_lost",
     "scheduler.preempted": "preemption",
+    # active fleet health (ISSUE 19): a quarantine verdict or a canary
+    # golden-hash mismatch names the worker in its incident key
+    "health.quarantined": "worker_quarantined",
+    "probe.golden_drift": "canary_drift",
 }
 
 
@@ -48,7 +52,8 @@ class IncidentCollector:
             "gridllm_incidents_total",
             "Auto-assembled incident reports opened by the forensics "
             "collector, by kind (watchdog_hang/shard_lease_lost/"
-            "broker_failover/migration_lost/preemption).",
+            "broker_failover/migration_lost/preemption/"
+            "worker_quarantined/canary_drift).",
             ("kind",),
         )
         store.add_listener(self._on_event)
@@ -59,6 +64,7 @@ class IncidentCollector:
             return
         wall_ms = stamp_key(ev)[0]
         key = (ev.get("requestId")
+               or (ev.get("fields") or {}).get("worker")
                or (ev.get("fields") or {}).get("shard")
                or (ev.get("fields") or {}).get("endpoint") or "")
         # debounce: one report per (kind, subject) per window — a retry

@@ -247,12 +247,25 @@ register_event("engine.verify_tree",
                modules=("gridllm_tpu/engine/engine.py",))
 register_event("gateway.server_error", keys=("method", "route", "status"),
                modules=("gridllm_tpu/gateway/obs_routes.py",))
+register_event("health.degraded", keys=("reason", "worker"),
+               modules=("gridllm_tpu/obs/health.py",))
+register_event("health.probation", keys=("reason", "worker"),
+               modules=("gridllm_tpu/obs/health.py",))
+register_event("health.quarantined", keys=("reason", "worker"),
+               modules=("gridllm_tpu/obs/health.py",))
+register_event("health.recovered", keys=("reason", "worker"),
+               modules=("gridllm_tpu/obs/health.py",))
 register_event("gateway.submitted", keys=("model",),
                modules=("gridllm_tpu/controlplane/client.py",))
 register_event("numcheck.nonfinite", keys=("op",),
                modules=("gridllm_tpu/analysis/numcheck.py",), open_keys=True)
 register_event("numcheck.tolerance", keys=("op",),
                modules=("gridllm_tpu/analysis/numcheck.py",), open_keys=True)
+register_event("probe.golden_drift",
+               keys=("expected", "got", "model", "worker"),
+               modules=("gridllm_tpu/obs/probe.py",))
+register_event("probe.golden_sealed", keys=("hash", "model", "worker"),
+               modules=("gridllm_tpu/obs/probe.py",))
 register_event("registry.liveness_resumed", keys=("workers",),
                modules=("gridllm_tpu/scheduler/registry.py",))
 register_event("registry.liveness_suspended", keys=("workers",),

@@ -34,6 +34,11 @@ from .metrics import MetricsRegistry, default_registry
 
 ANONYMOUS_TENANT = "anonymous"
 OVERFLOW_TENANT = "other"
+# Reserved tenant for canary probes (ISSUE 19): synthetic health traffic
+# is excluded from BOTH halves of the conservation ledger (worker skips
+# account_engine_usage, the shard's account() early-returns) and from SLO
+# attainment — billing and burn rates only ever describe real demand.
+CANARY_TENANT = "canary"
 
 _TENANT_RE = re.compile(r"[^a-zA-Z0-9_.:-]+")
 
@@ -142,6 +147,8 @@ def account_engine_usage(usage: Mapping[str, Any]) -> None:
     ledger.  Call ONLY after the result publishes succeeded — an
     unpublished execution (killed worker) must stay invisible on both
     sides of the conservation invariant."""
+    if str(usage.get("tenant") or "") == CANARY_TENANT:
+        return  # canary probes stay invisible on BOTH ledger halves
     model = str(usage.get("model") or "unknown")
     for key, kind in TOKEN_KINDS.items():
         n = int(usage.get(key) or 0)
@@ -209,6 +216,8 @@ class UsageAccountant:
         land somewhere."""
         if not usage:
             return
+        if str(usage.get("tenant") or "") == CANARY_TENANT:
+            return  # mirrors the engine half's exclusion exactly
         tenant = self.lru.label(str(usage.get("tenant") or ANONYMOUS_TENANT))
         model = str(usage.get("model") or "unknown")
         self.requests.inc(1, tenant=tenant, model=model, outcome=outcome)
@@ -227,6 +236,8 @@ class UsageAccountant:
     def note_outcome(self, tenant: str, model: str, outcome: str) -> None:
         """Record a terminal outcome that carries no usage payload
         (failures, sheds) so demand by tenant stays visible."""
+        if (tenant or "") == CANARY_TENANT:
+            return
         t = self.lru.label(tenant or ANONYMOUS_TENANT)
         self.requests.inc(1, tenant=t, model=model or "unknown", outcome=outcome)
 

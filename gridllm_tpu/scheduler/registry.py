@@ -30,6 +30,7 @@ import json
 import time
 
 from gridllm_tpu.bus.base import (
+    CH_HEALTH_STATE,
     CH_WORKER_DISCONNECTED,
     CH_WORKER_HEARTBEAT,
     CH_WORKER_REGISTERED,
@@ -124,6 +125,7 @@ class WorkerRegistry(EventEmitter):
             (CH_WORKER_HEARTBEAT, self._on_heartbeat),
             (CH_WORKER_STATUS_UPDATE, self._on_status_update),
             (CH_WORKER_DISCONNECTED, self._on_disconnected),
+            (CH_HEALTH_STATE, self._on_health_state),
         ]:
             self._subs.append(await self.bus.subscribe(channel, handler))
         await self._load_existing_workers()
@@ -177,6 +179,13 @@ class WorkerRegistry(EventEmitter):
             return
         is_new = info.workerId not in self.workers
         info.lastHeartbeat = time.time()
+        prev = self.workers.get(info.workerId)
+        if prev is not None:
+            # a re-registration must not silently clear a health verdict
+            # (ISSUE 19): the health monitor alone moves a quarantined
+            # worker to probation (its worker_registered hook), and the
+            # verdict replicates to observers over health:state
+            info.healthState = prev.healthState
         self.workers[info.workerId] = info
         await self.bus.hset(WORKERS_KEY, info.workerId, info.model_dump_json())
         log.worker("worker registered", info.workerId,
@@ -288,6 +297,31 @@ class WorkerRegistry(EventEmitter):
         await self.bus.hset(WORKERS_KEY, worker_id, info.model_dump_json())
         if old != info.status:
             self.emit("worker_status_changed", worker_id, old, info.status)
+
+    async def _on_health_state(self, _ch: str, raw: str) -> None:
+        """Apply a health-monitor verdict broadcast on ``health:state``
+        (ISSUE 19) — shards and observer replicas alike, so placement
+        and /health/workers agree fleet-wide. The emitting shard already
+        applied it locally; re-applying is idempotent."""
+        try:
+            data = json.loads(raw)
+            worker_id = str(data["worker"])
+            state = str(data["state"])
+        except Exception:
+            return
+        self.apply_health_state(worker_id, state)
+
+    def apply_health_state(self, worker_id: str, state: str) -> None:
+        if state not in ("online", "degraded", "quarantined", "probation"):
+            return
+        info = self.workers.get(worker_id)
+        if info is None or info.healthState == state:
+            return
+        old = info.healthState
+        info.healthState = state
+        log.worker("worker health state applied", worker_id,
+                   old=old, new=state)
+        self.emit("worker_health_changed", worker_id, old, state)
 
     async def _on_disconnected(self, _ch: str, raw: str) -> None:
         """Fast eviction path: the worker's own socket-close handler publishes
@@ -453,6 +487,11 @@ class WorkerRegistry(EventEmitter):
             w for w in self.workers.values()
             if w.status == "online"
             and w.currentJobs < max(w.capabilities.maxConcurrentTasks, 1)
+            # quarantined workers (ISSUE 19) are routed around even
+            # while their own status still says online — the health
+            # verdict outranks the worker's word; degraded/probation
+            # stay placeable (scored down in _select_worker)
+            and w.healthState != "quarantined"
         ]
 
     def get_available_workers_by_model(self, model: str) -> list[WorkerInfo]:

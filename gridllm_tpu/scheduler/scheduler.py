@@ -55,8 +55,11 @@ from gridllm_tpu.bus.base import (
     worker_job_channel,
 )
 from gridllm_tpu.obs import (
+    CANARY_TENANT,
+    CanaryProber,
     DemandTracker,
     HangWatchdog,
+    HealthMonitor,
     MetricsRegistry,
     SLOEngine,
     Tracer,
@@ -68,7 +71,12 @@ from gridllm_tpu.obs import (
 from gridllm_tpu.obs.timeline import CRITICAL_PATH_SEGMENTS, critical_path
 from gridllm_tpu.obs.tracer import TRACE_CHANNEL_PREFIX, trace_pattern
 from gridllm_tpu.scheduler.registry import WorkerRegistry
-from gridllm_tpu.utils.config import SchedulerConfig, SLOConfig, WatchdogConfig
+from gridllm_tpu.utils.config import (
+    SchedulerConfig,
+    SLOConfig,
+    WatchdogConfig,
+    env_float,
+)
 from gridllm_tpu.utils.events import EventEmitter
 from gridllm_tpu.utils.logging import bind_request_id, get_logger
 from gridllm_tpu.utils.types import (
@@ -292,6 +300,16 @@ class JobScheduler(EventEmitter):
             worker_capacity=lambda: aggregate_worker_capacity(
                 self.registry.get_online_workers()),
         )
+        # active fleet health (ISSUE 19): per-worker regression baselines
+        # driving the online/degraded/quarantined/probation state machine,
+        # and the canary prober that feeds it golden-hash verdicts. The
+        # prober is armed only when GRIDLLM_PROBE_INTERVAL_MS > 0.
+        self.health = HealthMonitor(
+            self.bus, self.registry, self.metrics,
+            member=lambda: str(self.identity().get("member") or ""))
+        self.prober = CanaryProber(self, self.registry, self.health,
+                                   self.metrics)
+        self._health_penalty = env_float("GRIDLLM_HEALTH_DEGRADED_PENALTY")
         # jobId → (first stream frame ts, last stream frame ts): the only
         # pre-completion sign of life a worker gives the gateway; feeds
         # the watchdog's decode-stall detection
@@ -332,11 +350,27 @@ class JobScheduler(EventEmitter):
         self.registry.on("worker_registered", lambda *_: self.request_dispatch())
         self.registry.on("worker_status_changed", lambda *_: self.request_dispatch())
         self.registry.on("worker_removed", self._on_worker_removed)
+        # active fleet health (ISSUE 19): registry signals feed the
+        # baselines (heartbeat jitter is measured receiver-side from
+        # arrival times); a re-registration is a quarantined worker's
+        # only road back (→ probation). The prober no-ops unless armed.
+        self.registry.on(
+            "worker_heartbeat",
+            lambda wid, *_: self.health.note_heartbeat(wid))
+        self.registry.on(
+            "worker_registered",
+            lambda info, *_: self.health.note_registered(
+                info.workerId, getattr(info, "status", "online") or "online"))
+        self.registry.on(
+            "worker_health_changed",
+            lambda *_: self.request_dispatch())
+        self.prober.start()
         log.info("job scheduler initialized",
                  queued=len(self.job_queue), active=len(self.active_jobs))
 
     async def shutdown(self) -> None:
         self._running = False
+        await self.prober.stop()
         await self.watchdog.stop()
         if self._sweep_task:
             self._sweep_task.cancel()
@@ -680,9 +714,12 @@ class JobScheduler(EventEmitter):
                     return result
                 except asyncio.TimeoutError:
                     outcome = "timeout"
-                    self.slo.record(slo_class, ok=False,
-                                    e2e_s=timeout_ms / 1000,
-                                    model=request.model)
+                    if str(md.get("tenant") or "") != CANARY_TENANT:
+                        # a timed-out canary is the prober's verdict to
+                        # record, not an SLO miss (ISSUE 19)
+                        self.slo.record(slo_class, ok=False,
+                                        e2e_s=timeout_ms / 1000,
+                                        model=request.model)
                     # end the root BEFORE cancel_job's tracer.abort seals
                     # the timeline, so the outcome lands on the span
                     self.tracer.end(root, outcome=outcome)
@@ -713,6 +750,15 @@ class JobScheduler(EventEmitter):
             tokens = int(resp.eval_count or 0)
             if tokens > 1 and resp.eval_duration:
                 itl_s = (resp.eval_duration / 1e9) / (tokens - 1)
+        # health baselines (ISSUE 19): engine-measured decode cadence
+        # feeds the serving worker's ITL baseline — canaries included
+        # (they exercise the same decode path)
+        if itl_s is not None and result.workerId:
+            self.health.note_itl(result.workerId, itl_s)
+        if str((request.metadata or {}).get("tenant") or "") == CANARY_TENANT:
+            # canary traffic is a measurement instrument, not served
+            # demand: it must never move SLO attainment (ISSUE 19)
+            return
         self.slo.record(
             slo_class, ok=result.success,
             ttft_s=(ttft_ref[0] if ttft_ref else None),
@@ -1017,6 +1063,19 @@ class JobScheduler(EventEmitter):
         md = request.metadata or {}
         md.pop("disagg", None)       # requeue hygiene: stale plans never
         md.pop("disaggPhase", None)  # survive a fresh placement pass
+        # pinned placement (ISSUE 19): a canary probe measures ONE worker —
+        # rerouting it elsewhere would grade the wrong machine, so a pin
+        # either lands on its target or waits (and times out as a failed
+        # probe, which is itself the verdict)
+        pin = md.get("pinWorkerId")
+        if pin:
+            w = self.registry.get_worker(str(pin))
+            if (w is not None and w.status == "online"
+                    and request.model in w.model_names()
+                    and w.currentJobs < max(
+                        w.capabilities.maxConcurrentTasks, 1)):
+                return w, None
+            return None, None
         # same image collection the worker's collect_images() applies:
         # top-level (generate path) AND per-message (chat path) — a
         # vision request can never migrate, so it must not be planned
@@ -1068,6 +1127,15 @@ class JobScheduler(EventEmitter):
         4. performance tier.
         """
         candidates = self.registry.get_available_workers_by_model(request.model)
+        # health gating (ISSUE 19): quarantined workers never serve (the
+        # registry already drops them from availability; this guards
+        # stale lists); probation workers serve only when nothing
+        # healthier exists — canaries, not tenants, should prove them out
+        candidates = [w for w in candidates
+                      if w.healthState != "quarantined"]
+        non_prob = [w for w in candidates if w.healthState != "probation"]
+        if non_prob:
+            candidates = non_prob
         if role in ("prefill", "decode"):
             candidates = [w for w in candidates if w.role == role]
         else:
@@ -1103,6 +1171,12 @@ class JobScheduler(EventEmitter):
             load = w.currentJobs / max(caps.maxConcurrentTasks, 1)
             if prefix_key and affinity_w and prefix_key in w.cachedPrefixes:
                 load -= affinity_w
+            # health penalty (ISSUE 19): a degraded/probation worker
+            # competes as if it carried extra load — traffic shifts to
+            # healthy peers but the worker stays reachable (mirrors the
+            # prefix-affinity bonus, opposite sign)
+            if w.healthState in ("degraded", "probation"):
+                load += self._health_penalty
             # decode-pool placement prefers the worker with the most open
             # batch slots (heartbeat-advertised headroom, ISSUE 7) — the
             # prefill pool orders purely by queue depth via `load`

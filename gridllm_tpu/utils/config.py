@@ -378,6 +378,43 @@ register_env("GRIDLLM_CAPACITY_EWMA_HALFLIFE_S", "60",
              "Half-life (seconds) of the per-model arrival/service rate "
              "and wait-time EWMAs behind /admin/capacity.")
 
+# observability: active fleet health (ISSUE 19) — canary prober + detector
+register_env("GRIDLLM_PROBE_INTERVAL_MS", "0",
+             "Canary probe cadence per scheduler shard (ms between "
+             "rounds); each round probes one (worker, model) pair "
+             "round-robin. 0 disables the prober.")
+register_env("GRIDLLM_PROBE_CONCURRENCY", "1",
+             "Max canary probes in flight at once per shard (rate bound: "
+             "a slow fleet must never accumulate probe backlog).")
+register_env("GRIDLLM_PROBE_TIMEOUT_MS", "15000",
+             "Per-probe timeout (ms); a timed-out canary counts as a "
+             "failed round for the worker's health verdict.")
+register_env("GRIDLLM_PROBE_TOKENS", "8",
+             "Tokens each canary generates (greedy, fixed seed) — the "
+             "byte-determinism surface the golden hash covers.")
+register_env("GRIDLLM_HEALTH_EWMA_HALFLIFE_S", "60",
+             "Half-life (seconds) of the per-worker baseline EWMAs "
+             "(canary e2e latency, decode ITL, heartbeat gap).")
+register_env("GRIDLLM_HEALTH_Z_THRESHOLD", "3.0",
+             "z-score above which a baseline observation counts as a "
+             "regression strike against its worker.")
+register_env("GRIDLLM_HEALTH_MIN_SAMPLES", "5",
+             "Baseline observations required before z-score judgments "
+             "begin (warmup; earlier observations only train the EWMA).")
+register_env("GRIDLLM_HEALTH_DEGRADE_STRIKES", "2",
+             "Consecutive regression strikes that move an online worker "
+             "to degraded (placement penalty applied).")
+register_env("GRIDLLM_HEALTH_QUARANTINE_STRIKES", "3",
+             "Consecutive strikes while degraded that quarantine the "
+             "worker (drained via the graceful-drain path).")
+register_env("GRIDLLM_HEALTH_PROBATION_PASSES", "2",
+             "Clean canary rounds a probation (or degraded) worker needs "
+             "to rejoin the online pool.")
+register_env("GRIDLLM_HEALTH_DEGRADED_PENALTY", "0.5",
+             "Load-score penalty _select_worker adds to degraded/"
+             "probation workers (same scale as the proportional load "
+             "term; mirrors prefix_affinity_weight).")
+
 # observability: perf introspection
 register_env("GRIDLLM_RECOMPILE_BUDGET", "4",
              "Steady-state recompiles tolerated per window before a "

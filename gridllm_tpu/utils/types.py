@@ -151,6 +151,13 @@ class WorkerInfo(_Model):
     # {model: {"slotsFree", "slotsTotal", "kvPagesFree"}} — the demand
     # tracker behind /admin/capacity aggregates these across workers
     modelCapacity: dict[str, dict[str, int]] = Field(default_factory=dict)
+    # Active fleet health (ISSUE 19): the health monitor's verdict for
+    # this worker, replicated to every registry over health:state.
+    # Distinct from `status` (the worker's OWN word about its lifecycle):
+    # a quarantined worker may still report status=online while the
+    # scheduler routes around it and drains it.
+    healthState: Literal["online", "degraded", "quarantined",
+                         "probation"] = "online"
 
     def model_names(self) -> list[str]:
         return [m.name for m in self.capabilities.availableModels]

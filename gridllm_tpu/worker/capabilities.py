@@ -9,6 +9,8 @@ scheduler's topology-aware routing uses.
 
 from __future__ import annotations
 
+import hashlib
+import json
 import os
 import platform
 
@@ -134,6 +136,19 @@ def gather_capabilities(
                     else str(getattr(c, "dtype", "bfloat16")).upper()
                 ),
                 "vision": bool(getattr(mc, "vision", False)),
+                # active fleet health (ISSUE 19): the canary prober keys
+                # its golden output hash on (model, engineConfigHash) —
+                # two workers share a golden ONLY when every knob that
+                # can legitimately change sampled bytes matches. A dtype
+                # or quantization drift is then a health incident, not a
+                # new golden.
+                "engineConfigHash": hashlib.sha256(json.dumps({
+                    "model": name,
+                    "family": family,
+                    "dtype": str(getattr(c, "dtype", "bfloat16")),
+                    "quantize": getattr(c, "quantize", None),
+                    "platform": topo.platform,
+                }, sort_keys=True).encode()).hexdigest()[:16],
             }
         models.append(ModelInfo(name=name, model=name, details=details))
         mesh = getattr(eng, "mesh", None)

@@ -111,7 +111,11 @@ def test_durable_classification_matches_legacy_patterns():
                     # replies replay across a subscriber reconnect —
                     # the incident window / dump op must not vanish
                     # into the exact outage it exists to record
-                    "obs:event"}
+                    "obs:event",
+                    # ISSUE 19: health-state verdicts replay across a
+                    # subscriber reconnect — a missed quarantine would
+                    # leave a replica routing at a bad worker
+                    "health:state"}
 
     def legacy(ch: str) -> bool:
         if ch in legacy_fixed or ch.startswith(legacy_prefixes):

@@ -1,0 +1,680 @@
+"""Active fleet health tests (ISSUE 19): canary probes, per-worker
+EWMA+z-score regression baselines, and the automatic-quarantine state
+machine.
+
+Covers the baseline math (decay, judged-before-fold z-scores), the full
+``online → degraded → quarantined → probation`` round trip with its
+metrics and registry replication, the canary golden-hash seal/drift law
+end-to-end over the bus (pinned placement, drain request, forensics
+incident naming the worker), the canary tenant's exclusion from both
+usage-ledger halves and SLO attainment, the two new fault sites
+(``probe.issue``, ``health.baseline``), and THE differentials: a worker
+that silently slows down is detected by its canary-latency baseline and
+quarantined with zero client-visible loss, and (slow, real engines) a
+worker with silently perturbed sampling — same config hash, same
+latency, wrong bytes — drifts against the sealed golden and is
+quarantined after ONE canary while traffic keeps matching the healthy
+reference byte-for-byte."""
+
+import asyncio
+import json
+import os
+import subprocess
+import sys
+import uuid
+from pathlib import Path
+
+import pytest
+
+from gridllm_tpu import faults
+from gridllm_tpu.bus import InMemoryBus
+from gridllm_tpu.obs import MetricsRegistry
+from gridllm_tpu.obs.flightrec import default_flight_recorder
+from gridllm_tpu.obs.forensics import IncidentCollector
+from gridllm_tpu.obs.health import (
+    SIG_ITL,
+    STATE_CODES,
+    HealthMonitor,
+    _Baseline,
+)
+from gridllm_tpu.obs.timeline import TimelinePublisher, TimelineStore, set_emitter
+from gridllm_tpu.obs.usage import (
+    CANARY_TENANT,
+    UsageAccountant,
+    account_engine_usage,
+    build_usage,
+    engine_usage_totals,
+)
+from gridllm_tpu.scheduler import JobScheduler, WorkerRegistry
+from gridllm_tpu.utils.types import InferenceRequest, Priority
+
+from .helpers import FakeWorker, fast_config
+
+DRIFT_CHILD = Path(__file__).with_name("health_drift_child.py")
+
+
+@pytest.fixture(autouse=True)
+def _clean_slate():
+    faults.reset()
+    yield
+    faults.reset()
+    set_emitter(None)
+    default_flight_recorder().set_tap(None)
+
+
+def req(model="m1", **kw) -> InferenceRequest:
+    return InferenceRequest(id=f"job-{uuid.uuid4().hex[:8]}", model=model,
+                            prompt="hi", priority=Priority.medium, **kw)
+
+
+async def make_stack(cfg=None):
+    bus = InMemoryBus(key_prefix="T:")
+    await bus.connect()
+    cfg = cfg or fast_config()
+    registry = WorkerRegistry(bus, cfg)
+    scheduler = JobScheduler(bus, registry, cfg)
+    await registry.initialize()
+    await scheduler.initialize()
+    return bus, registry, scheduler
+
+
+async def settle(bus):
+    """Yield so monitor-spawned tasks (announce/drain) publish, then
+    drain the bus."""
+    await asyncio.sleep(0)
+    await bus.flush()
+
+
+async def teardown(bus, registry, scheduler, *workers):
+    for w in workers:
+        await w.stop(announce=False)
+    await scheduler.shutdown()
+    await registry.shutdown()
+    await bus.disconnect()
+
+
+class _StubRegistry:
+    def __init__(self):
+        self.applied = []
+
+    def apply_health_state(self, worker_id, state):
+        self.applied.append((worker_id, state))
+
+
+class _StubBus:
+    async def publish(self, channel, raw):
+        pass
+
+
+# -- baseline math -----------------------------------------------------------
+
+def test_baseline_ewma_mean_std_and_decay():
+    bl = _Baseline(halflife_s=10.0)
+    t0 = 1000.0
+    for i in range(10):
+        bl.observe(1.0, now=t0 + 0.1 * i)
+    assert abs(bl.mean() - 1.0) < 1e-6
+    assert bl.std() < 1e-3
+    # z is judged against max(std, 10% of mean): a steady baseline cannot
+    # manufacture infinite z from jitter
+    assert 9.5 < bl.zscore(2.0) < 10.5
+    assert abs(bl.zscore(1.0)) < 0.1
+    # 100 half-lives later the old mass is gone: one observation dominates
+    bl.observe(5.0, now=t0 + 1000.0)
+    assert abs(bl.mean() - 5.0) < 1e-3
+
+
+def test_baseline_judged_before_fold(monkeypatch):
+    """A regression cannot mask itself by dragging the mean toward it in
+    the same call: the anomaly is flagged even though the bad sample also
+    folds into the baseline."""
+    monkeypatch.setenv("GRIDLLM_HEALTH_MIN_SAMPLES", "1")
+    monkeypatch.setenv("GRIDLLM_HEALTH_DEGRADE_STRIKES", "1")
+    hm = HealthMonitor(_StubBus(), _StubRegistry(), MetricsRegistry())
+    for _ in range(5):
+        hm.note_itl("w", 0.01)
+    hm.note_itl("w", 10.0)  # flagged out-of-band, folded into next round
+    hm.note_canary("w", ok=True, e2e_s=0.0)
+    assert hm.state_of("w") == "degraded"
+    assert "itl" in hm.snapshot()["workers"]["w"]["reason"]
+    assert SIG_ITL in hm.snapshot()["workers"]["w"]["baselines"]
+
+
+def test_heartbeat_gap_measured_receiver_side(monkeypatch):
+    monkeypatch.setenv("GRIDLLM_HEALTH_MIN_SAMPLES", "3")
+    monkeypatch.setenv("GRIDLLM_HEALTH_DEGRADE_STRIKES", "1")
+    hm = HealthMonitor(_StubBus(), _StubRegistry(), MetricsRegistry())
+    for t in range(6):
+        hm.note_heartbeat("w", now=1000.0 + t)  # steady 1 s cadence
+    hm.note_heartbeat("w", now=1036.0)          # 30 s seizure
+    hm.note_canary("w", ok=True, e2e_s=0.0)
+    assert hm.state_of("w") == "degraded"
+    assert "heartbeat_gap" in hm.snapshot()["workers"]["w"]["reason"]
+
+
+# -- state machine (sync, no loop) -------------------------------------------
+
+def test_state_machine_round_trip(monkeypatch):
+    monkeypatch.setenv("GRIDLLM_HEALTH_DEGRADE_STRIKES", "2")
+    monkeypatch.setenv("GRIDLLM_HEALTH_QUARANTINE_STRIKES", "3")
+    monkeypatch.setenv("GRIDLLM_HEALTH_PROBATION_PASSES", "2")
+    reg = _StubRegistry()
+    mr = MetricsRegistry()
+    hm = HealthMonitor(_StubBus(), reg, mr)
+
+    hm.note_canary("w", ok=False, e2e_s=0.1)
+    assert hm.state_of("w") == "online"  # first strike is not a verdict
+    hm.note_canary("w", ok=False, e2e_s=0.1)
+    assert hm.state_of("w") == "degraded"
+    for _ in range(3):
+        hm.note_canary("w", ok=False, e2e_s=0.1)
+    assert hm.state_of("w") == "quarantined"
+    assert mr.get("gridllm_worker_health_state").value(worker="w") \
+        == STATE_CODES["quarantined"]
+    # the local registry saw every verdict before any bus echo
+    assert reg.applied == [("w", "degraded"), ("w", "quarantined")]
+
+    # clean canaries never resurrect a quarantined worker...
+    hm.note_canary("w", ok=True, e2e_s=0.1)
+    assert hm.state_of("w") == "quarantined"
+    # ...only re-registration does, and only into probation
+    hm.note_registered("w")
+    assert hm.state_of("w") == "probation"
+    hm.note_canary("w", ok=True, e2e_s=0.1)
+    hm.note_canary("w", ok=True, e2e_s=0.1)
+    assert hm.state_of("w") == "online"
+    assert reg.applied[-2:] == [("w", "probation"), ("w", "online")]
+
+    # probation is the last chance: one strike goes straight back
+    hm.note_canary("w", ok=False, e2e_s=0.1)
+    hm.note_canary("w", ok=False, e2e_s=0.1)      # -> degraded
+    for _ in range(3):
+        hm.note_canary("w", ok=False, e2e_s=0.1)  # -> quarantined
+    hm.note_registered("w")                       # -> probation
+    hm.note_canary("w", ok=False, e2e_s=0.1)      # -> quarantined again
+    assert hm.state_of("w") == "quarantined"
+    assert mr.get("gridllm_health_transitions_total").value(
+        state="quarantined") == 3
+    assert hm.counts()["quarantined"] == 1
+
+
+def test_golden_drift_quarantines_from_any_state():
+    reg = _StubRegistry()
+    hm = HealthMonitor(_StubBus(), reg, MetricsRegistry())
+    hm.note_canary("w", ok=True, e2e_s=0.1, drift=True)
+    assert hm.state_of("w") == "quarantined"
+    assert hm.snapshot()["workers"]["w"]["reason"] == "golden_drift"
+    assert reg.applied == [("w", "quarantined")]
+
+
+def test_health_baseline_fault_site_deafens_detector(monkeypatch):
+    monkeypatch.setenv("GRIDLLM_HEALTH_MIN_SAMPLES", "1")
+    monkeypatch.setenv("GRIDLLM_HEALTH_DEGRADE_STRIKES", "1")
+    hm = HealthMonitor(_StubBus(), _StubRegistry(), MetricsRegistry())
+    faults.configure("health.baseline=1.0")
+    for _ in range(5):
+        hm.note_itl("w", 0.01)
+    hm.note_itl("w", 50.0)  # a deaf detector never sees the regression
+    hm.note_canary("w", ok=True, e2e_s=100.0)
+    assert hm.state_of("w") == "online"
+    assert hm.snapshot()["workers"]["w"]["baselines"] == {}
+
+
+# -- canary tenant exclusion (ISSUE 16 conservation) --------------------------
+
+def test_canary_tenant_excluded_from_engine_ledger():
+    before = dict(engine_usage_totals())
+    account_engine_usage(build_usage(
+        tenant=CANARY_TENANT, model="m1", prompt_tokens=11, output_tokens=7))
+    assert dict(engine_usage_totals()) == before
+    # a real tenant still lands, so conservation keeps balancing
+    account_engine_usage(build_usage(
+        tenant="t1", model="m1", prompt_tokens=11, output_tokens=7))
+    after = dict(engine_usage_totals())
+    assert after.get("prompt", 0) - before.get("prompt", 0) == 11
+    assert after.get("output", 0) - before.get("output", 0) == 7
+
+
+def test_canary_tenant_excluded_from_shard_ledger():
+    ua = UsageAccountant(MetricsRegistry(), lru_cap=4)
+    ua.account(build_usage(tenant=CANARY_TENANT, model="m1",
+                           prompt_tokens=5, output_tokens=3), "completed")
+    ua.note_outcome(CANARY_TENANT, "m1", "failed")
+    assert ua.token_totals() == {}
+    assert ua.snapshot() == {"tenants": {}}
+    ua.account(build_usage(tenant="t1", model="m1",
+                           prompt_tokens=5, output_tokens=3), "completed")
+    assert ua.token_totals() == {"prompt": 5.0, "output": 3.0}
+
+
+# -- canary probing over the bus ---------------------------------------------
+
+async def test_probe_issue_fault_is_error_never_a_strike():
+    bus, registry, scheduler = await make_stack()
+    w = FakeWorker(bus, "w1", ["m1"])
+    await w.start()
+    await bus.flush()
+    faults.configure("probe.issue=1.0")
+    assert await scheduler.prober.probe_once(
+        registry.get_worker("w1"), "m1") == "error"
+    assert scheduler.prober.goldens == {}
+    assert scheduler.health.state_of("w1") == "online"
+    faults.reset()
+    assert await scheduler.prober.probe_once(
+        registry.get_worker("w1"), "m1") == "pass"
+    assert len(scheduler.prober.goldens) == 1
+    await teardown(bus, registry, scheduler, w)
+
+
+async def test_probe_timer_loop_seals_and_passes(monkeypatch):
+    monkeypatch.setenv("GRIDLLM_PROBE_INTERVAL_MS", "30")
+    bus, registry, scheduler = await make_stack()
+    assert scheduler.prober.enabled
+    w1 = FakeWorker(bus, "w1", ["m1"])
+    w2 = FakeWorker(bus, "w2", ["m1"])
+    await w1.start()
+    await w2.start()
+    await bus.flush()
+    for _ in range(200):
+        s = scheduler.prober.summary()
+        if s["probes"] >= 3 and s["goldens"] >= 1:
+            break
+        await asyncio.sleep(0.05)
+    s = scheduler.prober.summary()
+    assert s["probes"] >= 3, s
+    assert s["byResult"].get("pass", 0) >= 3, s
+    assert s["passRate"] == 1.0, s
+    # the probes really were pinned canaries, not regular placements
+    served = w1.processed + w2.processed
+    assert served and all(j.startswith("canary-") for j in served)
+    await teardown(bus, registry, scheduler, w1, w2)
+
+
+async def test_golden_drift_quarantines_drains_and_opens_incident():
+    """The acceptance chain on one bus: seal on a healthy worker, drift
+    on a rotted one -> immediate quarantine replicated into the registry,
+    a drain request on the worker's job channel, placement exclusion, SLO
+    attainment untouched, and a forensics incident naming the worker."""
+    bus, registry, scheduler = await make_stack()
+    mr = MetricsRegistry()
+    store = TimelineStore()
+    collector = IncidentCollector(store, member="hq", window_ms=10_000,
+                                  registry=mr)
+    pub = TimelinePublisher("hq", registry=mr)
+    pub.install()
+    await pub.start(bus)
+    await store.attach(bus)
+    wa = FakeWorker(bus, "wA", ["m1"], reply="the golden reply")
+    wb = FakeWorker(bus, "wB", ["m1"], reply="silently rotted bytes")
+    await wa.start()
+    await wb.start()
+    await bus.flush()
+
+    drains = []
+
+    async def on_job(_ch, raw):
+        msg = json.loads(raw)
+        if msg.get("type") == "drain":
+            drains.append(msg)
+
+    await bus.subscribe("worker:wB:job", on_job)
+
+    try:
+        assert await scheduler.prober.probe_once(
+            registry.get_worker("wA"), "m1") == "pass"
+        # pinned placement graded wA specifically
+        assert wa.processed and wa.processed[0].startswith("canary-")
+        assert not wb.processed
+
+        assert await scheduler.prober.probe_once(
+            registry.get_worker("wB"), "m1") == "drift"
+        assert scheduler.health.state_of("wB") == "quarantined"
+        await settle(bus)
+        assert registry.get_worker("wB").healthState == "quarantined"
+        assert "wB" not in [w.workerId
+                            for w in registry.get_available_workers()]
+        assert any(m.get("reason") == "quarantine" for m in drains)
+        # canary traffic moved neither SLO attainment nor the ledger
+        assert scheduler.slo.snapshot()["classes"] == {}
+        assert scheduler.usage.token_totals() == {}
+
+        # real traffic routes around the quarantined worker
+        result = await scheduler.submit_and_wait(req(), timeout_ms=5000)
+        assert result.success and result.workerId == "wA"
+        assert result.response.response == "the golden reply"
+
+        # forensics: both incident kinds name the worker
+        await pub.flush_once()
+        await bus.flush()
+        kinds = {(r["kind"], r["key"]) for r in collector.reports()}
+        assert ("canary_drift", "wB") in kinds
+        assert ("worker_quarantined", "wB") in kinds
+    finally:
+        await pub.stop()
+        await store.detach()
+        await teardown(bus, registry, scheduler, wa, wb)
+
+
+# -- the fast differential: silent slowdown ----------------------------------
+
+async def test_slowed_worker_detected_quarantined_zero_loss(monkeypatch):
+    """A worker that silently slows down (nothing fails, heartbeats keep
+    beating) regresses against its OWN canary-latency baseline, walks
+    online -> degraded -> quarantined, gets a drain request, and every
+    client request before, during, and after detection still succeeds
+    with the expected bytes — zero client-visible loss."""
+    monkeypatch.setenv("GRIDLLM_HEALTH_MIN_SAMPLES", "3")
+    monkeypatch.setenv("GRIDLLM_HEALTH_DEGRADE_STRIKES", "1")
+    monkeypatch.setenv("GRIDLLM_HEALTH_QUARANTINE_STRIKES", "1")
+    monkeypatch.setenv("GRIDLLM_HEALTH_Z_THRESHOLD", "8.0")
+    bus, registry, scheduler = await make_stack()
+    victim = FakeWorker(bus, "wv", ["m1"], delay_s=0.02)
+    peer = FakeWorker(bus, "wp", ["m1"], delay_s=0.02)
+    await victim.start()
+    await peer.start()
+    await bus.flush()
+
+    drains = []
+
+    async def on_job(_ch, raw):
+        if json.loads(raw).get("type") == "drain":
+            drains.append(raw)
+
+    await bus.subscribe("worker:wv:job", on_job)
+
+    try:
+        # train both baselines on healthy latency
+        for _ in range(4):
+            assert await scheduler.prober.probe_once(
+                registry.get_worker("wv"), "m1") == "pass"
+            assert await scheduler.prober.probe_once(
+                registry.get_worker("wp"), "m1") == "pass"
+        assert scheduler.health.state_of("wv") == "online"
+
+        victim.delay_s = 0.5  # the silent regression: 25x slower
+        assert await scheduler.prober.probe_once(
+            registry.get_worker("wv"), "m1") == "pass"  # bytes still right
+        assert scheduler.health.state_of("wv") == "degraded"
+        # degraded workers stay in rotation (penalized, not excluded)
+        assert "wv" in [w.workerId
+                        for w in registry.get_available_workers()]
+        # the EWMA folded the first bad round in (it adapts to honest
+        # drift); only a STILL-worsening worker keeps striking
+        victim.delay_s = 2.5
+        assert await scheduler.prober.probe_once(
+            registry.get_worker("wv"), "m1") == "pass"
+        assert scheduler.health.state_of("wv") == "quarantined"
+        await settle(bus)
+        assert registry.get_worker("wv").healthState == "quarantined"
+        assert "wv" not in [w.workerId
+                            for w in registry.get_available_workers()]
+        assert drains, "quarantine never requested a drain"
+
+        # zero loss: concurrent real traffic all resolves with the right
+        # bytes, served by the healthy peer
+        results = await asyncio.gather(
+            *[scheduler.submit_and_wait(req(), timeout_ms=8000)
+              for _ in range(4)])
+        assert all(r.success for r in results)
+        assert all(r.response.response == "canned response" for r in results)
+        assert all(r.workerId == "wp" for r in results)
+        assert not [j for j in victim.processed if j.startswith("job-")]
+
+        m = scheduler.metrics
+        assert m.get("gridllm_worker_health_state").value(worker="wv") == 3
+        assert m.get("gridllm_health_transitions_total").value(
+            state="quarantined") >= 1
+    finally:
+        await teardown(bus, registry, scheduler, victim, peer)
+
+
+# -- probation re-entry + placement preference --------------------------------
+
+async def test_probation_reentry_preference_and_readmission(monkeypatch):
+    monkeypatch.setenv("GRIDLLM_HEALTH_DEGRADE_STRIKES", "1")
+    monkeypatch.setenv("GRIDLLM_HEALTH_QUARANTINE_STRIKES", "1")
+    monkeypatch.setenv("GRIDLLM_HEALTH_PROBATION_PASSES", "2")
+    bus, registry, scheduler = await make_stack()
+    wa = FakeWorker(bus, "wA", ["m1"])
+    wb = FakeWorker(bus, "wB", ["m1"])
+    await wa.start()
+    await wb.start()
+    await bus.flush()
+    try:
+        scheduler.health.note_canary("wB", ok=False, e2e_s=0.1)
+        scheduler.health.note_canary("wB", ok=False, e2e_s=0.1)
+        assert scheduler.health.state_of("wB") == "quarantined"
+        await settle(bus)
+        assert registry.get_worker("wB").healthState == "quarantined"
+
+        # operator restarts the worker: re-registration is the ONLY exit,
+        # and it lands in probation — the verdict survives the re-register
+        await wb.register()
+        await bus.flush()
+        assert scheduler.health.state_of("wB") == "probation"
+        assert registry.get_worker("wB").healthState == "probation"
+
+        # probation workers dodge placement while alternatives exist
+        for _ in range(3):
+            r = await scheduler.submit_and_wait(req(), timeout_ms=5000)
+            assert r.success and r.workerId == "wA"
+
+        # clean canaries keep flowing to probation workers and readmit
+        assert await scheduler.prober.probe_once(
+            registry.get_worker("wB"), "m1") == "pass"
+        assert await scheduler.prober.probe_once(
+            registry.get_worker("wB"), "m1") == "pass"
+        assert scheduler.health.state_of("wB") == "online"
+        await settle(bus)
+        assert registry.get_worker("wB").healthState == "online"
+    finally:
+        await teardown(bus, registry, scheduler, wa, wb)
+
+
+# -- surfaces: admin endpoint + fleet view ------------------------------------
+
+async def test_admin_health_fleet_endpoint():
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from gridllm_tpu.gateway.app import create_app
+    from gridllm_tpu.utils.config import Config
+
+    bus, registry, scheduler = await make_stack()
+    config = Config()
+    config.scheduler = fast_config()
+    app = create_app(bus, registry, scheduler, config)
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    w = FakeWorker(bus, "w1", ["m1"])
+    await w.start()
+    await bus.flush()
+    try:
+        assert await scheduler.prober.probe_once(
+            registry.get_worker("w1"), "m1") == "pass"
+        body = await (await client.get("/admin/health/fleet")).json()
+        assert body["health"]["workers"]["w1"]["state"] == "online"
+        assert body["health"]["counts"]["online"] == 1
+        assert body["canary"]["probes"] >= 1
+        assert body["canary"]["goldens"] == 1
+        # /health/workers carries the verdict per worker too
+        workers = await (await client.get("/health/workers")).json()
+        assert workers["workers"][0]["healthState"] == "online"
+    finally:
+        await client.close()
+        await teardown(bus, registry, scheduler, w)
+
+
+async def test_fleet_view_merges_health():
+    from gridllm_tpu.controlplane.status import FleetView, StatusPublisher
+
+    from .test_controlplane import make_fleet, stop_fleet
+
+    bus = InMemoryBus(key_prefix="T:")
+    await bus.connect()
+    shards, gws = await make_fleet(bus)
+    view = FleetView(bus, gws[0].metrics, stale_after_ms=5000)
+    await view.start()
+    try:
+        shards[0].scheduler.health.note_canary("wQ", ok=True, e2e_s=0.01)
+        pubs = [StatusPublisher(bus, sh.scheduler, "shard", sh.member_id,
+                                100, lease=sh.lease) for sh in shards]
+        for p in pubs:
+            await p.publish_once()
+        await bus.flush()
+        merged = view.merged_health()
+        assert merged["shard-0"]["health"]["workers"]["wQ"]["state"] \
+            == "online"
+        assert merged["shard-0"]["canary"]["enabled"] is False
+        assert "shard-1" in merged
+    finally:
+        await view.stop()
+        await stop_fleet(shards, gws)
+        await bus.disconnect()
+
+
+# -- the slow differential: real engines, silent sampler rot ------------------
+
+@pytest.mark.slow
+async def test_sampler_rot_drifts_golden_and_quarantines(monkeypatch):
+    """Chaos differential with REAL engines over a REAL broker: a child
+    worker whose sampler is silently perturbed (same engineConfigHash,
+    same latency, wrong bytes) registers next to a healthy in-process
+    peer. The peer seals the golden; the rotted worker's FIRST canary
+    drifts -> immediate quarantine, drain request, and the verdict
+    survives the worker's own drain re-register. Client traffic keeps
+    matching the healthy reference byte-for-byte — zero token loss."""
+    from gridllm_tpu.bus import create_bus
+    from gridllm_tpu.bus.broker import GridBusBroker
+    from gridllm_tpu.engine import EngineConfig, InferenceEngine
+    from gridllm_tpu.utils.config import SchedulerConfig, WorkerConfig
+    from gridllm_tpu.worker.service import WorkerService
+
+    # the victim's first canary pays its first-compile cost
+    monkeypatch.setenv("GRIDLLM_PROBE_TIMEOUT_MS", "180000")
+
+    broker = GridBusBroker()
+    await broker.start(port=0)
+    url = f"resp://127.0.0.1:{broker.port}"
+    bus = create_bus(url)
+    await bus.connect()
+    cfg = SchedulerConfig(
+        worker_heartbeat_timeout_ms=600,
+        worker_cleanup_interval_ms=100,
+        connection_monitor_interval_ms=100,
+        quick_disconnect_window_ms=400,
+        orphan_assign_threshold_ms=200,
+        job_timeout_ms=180_000,
+        retry_attempts=2,
+        retry_delay_ms=50,
+        sweep_interval_ms=100,
+    )
+    registry = WorkerRegistry(bus, cfg)
+    scheduler = JobScheduler(bus, registry, cfg)
+    await registry.initialize()
+    await scheduler.initialize()
+
+    mr = MetricsRegistry()
+    store = TimelineStore()
+    collector = IncidentCollector(store, member="hq", window_ms=30_000,
+                                  registry=mr)
+    pub = TimelinePublisher("hq", registry=mr)
+    pub.install()
+    await pub.start(bus)
+    await store.attach(bus)
+
+    def gen_req(rid: str) -> InferenceRequest:
+        return InferenceRequest(
+            id=rid, model="tiny-llama", prompt="fleet health reference",
+            options={"temperature": 0, "num_predict": 8, "seed": 3},
+            priority=Priority.medium)
+
+    env = {**os.environ, "PYTHONPATH": str(DRIFT_CHILD.parent.parent)}
+    env.pop("XLA_FLAGS", None)
+    child = None
+    peer = WorkerService(
+        bus, {"tiny-llama": InferenceEngine(EngineConfig(
+            model="tiny-llama", max_slots=2, page_size=8, num_pages=32,
+            max_pages_per_slot=4, prefill_buckets=(16, 32),
+        ))},
+        WorkerConfig(worker_id="health-peer", heartbeat_interval_ms=150,
+                     resource_monitor_interval_ms=500),
+        stream_flush_ms=5,
+    )
+    try:
+        await peer.start()
+        for _ in range(200):
+            if registry.get_workers_with_model("tiny-llama"):
+                break
+            await asyncio.sleep(0.1)
+
+        # healthy reference bytes + golden seal, both on the peer
+        ref = await scheduler.submit_and_wait(
+            gen_req(f"job-{uuid.uuid4().hex[:8]}"), timeout_ms=180_000)
+        assert ref.success and ref.response.response
+        ref_text = ref.response.response
+        assert await scheduler.prober.probe_once(
+            registry.get_worker("health-peer"), "tiny-llama") == "pass"
+        assert len(scheduler.prober.goldens) == 1
+
+        child = subprocess.Popen(
+            [sys.executable, str(DRIFT_CHILD), str(broker.port),
+             "health-victim"],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True)
+        for _ in range(1200):
+            if registry.get_worker("health-victim") is not None:
+                break
+            await asyncio.sleep(0.1)
+        assert registry.get_worker("health-victim") is not None, (
+            child.stdout.read() if child.poll() is not None else
+            "victim never registered")
+
+        # same model, same engineConfigHash -> same golden key; the rotted
+        # sampler makes the FIRST canary drift, quarantining immediately
+        assert await scheduler.prober.probe_once(
+            registry.get_worker("health-victim"), "tiny-llama") == "drift"
+        assert scheduler.health.state_of("health-victim") == "quarantined"
+        assert len(scheduler.prober.goldens) == 1  # never re-sealed
+        assert scheduler.prober.summary()["byResult"].get("drift") == 1
+
+        # quarantine drains the worker; the drain's own re-register must
+        # NOT launder the verdict (registry preserves healthState)
+        drained = False
+        for _ in range(150):
+            w = registry.get_worker("health-victim")
+            if w is not None and w.status == "draining":
+                drained = True
+                break
+            await asyncio.sleep(0.1)
+        assert drained, "victim never started draining"
+        assert registry.get_worker("health-victim").healthState \
+            == "quarantined"
+
+        # zero token loss: traffic keeps matching the healthy reference
+        for _ in range(3):
+            r = await scheduler.submit_and_wait(
+                gen_req(f"job-{uuid.uuid4().hex[:8]}"), timeout_ms=60_000)
+            assert r.success and r.workerId == "health-peer"
+            assert r.response.response == ref_text
+        assert "health-victim" not in [
+            w.workerId for w in registry.get_available_workers()]
+
+        # forensics incidents name the victim
+        await pub.flush_once()
+        deadline = asyncio.get_running_loop().time() + 5
+        while (collector.count() < 2
+               and asyncio.get_running_loop().time() < deadline):
+            await asyncio.sleep(0.1)
+        kinds = {(r["kind"], r["key"]) for r in collector.reports()}
+        assert ("canary_drift", "health-victim") in kinds
+        assert ("worker_quarantined", "health-victim") in kinds
+    finally:
+        if child is not None and child.poll() is None:
+            child.kill()
+        await pub.stop()
+        await store.detach()
+        await peer.stop()
+        await scheduler.shutdown()
+        await registry.shutdown()
+        await bus.disconnect()
+        await broker.stop()
