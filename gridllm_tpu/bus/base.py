@@ -151,17 +151,22 @@ register_channel(
                 "its registration.")
 register_channel(
     "worker:admin", pattern="worker:admin", payload="keys",
-    keys=("op", "id", "model", "source", "destination", "if_idle"),
-    publishers=("gridllm_tpu/gateway/admin.py",),
+    keys=("op", "id", "model", "source", "destination", "if_idle",
+          "workerId"),
+    publishers=("gridllm_tpu/gateway/admin.py",
+                "gridllm_tpu/scheduler/placement.py"),
     subscribers=("gridllm_tpu/worker/service.py",),
     helper="CH_WORKER_ADMIN",
-    description="Gateway broadcast of model-management ops "
-                "(load/unload/copy); workers answer on admin:result.")
+    description="Model-management ops (load/unload/copy), broadcast by "
+                "the gateway or targeted at one worker (workerId key) by "
+                "the placement controller; workers answer on "
+                "admin:result.")
 register_channel(
     "admin:result", pattern="admin:result:{op_id}", payload="keys",
     keys=("workerId", "op", "ack", "ok", "detail"), durable=True,
     publishers=("gridllm_tpu/worker/service.py",),
-    subscribers=("gridllm_tpu/gateway/admin.py",),
+    subscribers=("gridllm_tpu/gateway/admin.py",
+                 "gridllm_tpu/scheduler/placement.py"),
     helper="admin_result_channel",
     description="Per-op admin answers: immediate ack, then ok/detail "
                 "when the op resolves.")

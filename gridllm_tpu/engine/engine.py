@@ -145,6 +145,52 @@ _PREFIX_HIT_RATE = _OBS.gauge(
     "by model.",
     ("model",),
 )
+# elastic serving (ISSUE 20): cold-start cost, by how the weights arrived
+# — "snapshot" (host-RAM weight tier hit), "checkpoint" (safetensors
+# re-read), "init" (fresh random init). The ModelColdStartSlow alert keys
+# on this series: snapshot restores taking checkpoint-class time mean the
+# tier is thrashing or the host is paging.
+_MODEL_LOAD_SECONDS = _OBS.histogram(
+    "gridllm_model_load_seconds",
+    "Engine weight-load wall time at (re)construction, by model and "
+    "weight source (snapshot = host-RAM tier hit, checkpoint = disk "
+    "safetensors, init = fresh init).",
+    ("model", "source"),
+    buckets=(0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0),
+)
+
+# Persistent XLA compilation cache (ISSUE 20): wiring the jax config at
+# first engine construction (idempotent, process-global) means a
+# swapped-in model replays its warmup compiles from disk instead of
+# re-running XLA — the compile half of fast cold-start. Guarded: an old
+# jax without the knobs degrades to no cache, never a startup failure.
+_compile_cache_lock = threading.Lock()
+_compile_cache_dir: str | None = None
+
+
+def ensure_compile_cache() -> str | None:
+    """Point jax at GRIDLLM_COMPILE_CACHE_DIR (once). Returns the active
+    cache dir, or None when disabled/unsupported."""
+    global _compile_cache_dir
+    with _compile_cache_lock:
+        if _compile_cache_dir is not None:
+            return _compile_cache_dir or None
+        cache_dir = env_str("GRIDLLM_COMPILE_CACHE_DIR")
+        _compile_cache_dir = cache_dir or ""
+        if not cache_dir:
+            return None
+        try:
+            jax.config.update("jax_compilation_cache_dir", cache_dir)
+            # tiny-model compiles are fast and small — cache them anyway,
+            # or the CPU tests/bench never exercise the persistent path
+            jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+            jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        except Exception as e:  # pragma: no cover - jax version drift
+            log.warning("compile cache unavailable", error=str(e))
+            _compile_cache_dir = ""
+            return None
+        log.info("persistent compile cache enabled", dir=cache_dir)
+        return cache_dir
 # speculative decoding (ISSUE 5): draft-token accounting. proposed =
 # drafts sent to a verify step, accepted = drafts the model agreed with,
 # rejected = proposed - accepted (a draft discarded because an EARLIER one
@@ -423,6 +469,7 @@ class InferenceEngine:
     facade (worker/service.py wraps step() in a thread executor)."""
 
     def __init__(self, config: EngineConfig):
+        ensure_compile_cache()
         self.config = config
         try:
             self.cfg = get_config(config.model)
@@ -520,8 +567,11 @@ class InferenceEngine:
         # the executor thread serialize through it too).
         self.plan_sink: Callable[[dict[str, Any]], None] | None = None
         self.dispatch_lock: threading.RLock = threading.RLock()
+        self.prewarm_duration_ns = 0
         self._load()
         self._build_fns()
+        if env_bool("GRIDLLM_PREWARM_COMPILES") and not self.embedding_only:
+            self.prewarm()
 
     # ---------------------------------------------------------- state setup
 
@@ -546,7 +596,30 @@ class InferenceEngine:
                 return quantize_params(p)
             return p
 
-        if c.checkpoint_path:
+        # Weight snapshot tier (ISSUE 20): a parked host copy of this
+        # exact checkpoint identity skips the safetensors re-read (or
+        # re-init) — host→device transfer only. An injected restore fault
+        # degrades to the disk/init path below, never a wedged load.
+        snap = None
+        from gridllm_tpu.engine.loader import weight_snapshot_tier
+
+        tier = weight_snapshot_tier()
+        if tier.enabled:
+            try:
+                faults.inject("swap.snapshot_restore")
+                snap = tier.restore(self.snapshot_key())
+            except faults.InjectedFault:
+                log.warning("weight snapshot restore fault; degrading to "
+                            "disk load", model=self.cfg.name)
+                snap = None
+        if snap is not None:
+            # snapshots were parked post-quantization — re-materialize on
+            # device as-is (no re-quantize), then reshard if meshed
+            self.params = jax.tree_util.tree_map(jnp.asarray, snap)
+            if self.mesh is not None:
+                self.params = shard_params(self.params, self.mesh)
+            self.load_source = "snapshot"
+        elif c.checkpoint_path:
             from gridllm_tpu.engine.loader import load_checkpoint
             from gridllm_tpu.parallel.sharding import param_shardings
 
@@ -561,18 +634,24 @@ class InferenceEngine:
             self.params = load_checkpoint(
                 mc, c.checkpoint_path, dtype, shardings, quantize=c.quantize
             )
+            self.load_source = "checkpoint"
         else:
             self.params = _maybe_quant(
                 self.mod.init_params(mc, jax.random.PRNGKey(0), dtype)
             )
             if self.mesh is not None:
                 self.params = shard_params(self.params, self.mesh)
+            self.load_source = "init"
         if self.embedding_only:
             # no generation state: encoder families have no KV cache,
             # sampler, or decode loop — just the pooled-forward embed path
             self.load_duration_ns = time.perf_counter_ns() - t0
             self.max_context = mc.max_seq_len
             self._set_buckets()
+            _MODEL_LOAD_SECONDS.observe(
+                self.load_duration_ns / 1e9,
+                model=self.cfg.name, source=self.load_source,
+            )
             return
         self._init_device_state()
         self.load_duration_ns = time.perf_counter_ns() - t0
@@ -580,6 +659,58 @@ class InferenceEngine:
             mc.max_seq_len, c.max_pages_per_slot * c.page_size
         )
         self._set_buckets()
+        _MODEL_LOAD_SECONDS.observe(
+            self.load_duration_ns / 1e9,
+            model=self.cfg.name, source=self.load_source,
+        )
+
+    def snapshot_key(self) -> str:
+        """Checkpoint identity for the weight snapshot tier: everything
+        that changes the materialized param pytree. Two engines with the
+        same key are guaranteed interchangeable weights."""
+        c = self.config
+        return "|".join((
+            self.cfg.name,
+            c.checkpoint_path or "init",
+            str(c.dtype),
+            c.quantize or "none",
+            str(c.mesh or ""),
+        ))
+
+    def park_weights(self) -> bool:
+        """Park this engine's params into the host snapshot tier (call
+        after stop(), on the unload path). On success the device
+        references are dropped so HBM weight gauges fall to zero."""
+        from gridllm_tpu.engine.loader import weight_snapshot_tier
+
+        tier = weight_snapshot_tier()
+        if not tier.enabled or self.params is None:
+            return False
+        ok = tier.park(self.snapshot_key(), self.params)
+        if ok:
+            self.params = None
+        return ok
+
+    def prewarm(self) -> None:
+        """Compile the serving shapes before the first real request: one
+        inline greedy token compiles the smallest prefill bucket plus the
+        decode step (and, with the persistent compile cache, writes them
+        to disk for every future swap-in of this model). The recompile
+        tripwire is re-disarmed afterwards so warmup accounting still
+        treats the first REAL request as warmup."""
+        if self.embedding_only or self.running:
+            return
+        t0 = time.perf_counter_ns()
+        self.generate(GenerationRequest(
+            id="prewarm",
+            prompt_ids=[1],
+            raw=True,
+            options={"temperature": 0, "seed": 0, "num_predict": 1},
+        ))
+        self._perf_armed = False
+        self.prewarm_duration_ns = time.perf_counter_ns() - t0
+        log.info("engine prewarmed", model=self.cfg.name,
+                 ms=self.prewarm_duration_ns // 1_000_000)
 
     def _set_buckets(self) -> None:
         # always include max_context so every admissible length maps to a

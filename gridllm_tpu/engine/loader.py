@@ -18,6 +18,8 @@ from __future__ import annotations
 import json
 import os
 import re
+import threading
+from collections import OrderedDict
 from typing import Any, Callable
 
 import jax
@@ -25,6 +27,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from gridllm_tpu.models.configs import ModelConfig
+from gridllm_tpu.obs import default_registry
+from gridllm_tpu.utils.config import env_int
 from gridllm_tpu.utils.logging import get_logger
 
 log = get_logger("engine.loader")
@@ -130,3 +134,158 @@ def save_checkpoint(params: Any, cfg: ModelConfig, path: str) -> None:
     save_file(out, os.path.join(path, "model.safetensors"))
     with open(os.path.join(path, "config.json"), "w") as f:
         json.dump({"model_name": cfg.name}, f)
+
+
+# ---------------------------------------------------------------------------
+# Host-RAM weight snapshot tier (ISSUE 20) — the weights twin of the KV
+# host tier: unloading a model parks its device params as host numpy
+# arrays keyed by checkpoint identity; a later load of the same identity
+# restores via host→device transfer instead of re-reading safetensors
+# (or re-running init). Capacity-bounded LRU; a miss degrades to the
+# normal disk/init path, never an error.
+
+_SNAP_BYTES = default_registry().gauge(
+    "gridllm_weight_snapshot_bytes",
+    "Host RAM held by parked weight snapshots (engine/loader.py); "
+    "bounded by GRIDLLM_WEIGHT_SNAPSHOT_BYTES.",
+)
+_SNAP_MODELS = default_registry().gauge(
+    "gridllm_weight_snapshot_models",
+    "Distinct checkpoint identities resident in the weight snapshot "
+    "tier (engine/loader.py).",
+)
+_SNAP_EVENTS = default_registry().counter(
+    "gridllm_weight_snapshot_events_total",
+    "Weight snapshot tier activity by event: park, hit (restore served "
+    "from host RAM), miss (load fell through to disk/init), evict "
+    "(LRU capacity pressure).",
+    ("event",),
+)
+
+
+class WeightSnapshotTier:
+    """LRU of host-side param pytrees, keyed by checkpoint identity.
+
+    Entries survive :meth:`restore` (weights are immutable — the same
+    snapshot can warm many future loads); capacity pressure evicts the
+    least-recently-touched identity. Thread-safe: parks run on worker
+    admin tasks while restores run on engine construction threads.
+    """
+
+    def __init__(self, capacity_bytes: int):
+        self.capacity_bytes = max(int(capacity_bytes), 0)
+        self._entries: OrderedDict[str, tuple[Any, int]] = OrderedDict()
+        self._bytes = 0
+        self._lock = threading.Lock()
+        self.parks = 0
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    @property
+    def enabled(self) -> bool:
+        return self.capacity_bytes > 0
+
+    @staticmethod
+    def _host_copy(params: Any) -> tuple[Any, int]:
+        size = 0
+
+        def pull(a):
+            nonlocal size
+            h = np.asarray(jax.device_get(a))
+            size += h.nbytes
+            return h
+
+        return jax.tree_util.tree_map(pull, params), size
+
+    def park(self, key: str, params: Any) -> bool:
+        """Copy ``params`` to host RAM under ``key``. Returns False when
+        the tier is disabled or the snapshot alone exceeds capacity."""
+        if not self.enabled:
+            return False
+        host, size = self._host_copy(params)
+        if size > self.capacity_bytes:
+            log.info("weight snapshot too large for tier; dropped",
+                     key=key, bytes=size, capacity=self.capacity_bytes)
+            return False
+        with self._lock:
+            if key in self._entries:
+                _, old = self._entries.pop(key)
+                self._bytes -= old
+            while self._bytes + size > self.capacity_bytes and self._entries:
+                old_key, (_, old_size) = self._entries.popitem(last=False)
+                self._bytes -= old_size
+                self.evictions += 1
+                _SNAP_EVENTS.inc(event="evict")
+                log.info("weight snapshot evicted", key=old_key, bytes=old_size)
+            self._entries[key] = (host, size)
+            self._bytes += size
+            self.parks += 1
+            self._publish()
+        _SNAP_EVENTS.inc(event="park")
+        log.info("weight snapshot parked", key=key, bytes=size)
+        return True
+
+    def restore(self, key: str) -> Any | None:
+        """Host pytree for ``key``, or None on miss. The entry is kept
+        (moved to MRU) — callers must not mutate the returned arrays."""
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is None:
+                self.misses += 1
+                _SNAP_EVENTS.inc(event="miss")
+                return None
+            self._entries.move_to_end(key)
+            self.hits += 1
+        _SNAP_EVENTS.inc(event="hit")
+        return entry[0]
+
+    def drop(self, key: str) -> None:
+        with self._lock:
+            entry = self._entries.pop(key, None)
+            if entry is not None:
+                self._bytes -= entry[1]
+                self._publish()
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+            self._bytes = 0
+            self._publish()
+
+    def _publish(self) -> None:
+        _SNAP_BYTES.set(self._bytes)
+        _SNAP_MODELS.set(len(self._entries))
+
+    def stats(self) -> dict[str, int]:
+        with self._lock:
+            return {
+                "entries": len(self._entries),
+                "bytes": self._bytes,
+                "capacityBytes": self.capacity_bytes,
+                "parks": self.parks,
+                "hits": self.hits,
+                "misses": self.misses,
+                "evictions": self.evictions,
+            }
+
+
+_tier: WeightSnapshotTier | None = None
+_tier_lock = threading.Lock()
+
+
+def weight_snapshot_tier() -> WeightSnapshotTier:
+    """Process-wide tier, sized from GRIDLLM_WEIGHT_SNAPSHOT_BYTES at
+    first touch (all engines in a worker share one host-RAM budget)."""
+    global _tier
+    with _tier_lock:
+        if _tier is None:
+            _tier = WeightSnapshotTier(env_int("GRIDLLM_WEIGHT_SNAPSHOT_BYTES"))
+        return _tier
+
+
+def reset_weight_snapshot_tier() -> None:
+    """Forget the singleton (tests re-read the env on next touch)."""
+    global _tier
+    with _tier_lock:
+        _tier = None

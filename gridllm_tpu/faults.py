@@ -42,6 +42,15 @@ parse instead of silently injecting nothing:
                       a golden-hash verdict)
     health.baseline   drop one baseline observation before it reaches the
                       EWMA detector (a deaf detector round)
+    swap.load         raise from the worker's admin load path before the
+                      engine is constructed (the op reports ok=false and
+                      no half-built engine survives)
+    swap.unload       raise from the worker's admin unload path before
+                      the engine is torn down (the op reports ok=false;
+                      the model stays resident and servable)
+    swap.snapshot_restore  fail a host-RAM weight-snapshot restore (the
+                      load degrades to the disk/init path — slower,
+                      never a wedged request)
 
 The hot-path cost with no spec configured is one module-global boolean
 check. Tests drive the layer through :func:`configure` directly; the env
@@ -71,6 +80,9 @@ SITES = (
     "broker.fsync",
     "probe.issue",
     "health.baseline",
+    "swap.load",
+    "swap.unload",
+    "swap.snapshot_restore",
 )
 
 _INJECTED = default_registry().counter(

@@ -24,6 +24,7 @@ jax lazily so control-plane processes stay light.
 from gridllm_tpu.obs.capacity import (
     DemandTracker,
     aggregate_worker_capacity,
+    dedup_capacity_totals,
     merge_capacity,
 )
 from gridllm_tpu.obs.flightrec import (
@@ -132,6 +133,7 @@ __all__ = [
     "UsageAccountant",
     "account_engine_usage",
     "aggregate_worker_capacity",
+    "dedup_capacity_totals",
     "build_dump",
     "build_usage",
     "classify_request",

@@ -103,6 +103,33 @@ def aggregate_worker_capacity(
     return agg
 
 
+def dedup_capacity_totals(workers: Iterable[Any]) -> dict[str, int]:
+    """Fleet slot/KV totals counting each distinct engine pool ONCE
+    (ISSUE 20 satellite). Copy-model aliases serve one engine under
+    several names; per-model cells rightly attribute the shared pool to
+    every name (any of them can use it), but summing those cells into a
+    fleet total double-counts. Heartbeat blocks carry an ``engine``
+    identity token — aliases share it, so dedup is per (worker, token).
+    Blocks without a token (older workers) are counted per name."""
+    totals = {"slotsFree": 0, "slotsTotal": 0, "kvPagesFree": 0, "engines": 0}
+    for w in workers:
+        mc = getattr(w, "modelCapacity", None) or {}
+        seen: set[int] = set()
+        for caps in mc.values():
+            if not isinstance(caps, Mapping):
+                continue
+            tok = int(caps.get("engine") or 0)
+            if tok:
+                if tok in seen:
+                    continue
+                seen.add(tok)
+            totals["slotsFree"] += int(caps.get("slotsFree") or 0)
+            totals["slotsTotal"] += int(caps.get("slotsTotal") or 0)
+            totals["kvPagesFree"] += int(caps.get("kvPagesFree") or 0)
+            totals["engines"] += 1
+    return totals
+
+
 def _utilization(cap: Mapping[str, int]) -> float:
     total = int(cap.get("slotsTotal") or 0)
     if total <= 0:
@@ -139,6 +166,7 @@ class DemandTracker:
         halflife_s: float | None = None,
         queue_depths: Callable[[], Mapping[str, int]] | None = None,
         worker_capacity: Callable[[], Mapping[str, Mapping[str, int]]] | None = None,
+        pool_totals: Callable[[], Mapping[str, int]] | None = None,
     ) -> None:
         self.halflife = float(
             halflife_s
@@ -147,6 +175,7 @@ class DemandTracker:
         )
         self._queue_depths = queue_depths or (lambda: {})
         self._worker_capacity = worker_capacity or (lambda: {})
+        self._pool_totals = pool_totals or (lambda: {})
         self._models: dict[str, _ModelDemand] = {}
         self._lock = threading.Lock()
         self._g_arrival = metrics.gauge(
@@ -186,6 +215,12 @@ class DemandTracker:
             "Signed replica delta to hold the SLO at current burn rate "
             "(positive = scale out).",
             ("model",),
+        )
+        self._g_fleet = metrics.gauge(
+            "gridllm_capacity_fleet_slots",
+            "Fleet decode slots deduped by engine identity (copy-model "
+            "aliases counted once), by state (free / total).",
+            ("state",),
         )
         metrics.add_collector("capacity", self._collect)
 
@@ -245,7 +280,8 @@ class DemandTracker:
                         queue_depth=qd,
                     ),
                 }
-        return {"halflifeS": self.halflife, "models": models}
+        fleet = {k: int(v) for k, v in dict(self._pool_totals()).items()}
+        return {"halflifeS": self.halflife, "models": models, "fleet": fleet}
 
     def _collect(self) -> None:
         snap = self.snapshot()
@@ -260,6 +296,10 @@ class DemandTracker:
                 m["headroom"]["kvPages"], model=model, resource="kv_pages"
             )
             self._g_hint.set(m["scaleHint"], model=model)
+        fleet = snap.get("fleet") or {}
+        if fleet:
+            self._g_fleet.set(fleet.get("slotsFree", 0), state="free")
+            self._g_fleet.set(fleet.get("slotsTotal", 0), state="total")
 
 
 def merge_capacity(snapshots: Iterable[Mapping[str, Any]]) -> dict[str, Any]:
@@ -269,6 +309,7 @@ def merge_capacity(snapshots: Iterable[Mapping[str, Any]]) -> dict[str, Any]:
     registry, so element-wise max avoids double counting.  The scale
     hint is recomputed from the merged numbers."""
     models: dict[str, dict[str, Any]] = {}
+    fleet: dict[str, int] = {}
     shards = 0
     halflife = 0.0
     for snap in snapshots:
@@ -276,6 +317,10 @@ def merge_capacity(snapshots: Iterable[Mapping[str, Any]]) -> dict[str, Any]:
             continue
         shards += 1
         halflife = max(halflife, float(snap.get("halflifeS") or 0.0))
+        # every shard's registry observes the same workers — element-wise
+        # max (like headroom), never a sum
+        for k, v in (snap.get("fleet") or {}).items():
+            fleet[k] = max(int(fleet.get(k, 0)), int(v or 0))
         for model, m in (snap.get("models") or {}).items():
             cell = models.setdefault(
                 model,
@@ -320,4 +365,5 @@ def merge_capacity(snapshots: Iterable[Mapping[str, Any]]) -> dict[str, Any]:
             arrival_rate=cell["arrivalRate"],
             queue_depth=cell["queueDepth"],
         )
-    return {"shards": shards, "halflifeS": halflife, "models": models}
+    return {"shards": shards, "halflifeS": halflife, "models": models,
+            "fleet": fleet}

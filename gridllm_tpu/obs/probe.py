@@ -121,9 +121,25 @@ class CanaryProber:
                 continue
             if getattr(w, "healthState", "online") == "quarantined":
                 continue
+            mc = getattr(w, "modelCapacity", None) or {}
             for model in w.model_names():
+                # scale-to-zero (ISSUE 20): a model mid-unload (or already
+                # unloaded, pending re-registration) has no capacity block
+                # in the worker's freshest heartbeat — probing it now
+                # would time out and trip CanaryDrift on a healthy worker.
+                # Embedding-only models never report capacity and are
+                # exempt from the check.
+                if mc and model not in mc and not self._embedding_model(w, model):
+                    continue
                 out.append((w, model))
         return out
+
+    @staticmethod
+    def _embedding_model(worker: Any, model: str) -> bool:
+        for m in worker.capabilities.availableModels:
+            if m.name == model:
+                return (m.details or {}).get("family") == "bert_embed"
+        return False
 
     def _next_target(self) -> tuple[Any, str] | None:
         targets = self._targets()

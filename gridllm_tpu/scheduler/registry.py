@@ -264,9 +264,13 @@ class WorkerRegistry(EventEmitter):
                 if not isinstance(caps, dict):
                     continue
                 try:
+                    # "engine" is the alias-dedup identity token (ISSUE
+                    # 20): copy-model aliases share it, so fleet totals
+                    # can count the shared pool once
                     bounded[str(model)] = {
                         k: max(int(caps.get(k, 0)), 0)
-                        for k in ("slotsFree", "slotsTotal", "kvPagesFree")
+                        for k in ("slotsFree", "slotsTotal", "kvPagesFree",
+                                  "engine")
                     }
                 except (TypeError, ValueError):
                     continue

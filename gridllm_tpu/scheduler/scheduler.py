@@ -66,6 +66,7 @@ from gridllm_tpu.obs import (
     UsageAccountant,
     aggregate_worker_capacity,
     classify_request,
+    dedup_capacity_totals,
     default_flight_recorder,
 )
 from gridllm_tpu.obs.timeline import CRITICAL_PATH_SEGMENTS, critical_path
@@ -299,7 +300,15 @@ class JobScheduler(EventEmitter):
             queue_depths=self._queue_depth_by_model,
             worker_capacity=lambda: aggregate_worker_capacity(
                 self.registry.get_online_workers()),
+            pool_totals=lambda: dedup_capacity_totals(
+                self.registry.get_online_workers()),
         )
+        # elastic serving (ISSUE 20): the demand-driven model placement
+        # loop — armed only when GRIDLLM_PLACEMENT_INTERVAL_MS > 0
+        from gridllm_tpu.scheduler.placement import ModelPlacementController
+
+        self.placement = ModelPlacementController(
+            self, self.registry, self.bus, self.metrics)
         # active fleet health (ISSUE 19): per-worker regression baselines
         # driving the online/degraded/quarantined/probation state machine,
         # and the canary prober that feeds it golden-hash verdicts. The
@@ -365,11 +374,13 @@ class JobScheduler(EventEmitter):
             "worker_health_changed",
             lambda *_: self.request_dispatch())
         self.prober.start()
+        self.placement.start()
         log.info("job scheduler initialized",
                  queued=len(self.job_queue), active=len(self.active_jobs))
 
     async def shutdown(self) -> None:
         self._running = False
+        await self.placement.stop()
         await self.prober.stop()
         await self.watchdog.stop()
         if self._sweep_task:
@@ -1031,6 +1042,10 @@ class JobScheduler(EventEmitter):
                         # lower-priority running one to the host KV tier
                         await self._maybe_preempt(qj, now)
                     if not owners:
+                        # scale-to-zero and back (ISSUE 20): the job stays
+                        # QUEUED (never rejected) and the placement
+                        # controller is asked for an immediate swap-in
+                        self.placement.note_unserved(qj.request.model)
                         # loud no-owner log (reference: JobScheduler.ts:176-204),
                         # rate-limited to once per model per 5 s
                         now = time.time()

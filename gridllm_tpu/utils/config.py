@@ -415,6 +415,44 @@ register_env("GRIDLLM_HEALTH_DEGRADED_PENALTY", "0.5",
              "probation workers (same scale as the proportional load "
              "term; mirrors prefix_affinity_weight).")
 
+# elastic serving (ISSUE 20) — snapshot tier, compile cache, placement
+register_env("GRIDLLM_WEIGHT_SNAPSHOT_BYTES", "0",
+             "Host-RAM weight snapshot tier capacity (bytes). Unloading "
+             "a model parks its device params as host arrays keyed by "
+             "checkpoint identity; a later load restores via host-to-"
+             "device transfer instead of re-reading the checkpoint. "
+             "LRU-evicted past capacity; 0 disables the tier.")
+register_env("GRIDLLM_COMPILE_CACHE_DIR", "",
+             "Persistent XLA compilation-cache directory (wired to "
+             "jax_compilation_cache_dir at engine construction). A "
+             "swapped-in model reuses compiles from any prior process "
+             "that warmed the same shapes. Empty disables.")
+register_env("GRIDLLM_PREWARM_COMPILES", "0",
+             "When 1, a freshly loaded engine runs a one-token greedy "
+             "prewarm request before serving, compiling the smallest "
+             "prefill bucket and the decode step so the first real "
+             "request skips warmup compiles (with the compile cache "
+             "this is a disk hit, not an XLA compile).")
+register_env("GRIDLLM_PLACEMENT_INTERVAL_MS", "0",
+             "Model-placement controller cadence per scheduler shard "
+             "(ms between ticks). Each tick compares per-model demand "
+             "(queue depth, scale hints) against resident replicas and "
+             "issues load/unload admin ops to live workers. 0 disables "
+             "the controller (static placement).")
+register_env("GRIDLLM_MODEL_IDLE_TTL_MS", "0",
+             "Idle time (ms, no queued/active work and no arrivals) "
+             "after which the placement controller unloads a model's "
+             "replicas above its min-replica floor, releasing slots and "
+             "HBM. 0 disables idle unload (models stay resident).")
+register_env("GRIDLLM_SWAP_COOLDOWN_MS", "10000",
+             "Hysteresis: minimum gap (ms) between placement actions "
+             "for the same model, so demand flapping around a threshold "
+             "cannot thrash load/unload cycles.")
+register_env("GRIDLLM_MODEL_FLOORS", "",
+             "Comma-separated model=N min-replica floors (SLO classes): "
+             "the placement controller never drops a listed model below "
+             "N replicas, and restores it toward N when under.")
+
 # observability: perf introspection
 register_env("GRIDLLM_RECOMPILE_BUDGET", "4",
              "Steady-state recompiles tolerated per window before a "
